@@ -1,0 +1,31 @@
+"""enterprise_warp_tpu — a TPU-native pulsar-timing-array inference framework.
+
+A from-scratch reimplementation of the capabilities of the reference
+``enterprise_warp`` wrapper *and* the external numerics stack it drives
+(Enterprise's marginalized Gaussian-process likelihood, PTMCMC-style adaptive
+sampling, optimal statistic, noise simulation), designed TPU-first:
+
+- the likelihood is a pure, jit-compiled JAX kernel batched (``vmap``) over
+  sampler walkers and pulsars instead of a scalar Python callback
+  (reference hot path: ``enterprise_warp/bilby_warp.py:19-35``);
+- multi-pulsar correlated-GWB runs shard pulsars over a
+  ``jax.sharding.Mesh`` with XLA collectives instead of MPI file staging
+  (reference: ``enterprise_warp/enterprise_warp.py:46-55``);
+- precision strategy for TPU: large TOA-axis contractions run in f32 on
+  whitened bases, the small inner Cholesky solves run in f64.
+
+Subpackages
+-----------
+``io``        .par/.tim parsing, Pulsar containers, timing-model design matrix
+``ops``       Fourier bases, the likelihood kernels, ORFs
+``models``    the noise-model vocabulary registry (StandardModels equivalent)
+``config``    paramfile DSL + noise-model JSON dispatch
+``samplers``  native adaptive MCMC / nested sampling / hypermodel
+``parallel``  device-mesh sharding of the PTA likelihood
+``results``   post-processing over the reference's output-directory contract
+``sim``       noise injection / dataset simulation
+"""
+
+__version__ = "0.1.0"
+
+from . import constants  # noqa: F401

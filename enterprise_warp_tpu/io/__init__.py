@@ -1,0 +1,18 @@
+"""Data ingestion: tempo2-format .par/.tim parsing and Pulsar containers.
+
+This subpackage natively absorbs the capability the reference consumes from
+tempo2/libstempo/Enterprise's ``Pulsar`` constructor
+(``/root/reference/enterprise_warp/enterprise_warp.py:382,409``): reading pulsar
+timing data from disk and producing the arrays the GP likelihood needs
+(TOAs, residuals, errors, radio frequencies, flags, sky position, and the
+linearized timing-model design matrix).
+"""
+
+from .par import parse_par, ParFile
+from .tim import parse_tim, TimFile
+from .pulsar import Pulsar, load_pulsar, load_pulsars_from_dir
+
+__all__ = [
+    "parse_par", "ParFile", "parse_tim", "TimFile",
+    "Pulsar", "load_pulsar", "load_pulsars_from_dir",
+]

@@ -1,0 +1,128 @@
+"""tempo2 .tim (TOA) file parser.
+
+Replaces the TOA-ingestion capability the reference gets from
+tempo2/libstempo. Handles the tempo2 ``FORMAT 1`` grammar used by the shipped
+fixtures (``/root/reference/examples/data/*.tim``): one TOA per line,
+
+    <archive-name> <freq MHz> <MJD> <uncertainty us> <site> [-flag value]...
+
+plus ``FORMAT``/``MODE`` headers, ``INCLUDE`` directives, and ``C``/``#``
+comment lines.
+
+Precision note (TPU-first design): a TOA written with 17 fractional MJD digits
+carries more precision than one float64 (86400 s x 1e-16 rounds to ~0.5 us at
+MJD ~5e4). TOAs are therefore stored two-part — integer MJD plus float64
+seconds-within-day — and only differenced against a reference epoch when the
+float64 second-scale arrays for the likelihood are built (ns-level accuracy,
+far below the ~1 us TOA uncertainties).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+def _is_flag(tok: str) -> bool:
+    """A '-x' token introduces a flag unless it parses as a number."""
+    if not tok.startswith("-") or len(tok) < 2:
+        return False
+    nxt = tok[1]
+    return not (nxt.isdigit() or nxt == ".")
+
+
+@dataclass
+class TimFile:
+    """Parsed .tim contents (arrays aligned on the TOA axis)."""
+
+    names: np.ndarray = None        # archive name per TOA (str)
+    freqs: np.ndarray = None        # radio frequency, MHz (f64)
+    mjd_int: np.ndarray = None      # integer MJD (i64)
+    sec: np.ndarray = None          # seconds within day (f64)
+    errs: np.ndarray = None         # TOA uncertainty, microseconds (f64)
+    sites: np.ndarray = None        # observatory code per TOA (str)
+    flags: dict = field(default_factory=dict)  # flag -> np.ndarray[str] ('' = absent)
+
+    def __len__(self):
+        return len(self.freqs)
+
+    @property
+    def mjd(self) -> np.ndarray:
+        """Approximate single-float MJD (display/plotting only)."""
+        return self.mjd_int + self.sec / 86400.0
+
+
+def _split_mjd(text: str):
+    """Split an MJD string into (int day, float seconds-of-day) losslessly."""
+    if "." in text:
+        ip, fp = text.split(".", 1)
+        return int(ip), float("0." + fp) * 86400.0
+    return int(text), 0.0
+
+
+def parse_tim(path: str) -> TimFile:
+    """Parse a tempo2 FORMAT-1 .tim file (recursing into INCLUDEs)."""
+    names, freqs, mjd_i, secs, errs, sites = [], [], [], [], [], []
+    flag_rows: list[dict] = []
+
+    def _parse_file(p, depth=0):
+        if depth > 16:
+            raise ValueError(
+                f"INCLUDE nesting deeper than 16 at {p} (cyclic include?)")
+        base = os.path.dirname(p)
+        with open(p) as fh:
+            for line in fh:
+                s = line.strip()
+                if not s or s.startswith(("#", "C ", "CN ")):
+                    continue
+                toks = s.split()
+                head = toks[0].upper()
+                if head == "FORMAT" or head == "MODE":
+                    continue
+                if head == "INCLUDE" and len(toks) >= 2:
+                    inc = toks[1]
+                    if not os.path.isabs(inc):
+                        inc = os.path.join(base, inc)
+                    _parse_file(inc, depth + 1)
+                    continue
+                if len(toks) < 5:
+                    continue
+                names.append(toks[0])
+                freqs.append(float(toks[1]))
+                di, sec = _split_mjd(toks[2])
+                mjd_i.append(di)
+                secs.append(sec)
+                errs.append(float(toks[3]))
+                sites.append(toks[4])
+                row = {}
+                i = 5
+                while i < len(toks):
+                    if _is_flag(toks[i]):
+                        flag = toks[i][1:]
+                        if i + 1 < len(toks) and not _is_flag(toks[i + 1]):
+                            row[flag] = toks[i + 1]
+                            i += 2
+                        else:
+                            row[flag] = "1"
+                            i += 1
+                    else:
+                        i += 1
+                flag_rows.append(row)
+
+    _parse_file(path)
+
+    tf = TimFile(
+        names=np.array(names, dtype=object),
+        freqs=np.array(freqs, dtype=np.float64),
+        mjd_int=np.array(mjd_i, dtype=np.int64),
+        sec=np.array(secs, dtype=np.float64),
+        errs=np.array(errs, dtype=np.float64),
+        sites=np.array(sites, dtype=object),
+    )
+    all_flags = sorted({k for row in flag_rows for k in row})
+    for k in all_flags:
+        tf.flags[k] = np.array([row.get(k, "") for row in flag_rows],
+                               dtype=object)
+    return tf

@@ -1,0 +1,49 @@
+"""Test configuration.
+
+Runs the suite on a virtual 8-device CPU mesh (multi-chip sharding tests
+execute without TPU hardware) with float64 enabled, per the project test
+strategy (SURVEY.md §4: likelihood-equivalence vs fp64 oracle).
+
+Environment variables must be set before jax initializes its backends, hence
+the module-level assignment ahead of any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pathlib  # noqa: E402
+
+import pytest  # noqa: E402
+
+REFERENCE_DATA = pathlib.Path("/root/reference/examples/data")
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="session")
+def ref_data_dir():
+    if not REFERENCE_DATA.exists():
+        pytest.skip("reference data not mounted")
+    return REFERENCE_DATA
+
+
+@pytest.fixture(scope="session")
+def fake_psr(ref_data_dir):
+    from enterprise_warp_tpu.io import load_pulsar
+    return load_pulsar(str(ref_data_dir / "fake_psr_0.par"),
+                       str(ref_data_dir / "fake_psr_0.tim"))
+
+
+@pytest.fixture(scope="session")
+def real_psr(ref_data_dir):
+    from enterprise_warp_tpu.io import load_pulsar
+    return load_pulsar(str(ref_data_dir / "J1832-0836.par"),
+                       str(ref_data_dir / "J1832-0836.tim"))
