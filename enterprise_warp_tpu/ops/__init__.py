@@ -1,0 +1,20 @@
+"""Numerical kernels: Fourier/quantization bases, PSDs, the GP likelihood.
+
+This subpackage natively reimplements the numerics the reference consumes
+from Enterprise (the rank-reduced Gaussian-process marginalized likelihood
+behind ``pta.get_lnlikelihood`` at
+``/root/reference/enterprise_warp/bilby_warp.py:35``) as pure JAX functions
+designed for the TPU: static shapes, batched matmuls on the MXU, mixed
+f32-Gram / f64-solve precision.
+"""
+
+from .fourier import fourier_design, dm_scaling, chromatic_scaling, \
+    quantization_matrix
+from .spectra import powerlaw_psd, broken_powerlaw_psd, free_spectrum_psd
+from .kernel import marginalized_loglike, whiten_inputs
+
+__all__ = [
+    "fourier_design", "dm_scaling", "chromatic_scaling",
+    "quantization_matrix", "powerlaw_psd", "broken_powerlaw_psd",
+    "free_spectrum_psd", "marginalized_loglike", "whiten_inputs",
+]

@@ -1,0 +1,61 @@
+"""Power-spectral-density models -> Fourier-coefficient prior variances.
+
+Pure JAX functions mapping sampled hyper-parameters to the per-coefficient
+prior variance vector ``phi`` of a rank-reduced GP. Formula conventions match
+the reference stack exactly (Enterprise ``utils.powerlaw``; the broken power
+law of Goncharov, Zhu & Thrane 2019 at
+``/root/reference/enterprise_warp/enterprise_models.py:553-563``; and
+``gp_priors.free_spectrum``) so hyper-parameter posteriors are directly
+comparable.
+
+Each function takes the frequency grid ``f`` (nmodes,) and the grid spacing
+``df`` (nmodes,) and returns variances per *mode*; the kernel repeats them
+over the interleaved (sin, cos) columns.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import constants as const
+
+
+def _repeat_modes(phi_modes):
+    """(nmodes,) mode variances -> (2*nmodes,) interleaved sin/cos slots."""
+    return jnp.repeat(phi_modes, 2)
+
+
+def powerlaw_psd(f, df, log10_A, gamma):
+    """Power-law red-noise prior variance per Fourier mode.
+
+    phi_k = A^2 / (12 pi^2) * fyr^(gamma-3) * f_k^(-gamma) * df_k
+    """
+    A2 = 10.0 ** (2.0 * log10_A)
+    phi = (A2 / (12.0 * jnp.pi ** 2)
+           * const.fyr ** (gamma - 3.0) * f ** (-gamma) * df)
+    return _repeat_modes(phi)
+
+
+def broken_powerlaw_psd(f, df, log10_A, gamma, fc):
+    """Broken power law (Goncharov+ 2019): corner frequency flattens the
+    spectrum below fc; ``fc < 0`` is interpreted as log10(fc) (reference
+    convention at ``enterprise_models.py:561``)."""
+    fc = jnp.where(fc < 0, 10.0 ** fc, fc)
+    A2 = 10.0 ** (2.0 * log10_A)
+    phi = (A2 / (12.0 * jnp.pi ** 2) * const.fyr ** (-3.0)
+           * ((f + fc) / const.fyr) ** (-gamma) * df)
+    return _repeat_modes(phi)
+
+
+def free_spectrum_psd(f, df, log10_rho):
+    """Free spectrum: rho_k^2 per mode, independent of f/df."""
+    del f, df
+    return _repeat_modes(10.0 ** (2.0 * log10_rho))
+
+
+def df_from_freqs(freqs):
+    """Grid spacing including the DC gap, matching the reference's
+    ``np.diff(np.concatenate(([0], f[::components])))`` convention."""
+    import numpy as np
+    f = np.asarray(freqs)
+    return np.diff(np.concatenate(([0.0], f)))

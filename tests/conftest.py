@@ -19,6 +19,9 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
+# the axon TPU plugin ignores the JAX_PLATFORMS env var; force CPU here so
+# the suite runs on the virtual 8-device host mesh
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib  # noqa: E402
 
