@@ -1,0 +1,23 @@
+"""Noise-model vocabulary and model construction.
+
+Reimplements the reference's model layer — ``StandardModels`` and its
+string-dispatched method vocabulary
+(``/root/reference/enterprise_warp/enterprise_models.py:19-536``) plus the
+PTA assembly of ``init_pta``
+(``/root/reference/enterprise_warp/enterprise_warp.py:437-519``) — as a
+declarative pipeline: model methods emit small *term specs* (pure data), and
+``build`` lowers a list of term specs + a Pulsar into one compiled, batched
+JAX likelihood. User custom models subclass :class:`StandardModels` exactly
+as in the reference plugin contract (``examples/custom_models.py``).
+"""
+
+from .priors import Uniform, Normal, LinearExp, Constant, Parameter
+from .terms import WhiteTerm, BasisTerm, CommonTerm, TermList
+from .standard import StandardModels
+from .build import build_pulsar_likelihood, PulsarLikelihood
+
+__all__ = [
+    "Uniform", "Normal", "LinearExp", "Constant", "Parameter",
+    "WhiteTerm", "BasisTerm", "CommonTerm", "TermList",
+    "StandardModels", "build_pulsar_likelihood", "PulsarLikelihood",
+]

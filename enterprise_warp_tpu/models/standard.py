@@ -1,0 +1,385 @@
+"""StandardModels: the noise-model vocabulary, string-dispatched by name.
+
+Faithful functional equivalent of the reference's model class
+(``/root/reference/enterprise_warp/enterprise_models.py:19-536``): method
+names are the vocabulary of noise-model JSON files, ``self.priors`` carries
+default prior bounds that the paramfile can override, and custom models
+subclass this and add methods + prior entries (plugin contract:
+``/root/reference/examples/custom_models.py``). Methods emit term specs
+(see ``terms.py``) instead of Enterprise signal objects.
+
+Differences by design (documented):
+
+- selections are precomputed masks, not runtime-synthesized functions
+  (replaces the CodeType factory at ``enterprise_models.py:576-642``);
+- ``bayes_ephem`` builds an ephemeris-derivative basis whose coefficients
+  are *marginalized analytically* under (Gaussianized) physical priors
+  instead of sampled;
+- a ``white_noise`` convenience term (efac+equad) exists because shipped
+  noise-model JSONs use it under ``universal``.
+"""
+
+from __future__ import annotations
+
+import types
+
+import numpy as np
+
+from .. import constants as const
+from ..io import bary
+from ..ops import fourier_design, dm_scaling
+from ..ops.spectra import df_from_freqs
+from ..ops.fourier import log_freq_ratio
+from .priors import (Uniform, Normal, LinearExp, Constant, Parameter,
+                     interpret_white_noise_prior)
+from .terms import WhiteTerm, BasisTerm, CommonTerm
+
+_SELECTION_FLAGS = {
+    "by_backend": None,        # psr.backend_flags ('-f' convention)
+    "by_group": "group",
+    "by_band": "B",
+    "by_frontend": "fe",
+    "by_be": "be",
+}
+
+
+class StandardModels:
+    """Standard models for pulsar timing analyses (term-spec emitting)."""
+
+    def __init__(self, psr=None, params=None):
+        self.psr = psr
+        self.params = params
+        self.priors = {
+            "efac": [0., 10.],
+            "equad": [-10., -5.],
+            "ecorr": [-10., -5.],
+            "sn_lgA": [-20., -6.],
+            "sn_gamma": [0., 10.],
+            "sn_fc": [-10., -6.],
+            "dmn_lgA": [-20., -6.],
+            "dmn_gamma": [0., 10.],
+            "chrom_idx": [0., 6.],
+            "syn_lgA": [-20., -6.],
+            "syn_gamma": [0., 10.],
+            "gwb_lgA": [-20., -6.],
+            "gwb_lgA_prior": "uniform",
+            "gwb_lgrho": [-10., -4.],
+            "gwb_gamma": [0., 10.],
+            "gwb_gamma_prior": "uniform",
+            "red_general_freqs": "tobs_60days",
+            "red_general_nfouriercomp": 2,
+        }
+        if self.params is None:
+            # standalone use: defaults namespace from the priors dict
+            self.params = types.SimpleNamespace(
+                Tspan=None, fref=1400.0, **self.priors)
+        self.nfreqs_log = []     # (selection, flagval, nfreqs) provenance
+
+    # ------------------------------------------------------------------ #
+    def get_label_attr_map(self):
+        """self.priors -> paramfile schema extension (reference
+        ``enterprise_models.py:90-101``)."""
+        label_attr_map = {}
+        for key, val in self.priors.items():
+            if hasattr(val, "__iter__") and not isinstance(val, str):
+                types_ = [type(v) for v in val]
+            else:
+                types_ = [type(val)]
+            label_attr_map[key + ":"] = [key] + types_
+        return label_attr_map
+
+    def _p(self, key, idx):
+        """Prior bound component from the params namespace."""
+        return getattr(self.params, key)[idx]
+
+    def _uniform(self, key):
+        return Uniform(self._p(key, 0), self._p(key, 1))
+
+    def _psr_name(self):
+        return self.psr.name if self.psr is not None else ""
+
+    def _tspan(self, mask=None):
+        if mask is not None and mask.any():
+            t = self.psr.toas[mask]
+            return float(t.max() - t.min())
+        if getattr(self.params, "Tspan", None):
+            return float(self.params.Tspan)
+        return self.psr.Tspan
+
+    def determine_nfreqs(self, tspan, cadence=60.0):
+        """'tobs_60days' heuristic or fixed count (reference
+        ``enterprise_models.py:436-468``)."""
+        spec = getattr(self.params, "red_general_freqs", "tobs_60days")
+        if isinstance(spec, str) and spec.isdigit():
+            return int(spec)
+        if isinstance(spec, (int, float)):
+            return int(spec)
+        return int(np.round((1.0 / (cadence * const.day) - 1.0 / tspan)
+                            / (1.0 / tspan)))
+
+    @staticmethod
+    def _split_nfreqs(option):
+        """Strip an embedded '<n>_nfreqs' from an option string; returns
+        (option, nfreqs or None). E.g. 'powerlaw_30_nfreqs' ->
+        ('powerlaw', 30)."""
+        if isinstance(option, str) and "_nfreqs" in option:
+            parts = option.split("_")
+            i = parts.index("nfreqs") - 1
+            n = int(parts[i])
+            del parts[i:i + 2]
+            rest = "_".join(parts)
+            return rest, n
+        return option, None
+
+    def _selection_masks(self, option):
+        if option in _SELECTION_FLAGS:
+            flag = _SELECTION_FLAGS[option]
+            return self.psr.backend_masks(flag)
+        if option in (None, "no_selection", "default"):
+            return {"": np.ones(len(self.psr), dtype=bool)}
+        raise ValueError(f"unknown selection option '{option}'")
+
+    def _white_params(self, kind, masks, prior_spec):
+        prior = interpret_white_noise_prior(prior_spec)
+        suffix = {"efac": "efac", "equad": "log10_equad",
+                  "ecorr": "log10_ecorr"}[kind]
+        names = []
+        for key in sorted(masks):
+            stem = f"{self._psr_name()}_{key}" if key else self._psr_name()
+            names.append(Parameter(f"{stem}_{suffix}", prior))
+        return names
+
+    # ------------------- single-pulsar white noise --------------------- #
+    def efac(self, option="by_backend"):
+        masks = self._selection_masks(option)
+        return WhiteTerm("efac", masks,
+                         self._white_params("efac", masks,
+                                            self.params.efac))
+
+    def equad(self, option="by_backend"):
+        masks = self._selection_masks(option)
+        return WhiteTerm("equad", masks,
+                         self._white_params("equad", masks,
+                                            self.params.equad))
+
+    def ecorr(self, option="by_backend"):
+        masks = self._selection_masks(option)
+        return WhiteTerm("ecorr", masks,
+                         self._white_params("ecorr", masks,
+                                            self.params.ecorr))
+
+    def white_noise(self, option="by_backend"):
+        """efac + equad convenience (used by shipped noise-model JSONs
+        under 'universal')."""
+        return [self.efac(option), self.equad(option)]
+
+    # ------------------- single-pulsar red processes ------------------- #
+    def _red_basis(self, nfreqs, mask=None, tspan=None):
+        tspan = tspan or self._tspan(mask)
+        toas = self.psr.toas - self.psr.toas.min()
+        F, freqs = fourier_design(toas, nfreqs, tspan)
+        if mask is not None:
+            F = F * mask[:, None]
+        return F, freqs, df_from_freqs(freqs)
+
+    def _psd_params(self, stem, psd, lgA_key, gamma_key):
+        ps = [Parameter(f"{stem}_log10_A", self._uniform(lgA_key)),
+              Parameter(f"{stem}_gamma", self._uniform(gamma_key))]
+        if psd == "turnover":
+            ps.append(Parameter(f"{stem}_fc", self._uniform("sn_fc")))
+        return ps
+
+    def spin_noise(self, option="powerlaw"):
+        """Achromatic red noise, signal name 'red_noise' (reference
+        ``enterprise_models.py:169-188``)."""
+        option, nfreqs = self._split_nfreqs(option)
+        nfreqs = nfreqs or self.determine_nfreqs(self._tspan())
+        self.nfreqs_log.append(("no selection", "-", nfreqs))
+        F, freqs, df = self._red_basis(nfreqs)
+        stem = f"{self._psr_name()}_red_noise"
+        return BasisTerm("red_noise", F, freqs, df, psd=option,
+                         params=self._psd_params(stem, option,
+                                                 "sn_lgA", "sn_gamma"))
+
+    def dm_noise(self, option="powerlaw"):
+        """DM-chromatic red noise ~ nu^-2, signal name 'dm_gp'."""
+        option, nfreqs = self._split_nfreqs(option)
+        nfreqs = nfreqs or self.determine_nfreqs(self._tspan())
+        self.nfreqs_log.append(("no selection", "-", nfreqs))
+        F, freqs, df = self._red_basis(nfreqs)
+        scale = dm_scaling(self.psr.freqs, self.params.fref)
+        stem = f"{self._psr_name()}_dm_gp"
+        return BasisTerm("dm_gp", F, freqs, df, psd=option,
+                         params=self._psd_params(stem, option,
+                                                 "dmn_lgA", "dmn_gamma"),
+                         row_scale=scale)
+
+    def chromred(self, option="vary"):
+        """Chromatic noise ~ nu^-idx with idx fixed or sampled (reference
+        ``enterprise_models.py:213-254``)."""
+        option, nfreqs = self._split_nfreqs(option)
+        psd = "powerlaw"
+        if isinstance(option, str) and "turnover" in option:
+            psd = "turnover"
+            parts = option.split("_")
+            del parts[parts.index("turnover")]
+            option = "_".join(parts)
+        nfreqs = nfreqs or self.determine_nfreqs(self._tspan())
+        F, freqs, df = self._red_basis(nfreqs)
+        stem = f"{self._psr_name()}_chromatic_gp"
+        params = self._psd_params(stem, psd, "dmn_lgA", "dmn_gamma")
+        if option == "vary" or option == "":
+            idx_param = Parameter(f"{stem}_idx", self._uniform("chrom_idx"))
+            return BasisTerm("chromatic_gp", F, freqs, df, psd=psd,
+                             params=params, dynamic_idx=idx_param,
+                             log_nu_ratio=log_freq_ratio(
+                                 self.psr.freqs, self.params.fref))
+        idx = float(option)
+        from ..ops import chromatic_scaling
+        return BasisTerm("chromatic_gp", F, freqs, df, psd=psd,
+                         params=params,
+                         row_scale=chromatic_scaling(
+                             self.psr.freqs, idx, self.params.fref))
+
+    def _selected_red(self, flag, flagval, name_stem):
+        """One red-noise term restricted to '-flag flagval' TOAs."""
+        term, nfreqs = self._split_nfreqs(flagval)
+        psd = "powerlaw"
+        if isinstance(term, str) and "turnover" in term:
+            psd = "turnover"
+            parts = term.split("_")
+            del parts[parts.index("turnover")]
+            term = "_".join(parts)
+        mask = self.psr.flag_mask(flag, term)
+        if not mask.any():
+            raise ValueError(
+                f"{self.psr.name}: no TOAs with -{flag} {term}")
+        tspan = self._tspan(mask)
+        nfreqs = nfreqs or self.determine_nfreqs(tspan)
+        self.nfreqs_log.append((flag, term, nfreqs))
+        F, freqs, df = self._red_basis(nfreqs, mask=mask, tspan=tspan)
+        stem = f"{self._psr_name()}_{name_stem}_{term}"
+        return BasisTerm(f"{name_stem}_{term}", F, freqs, df, psd=psd,
+                         params=self._psd_params(stem, psd,
+                                                 "syn_lgA", "syn_gamma"))
+
+    def system_noise(self, option=()):
+        """Per-system red noise via the '-group' flag (reference
+        ``enterprise_models.py:256-292``)."""
+        return [self._selected_red("group", v, "system_noise")
+                for v in option]
+
+    def ppta_band_noise(self, option=()):
+        """Per-band red noise via the PPTA '-B' flag (reference
+        ``enterprise_models.py:294-338``)."""
+        return [self._selected_red("B", v, "band_noise") for v in option]
+
+    # ------------------------- common signals -------------------------- #
+    def gwb(self, option="hd_vary_gamma"):
+        """Stochastic GW background / common process; '+'-composable
+        option grammar matching the reference (``enterprise_models.py:
+        342-425``): [hd|mono|dipo|<none>] x [vary_gamma|fixed_gamma|
+        <val>_gamma|freesp] [noauto] [<n>_nfreqs] [namehd|nameorf]."""
+        out = []
+        optsp = option.split("+")
+        for opt in optsp:
+            opt_s, nfreqs = self._split_nfreqs(opt)
+            if nfreqs is None:
+                tspan = (self.params.Tspan if
+                         getattr(self.params, "Tspan", None)
+                         else self._tspan())
+                nfreqs = self.determine_nfreqs(tspan)
+
+            name = "gw"
+            if len(optsp) > 1 and "hd" in opt_s or "namehd" in opt_s:
+                name = "gw_hd"
+
+            if "freesp" in opt_s:
+                psd = "free_spectrum"
+                rho_prior = Uniform(self._p("gwb_lgrho", 0),
+                                    self._p("gwb_lgrho", 1))
+                params = [Parameter(f"{name}_log10_rho_{k}", rho_prior)
+                          for k in range(nfreqs)]
+            else:
+                psd = "powerlaw"
+                if getattr(self.params, "gwb_lgA_prior",
+                           "uniform") == "linexp":
+                    amp_prior = LinearExp(self._p("gwb_lgA", 0),
+                                          self._p("gwb_lgA", 1))
+                else:
+                    amp_prior = self._uniform("gwb_lgA")
+                if "vary_gamma" in opt_s:
+                    gam_prior = self._uniform("gwb_gamma")
+                elif "fixed_gamma" in opt_s:
+                    gam_prior = Constant(4.33)
+                elif "_gamma" in opt_s:
+                    parts = opt_s.split("_")
+                    gam_prior = Constant(
+                        float(parts[parts.index("gamma") - 1]))
+                else:
+                    gam_prior = self._uniform("gwb_gamma")
+                params = [Parameter(f"{name}_log10_A", amp_prior),
+                          Parameter(f"{name}_gamma", gam_prior)]
+
+            if "hd" in opt_s:
+                orf = "hd_noauto" if "noauto" in opt_s else "hd"
+            elif "mono" in opt_s:
+                orf = "monopole"
+            elif "dipo" in opt_s:
+                orf = "dipole"
+            else:
+                orf = None
+            out.append(CommonTerm(name, nmodes=nfreqs, psd=psd,
+                                  params=params, orf=orf))
+        return out
+
+    # -------------------- deterministic systematics -------------------- #
+    def bayes_ephem(self, option="default"):
+        """Solar-system-ephemeris error model (reference
+        ``enterprise_models.py:427-432``).
+
+        Basis columns are analytic derivatives of the Roemer delay w.r.t.
+        frame rotation (3), giant-planet masses (4) and Jupiter orbital
+        perturbations (6); coefficients are marginalized under
+        (Gaussianized) physical priors rather than sampled.
+        """
+        psr = self.psr
+        mjd = psr.toas / const.day
+        earth = bary.earth_ssb_position(mjd)          # (n, 3) AU
+        n_hat = np.asarray(psr.pos)
+
+        cols, sig2 = [], []
+        # frame rotation about each equatorial axis: delta r = omega x r,
+        # linear drift amplitude prior ~ uniform(+-1e-9) rad/yr
+        t_yr = (mjd - mjd.mean()) * const.day / const.yr
+        for ax in np.eye(3):
+            dr = np.cross(ax, earth) * t_yr[:, None]
+            cols.append(dr @ n_hat * const.AU_light_s)
+            sig2.append((2e-9) ** 2 / 12.0 * 4)       # var of U(-1e-9,1e-9)
+        # giant planet mass perturbations: delta(Sun barycenter offset)
+        mass_sigma = {0: 1.55e-11, 1: 8.17e-12, 2: 5.8e-11, 3: 7.9e-11}
+        t_cy = (mjd - const.MJD_J2000) / 36525.0
+        for k, elem in enumerate(bary._GIANTS):
+            px, py, pz = bary._planet_helio_eq(elem, t_cy)
+            planet = np.stack([px, py, pz], axis=-1)
+            cols.append(-(planet @ n_hat) * const.AU_light_s)
+            sig2.append(mass_sigma[k] ** 2)
+        # Jupiter orbital element perturbations: numerical partials of the
+        # Jupiter-induced Sun offset w.r.t. its six Kepler elements
+        jup = bary._GIANTS[0]
+        eps_steps = (1e-4, 1e-5, 1e-3, 1e-3, 1e-3, 1e-3)
+        for j, eps in enumerate(eps_steps):
+            pert = list(jup)
+            pert[j if j < 5 else 5] = pert[j if j < 5 else 5] + eps
+            px0, py0, pz0 = bary._planet_helio_eq(jup, t_cy)
+            px1, py1, pz1 = bary._planet_helio_eq(tuple(pert), t_cy)
+            d = (np.stack([px1 - px0, py1 - py0, pz1 - pz0], axis=-1)
+                 / eps / jup[-1])
+            cols.append(-(d @ n_hat) * const.AU_light_s)
+            sig2.append(0.05 ** 2 / 3.0)              # ~U(-0.05, 0.05)
+        F = np.stack(cols, axis=1)
+        # normalize columns; fold scale into the prior variances
+        norms = np.linalg.norm(F, axis=0)
+        norms = np.where(norms > 0, norms, 1.0)
+        return BasisTerm("bayes_ephem", F / norms,
+                         coeff_sigma2=np.asarray(sig2) * norms ** 2)

@@ -1,0 +1,197 @@
+"""Model vocabulary + build pipeline tests on the shipped fixtures.
+
+The by-group white-noise parameter names must match the shipped reference
+noisefile (``/root/reference/examples/example_noisefiles/J1832-0836_noise.json``)
+so noisefile round-trips work unchanged.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                        build_pulsar_likelihood)
+from enterprise_warp_tpu.models.priors import Constant, Uniform
+
+NOISEFILE = ("/root/reference/examples/example_noisefiles/"
+             "J1832-0836_noise.json")
+
+
+@pytest.fixture(scope="module")
+def j1832(ref_data_dir):
+    from enterprise_warp_tpu.io import load_pulsar
+    psr = load_pulsar(str(ref_data_dir / "J1832-0836.par"),
+                      str(ref_data_dir / "J1832-0836.tim"))
+    # fixture residuals: deterministic white noise at the TOA errors
+    rng = np.random.default_rng(7)
+    psr.residuals = psr.toaerrs * rng.standard_normal(len(psr))
+    return psr
+
+
+def default_model_terms(psr, group_selection="by_group"):
+    """The default_noise_example_1 vocabulary: by-backend efac+equad,
+    powerlaw spin noise, powerlaw DM noise."""
+    m = StandardModels(psr=psr)
+    return TermList(psr, [m.efac(group_selection),
+                          m.equad(group_selection),
+                          m.spin_noise("powerlaw"),
+                          m.dm_noise("powerlaw")])
+
+
+class TestVocabulary:
+    def test_noisefile_name_compatibility(self, j1832):
+        terms = default_model_terms(j1832)
+        like = build_pulsar_likelihood(j1832, terms)
+        with open(NOISEFILE) as fh:
+            ref_names = set(json.load(fh))
+        assert set(like.param_names) == ref_names
+
+    def test_param_count_and_order(self, j1832):
+        like = build_pulsar_likelihood(j1832, default_model_terms(j1832))
+        # 4 backends x (efac, equad) + 2 spin + 2 dm = 12
+        assert like.ndim == 12
+        # white noise params first (model order), red noise after
+        assert like.param_names[-4:] == [
+            "J1832-0836_red_noise_log10_A", "J1832-0836_red_noise_gamma",
+            "J1832-0836_dm_gp_log10_A", "J1832-0836_dm_gp_gamma"]
+
+    def test_loglike_finite_and_batch(self, j1832):
+        like = build_pulsar_likelihood(j1832, default_model_terms(j1832))
+        rng = np.random.default_rng(0)
+        thetas = like.sample_prior(rng, 8)
+        single = np.array([float(like.loglike(jnp.asarray(t)))
+                           for t in thetas])
+        batch = np.asarray(like.loglike_batch(jnp.asarray(thetas)))
+        # extreme prior corners may be -inf (non-PD Sigma -> reference
+        # stack's Cholesky-failure convention) but never NaN
+        assert not np.any(np.isnan(single))
+        assert np.sum(np.isfinite(single)) >= 6
+        np.testing.assert_allclose(batch, single, rtol=1e-12)
+
+    def test_fixed_white_noise_from_noisefile(self, j1832):
+        """efac: -1 sentinel + noisefile values == sampling at those
+        values (the reference's fixed-white-noise workflow)."""
+        with open(NOISEFILE) as fh:
+            noise = json.load(fh)
+        m = StandardModels(psr=j1832)
+        m.params.efac = -1.0       # scalar -> Constant sentinel
+        m.params.equad = -1.0
+        terms = TermList(j1832, [m.efac("by_group"), m.equad("by_group"),
+                                 m.spin_noise("powerlaw"),
+                                 m.dm_noise("powerlaw")])
+        like_fixed = build_pulsar_likelihood(j1832, terms,
+                                             fixed_values=noise,
+                                             gram_mode="f64")
+        assert like_fixed.ndim == 4  # only red + dm hyperparams sampled
+
+        like_full = build_pulsar_likelihood(
+            j1832, default_model_terms(j1832), gram_mode="f64")
+        theta_red = np.array([-13.909285117811088, 4.689976425885699,
+                              -12.977197831472266, 2.8821236207177803])
+        # full theta in like_full's order: whites from the noisefile
+        full = np.array([noise[n] for n in like_full.param_names[:8]]
+                        + list(theta_red))
+        a = float(like_fixed.loglike(jnp.asarray(theta_red)))
+        b = float(like_full.loglike(jnp.asarray(full)))
+        assert a == pytest.approx(b, abs=1e-8)
+
+    def test_missing_noisefile_value_raises(self, j1832):
+        m = StandardModels(psr=j1832)
+        m.params.efac = -1.0
+        terms = TermList(j1832, [m.efac("by_group")])
+        with pytest.raises(ValueError, match="sentinel"):
+            build_pulsar_likelihood(j1832, terms)
+
+    def test_chromred_vary_matches_fixed(self, j1832):
+        m = StandardModels(psr=j1832)
+        t_vary = TermList(j1832, [m.efac("by_group"),
+                                  m.chromred("vary")])
+        t_fixed = TermList(j1832, [m.efac("by_group"),
+                                   m.chromred("4")])
+        lv = build_pulsar_likelihood(j1832, t_vary, gram_mode="f64")
+        lf = build_pulsar_likelihood(j1832, t_fixed, gram_mode="f64")
+        assert lv.ndim == lf.ndim + 1
+        assert lv.param_names[-1] == "J1832-0836_chromatic_gp_idx"
+        efacs = np.ones(4)
+        th_f = np.concatenate([efacs, [-13.0, 3.0]])
+        th_v = np.concatenate([efacs, [-13.0, 3.0, 4.0]])
+        a = float(lv.loglike(jnp.asarray(th_v)))
+        b = float(lf.loglike(jnp.asarray(th_f)))
+        assert a == pytest.approx(b, abs=1e-6)
+
+    def test_system_and_band_noise(self, j1832):
+        m = StandardModels(psr=j1832)
+        terms = TermList(j1832, [
+            m.efac("by_group"),
+            m.system_noise(["PDFB_40CM", "CASPSR_40CM"]),
+            m.ppta_band_noise(["10CM"]),
+        ])
+        like = build_pulsar_likelihood(j1832, terms)
+        names = like.param_names
+        assert "J1832-0836_system_noise_PDFB_40CM_log10_A" in names
+        assert "J1832-0836_band_noise_10CM_gamma" in names
+        th = like.sample_prior(np.random.default_rng(1), 1)[0]
+        assert np.isfinite(float(like.loglike(jnp.asarray(th))))
+
+    def test_gwb_single_pulsar_lowering(self, j1832):
+        m = StandardModels(psr=j1832)
+        terms = TermList(j1832, [m.efac("by_group"),
+                                 m.gwb("hd_vary_gamma")])
+        like = build_pulsar_likelihood(j1832, terms)
+        assert "gw_log10_A" in like.param_names
+        assert "gw_gamma" in like.param_names
+        th = like.sample_prior(np.random.default_rng(2), 1)[0]
+        assert np.isfinite(float(like.loglike(jnp.asarray(th))))
+
+    def test_gwb_fixed_gamma_and_freespec(self, j1832):
+        m = StandardModels(psr=j1832)
+        (t1,) = m.gwb("hd_fixed_gamma")
+        assert isinstance(t1.params[1].prior, Constant)
+        assert t1.params[1].prior.value == 4.33
+        (t2,) = m.gwb("freesp_10_nfreqs")
+        assert t2.psd == "free_spectrum"
+        assert len(t2.params) == 10
+        (t3,) = m.gwb("hd_noauto_vary_gamma")
+        assert t3.orf == "hd_noauto"
+
+    def test_ecorr_and_bayes_ephem(self, j1832):
+        m = StandardModels(psr=j1832)
+        terms = TermList(j1832, [m.efac("by_group"), m.ecorr("by_group"),
+                                 m.bayes_ephem()])
+        like = build_pulsar_likelihood(j1832, terms)
+        # bayes_ephem is marginalized: contributes no sampled params
+        assert not any("ephem" in n for n in like.param_names)
+        th = like.sample_prior(np.random.default_rng(3), 1)[0]
+        assert np.isfinite(float(like.loglike(jnp.asarray(th))))
+
+    def test_custom_model_plugin_contract(self, j1832):
+        """Subclass with a new prior key + method, as the reference's
+        examples/custom_models.py does."""
+        from enterprise_warp_tpu.models.priors import Parameter
+        from enterprise_warp_tpu.models.terms import BasisTerm
+
+        class MyModels(StandardModels):
+            def __init__(self, psr=None, params=None):
+                super().__init__(psr=psr, params=params)
+                self.priors.update({"my_lgA": [-18., -10.]})
+                if not hasattr(self.params, "my_lgA"):
+                    self.params.my_lgA = self.priors["my_lgA"]
+
+            def my_powerlaw(self, option="default"):
+                t = self.spin_noise("powerlaw")
+                t.name = "my_powerlaw"
+                t.params = [
+                    Parameter(f"{self.psr.name}_my_powerlaw_log10_A",
+                              Uniform(*self.params.my_lgA)),
+                    t.params[1],
+                ]
+                return t
+
+        m = MyModels(psr=j1832)
+        term = getattr(m, "my_powerlaw")("default")
+        like = build_pulsar_likelihood(
+            j1832, TermList(j1832, [m.efac("by_group"), term]))
+        assert "J1832-0836_my_powerlaw_log10_A" in like.param_names
+        assert "my_lgA:" in m.get_label_attr_map()
