@@ -1,0 +1,18 @@
+"""Configuration: the paramfile DSL + noise-model JSON dispatch.
+
+Replicates the reference's user-facing config surface — the line-oriented
+``key: value`` paramfile with ``{N}`` model sections
+(``/root/reference/enterprise_warp/enterprise_warp.py:90-311``), the noise
+model JSON schema (``:272-311``), PAL2 noisefiles (``:543-557``) and the CLI
+options (``:24-71``) — over typed native parsing (no ``eval``).
+"""
+
+from .paramfile import Params, ModelParams, parse_commandline, \
+    IMPLEMENTED_SAMPLERS
+from .modeldict import read_json_dict, merge_two_noise_model_dicts, \
+    get_noise_dict
+
+__all__ = [
+    "Params", "ModelParams", "parse_commandline", "IMPLEMENTED_SAMPLERS",
+    "read_json_dict", "merge_two_noise_model_dicts", "get_noise_dict",
+]

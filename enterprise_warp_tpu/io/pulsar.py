@@ -124,8 +124,14 @@ class Pulsar:
 
 
 def _backend_flag_values(tim: TimFile) -> np.ndarray:
-    """Backend label per TOA: '-f' flag, else '-be', else '-g', else site."""
-    for flag in ("f", "be", "g", "group", "sys"):
+    """Backend label per TOA for the ``by_backend`` selection.
+
+    Preference order: '-group' (the PPTA per-system convention the
+    reference's shipped noisefiles use), then '-f', '-be', '-sys', else the
+    observatory code. The flag conventions enumerated at
+    ``/root/reference/enterprise_warp/libstempo_warp.py:60-75``.
+    """
+    for flag in ("group", "f", "be", "sys", "g"):
         vals = tim.flags.get(flag)
         if vals is not None and all(str(v) for v in vals):
             return vals

@@ -1,0 +1,147 @@
+"""Config-layer tests against the shipped reference paramfiles.
+
+All five paramfiles in ``/root/reference/examples/example_params/`` must
+parse, and the dynesty single-model config must assemble into a compiled
+likelihood end-to-end (the reference workflow of SURVEY.md §3.1).
+"""
+
+import os
+import types
+
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.config import Params, IMPLEMENTED_SAMPLERS
+from enterprise_warp_tpu.config.modeldict import (
+    get_noise_dict, merge_two_noise_model_dicts, parse_extra_model_terms)
+from enterprise_warp_tpu.models.assemble import init_model_likelihoods
+
+EXAMPLES = "/root/reference/examples"
+PARAMS = f"{EXAMPLES}/example_params"
+
+
+def make_opts(**kw):
+    base = dict(num=0, drop=0, clearcache=0, mpi_regime=0,
+                wipe_old_output=0, extra_model_terms=None)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+@pytest.fixture
+def in_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+class TestParamfileParsing:
+    def test_all_shipped_paramfiles_parse(self, in_tmp):
+        for name in os.listdir(PARAMS):
+            p = Params(os.path.join(PARAMS, name), opts=make_opts(),
+                       init_pulsars=False)
+            assert p.models, name
+            assert p.sampler in IMPLEMENTED_SAMPLERS, name
+
+    def test_dynesty_config(self, in_tmp):
+        p = Params(f"{PARAMS}/default_model_dynesty.dat", opts=make_opts(),
+                   init_pulsars=False)
+        assert p.sampler == "dynesty"
+        assert p.sampler_kwargs["nlive"] == 800
+        assert p.sampler_kwargs["dlogz"] == 0.1
+        assert p.models[0].model_name == "examp_1"
+        assert p.label_models == "examp_1"
+
+    def test_hypermodel_two_sections(self, in_tmp):
+        p = Params(f"{PARAMS}/default_hypermodel.dat", opts=make_opts(),
+                   init_pulsars=False)
+        assert sorted(p.models) == [0, 1]
+        assert p.models[0].model_name == "examp_1"
+        assert p.models[1].model_name == "examp_2"
+        assert p.label_models == "examp_1_examp_2"
+        assert p.SCAMweight == 30 and p.AMweight == 15 and p.DEweight == 50
+
+    def test_priors_default_from_model_object(self, in_tmp):
+        p = Params(f"{PARAMS}/default_model_dynesty.dat", opts=make_opts(),
+                   init_pulsars=False)
+        assert p.efac == [0., 10.]
+        assert p.gwb_lgA_prior == "uniform"
+        assert p.red_general_freqs == "tobs_60days"
+
+    def test_fixed_white_noise_sentinel(self, in_tmp):
+        p = Params(f"{PARAMS}/fixed_white_noise.dat", opts=make_opts(),
+                   init_pulsars=False)
+        assert p.efac == -1
+        assert p.equad == -1
+        assert p.noisefiles.endswith("example_noisefiles/")
+
+    def test_unknown_sampler_raises(self, in_tmp, tmp_path):
+        bad = tmp_path / "bad.dat"
+        bad.write_text("datadir: data/\nsampler: not_a_sampler\n"
+                       "{0}\nnoise_model_file: x.json\n")
+        with pytest.raises(ValueError, match="Known samplers"):
+            Params(str(bad), opts=make_opts(), init_pulsars=False)
+
+    def test_cli_override_mutates_label(self, in_tmp):
+        opts = make_opts(noise_model_file=None)  # None -> no override
+        p = Params(f"{PARAMS}/default_model_dynesty.dat", opts=opts,
+                   init_pulsars=False)
+        assert "noise_model_file" not in p.label
+
+
+class TestModeldict:
+    def test_merge_extra_terms(self):
+        base = {"J1832-0836": {"efac": "by_backend"}}
+        extra = parse_extra_model_terms(
+            "{'J1832-0836': {'system_noise': ['PDFB_40CM']}, "
+            "'J0437-4715': {'efac': 'by_backend'}}")
+        merged = merge_two_noise_model_dicts(base, extra)
+        assert merged["J1832-0836"]["system_noise"] == ["PDFB_40CM"]
+        assert merged["J1832-0836"]["efac"] == "by_backend"
+        assert "J0437-4715" in merged
+
+    def test_extra_terms_rejects_code(self):
+        with pytest.raises(ValueError):
+            parse_extra_model_terms("__import__('os').system('true')")
+
+    def test_noise_dict_alias_normalization(self, tmp_path):
+        import json
+        d = {"J0000+0000_b1_efac": 1.1,
+             "J0000+0000_b1_log10_tnequad": -7.5}
+        (tmp_path / "J0000+0000_noise.json").write_text(json.dumps(d))
+        out = get_noise_dict(["J0000+0000"], str(tmp_path))
+        assert out["J0000+0000_b1_log10_equad"] == -7.5
+
+
+class TestEndToEnd:
+    def test_dynesty_assembles_compiled_likelihood(self, in_tmp):
+        opts = make_opts(num=0)
+        p = Params(f"{PARAMS}/default_model_dynesty.dat", opts=opts)
+        assert len(p.psrs) == 1
+        assert p.psrs[0].name == "J1832-0836"   # sorted par order
+        likes = init_model_likelihoods(p)
+        like = likes[0]
+        # default_noise_example_1: by-backend efac+equad + spin + dm
+        assert like.ndim == 12
+        th = np.array([1.0, 1.1, 0.9, 1.2, -7.0, -6.5, -7.5, -6.8,
+                       -13.5, 3.0, -13.0, 2.5])
+        import jax.numpy as jnp
+        assert np.isfinite(float(like.loglike(jnp.asarray(th))))
+        # output contract: directory + pars.txt
+        assert os.path.isdir(p.output_dir)
+        pars = open(os.path.join(p.output_dir, "pars.txt")).read().split()
+        assert pars == like.param_names
+        assert p.output_dir.endswith("examp_1_v1/0_J1832-0836/")
+
+    def test_num_selects_fake_pulsar(self, in_tmp):
+        opts = make_opts(num=1)
+        p = Params(f"{PARAMS}/default_model_dynesty.dat", opts=opts)
+        assert p.psrs[0].name == "J0711-0000"
+        assert "1_J0711-0000" in p.output_dir
+
+    def test_fixed_white_noise_end_to_end(self, in_tmp):
+        opts = make_opts(num=0)
+        p = Params(f"{PARAMS}/fixed_white_noise.dat", opts=opts)
+        likes = init_model_likelihoods(p)
+        # whites fixed from noisefile: model 0 leaves only spin+dm hypers
+        assert likes[0].ndim == 4
+        # model 1 (examp_2): spin turnover adds fc -> 5
+        assert likes[1].ndim == 5
