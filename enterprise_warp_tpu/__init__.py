@@ -28,4 +28,19 @@ Subpackages
 
 __version__ = "0.1.0"
 
+import os as _os
+
+import jax as _jax
+
+if _os.environ.get("EWT_NO_X64", "") != "1":
+    # The likelihood design requires float64 semantics for the small solves
+    # (the big TOA-axis contractions still run in f32 via gram_mode='split');
+    # enable x64 before any jax.numpy use. Opt out with EWT_NO_X64=1.
+    _jax.config.update("jax_enable_x64", True)
+
+if _os.environ.get("EWT_PLATFORM"):
+    # The axon TPU plugin ignores JAX_PLATFORMS; honor an explicit platform
+    # choice in-process (e.g. EWT_PLATFORM=cpu for host-only runs).
+    _jax.config.update("jax_platforms", _os.environ["EWT_PLATFORM"])
+
 from . import constants  # noqa: F401

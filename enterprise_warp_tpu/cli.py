@@ -1,0 +1,97 @@
+"""Run CLI: the equivalent of the reference's de-facto entry point
+``examples/run_example_paramfile.py`` plus its sampler-branch logic:
+
+- ``ptmcmcsampler`` + one model  -> native adaptive PT-MCMC;
+- ``ptmcmcsampler`` + >=2 models -> product-space hypermodel PT-MCMC
+  (enterprise_extensions HyperModel equivalent);
+- any nested sampler name        -> native JAX nested sampling (Bilby
+  branch equivalent, Bilby-style result JSON).
+
+Outputs follow the reference directory contract so
+``python -m enterprise_warp_tpu.results`` post-processes them unchanged.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import sys
+
+from .config import Params
+from .models.assemble import init_model_likelihoods
+from .samplers import HyperModelLikelihood, run_nested, run_ptmcmc
+
+
+def import_custom_models(py_path: str, class_name: str):
+    """Dynamic import of a user model file (results-CLI contract,
+    ``/root/reference/enterprise_warp/results.py:1048-1054``)."""
+    spec = importlib.util.spec_from_file_location("custom_models_module",
+                                                  py_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return getattr(mod, class_name)
+
+
+def main(argv=None):
+    import argparse
+    # the reference option set (config.parse_commandline) extended with the
+    # custom-models hook and the precision mode
+    parser = argparse.ArgumentParser(description="enterprise_warp_tpu run")
+    parser.add_argument("-n", "--num", type=int, default=0)
+    parser.add_argument("-p", "--prfile", type=str, required=True)
+    parser.add_argument("-d", "--drop", type=int, default=0)
+    parser.add_argument("-c", "--clearcache", type=int, default=0)
+    parser.add_argument("-m", "--mpi_regime", type=int, default=0)
+    parser.add_argument("-w", "--wipe_old_output", type=int, default=0)
+    parser.add_argument("-x", "--extra_model_terms", type=str,
+                        default=None)
+    parser.add_argument("--custom_models_py", type=str, default=None)
+    parser.add_argument("--custom_models", type=str, default=None)
+    parser.add_argument("--gram_mode", type=str, default="split",
+                        choices=("split", "f32", "f64"))
+    opts = parser.parse_args(argv)
+
+    custom = None
+    if opts.custom_models_py and opts.custom_models:
+        custom = import_custom_models(opts.custom_models_py,
+                                      opts.custom_models)
+
+    params = Params(opts.prfile, opts=opts, custom_models_obj=custom)
+    likes = init_model_likelihoods(params, gram_mode=opts.gram_mode)
+
+    if params.setupsamp or opts.mpi_regime == 1:
+        print("Preparations for the sampling are complete "
+              "(setup-only mode)")
+        return 0
+
+    resume = not bool(opts.wipe_old_output)
+    first_id = min(likes)
+    if params.sampler == "ptmcmcsampler":
+        like = (HyperModelLikelihood(likes) if len(likes) >= 2
+                else likes[first_id])
+        nsamp = int(getattr(params, "nsamp",
+                            params.sampler_kwargs.get("nsamp", 1000000)))
+        run_ptmcmc(like, params.output_dir, nsamp,
+                   params=params, resume=resume)
+    elif params.sampler in ("emcee", "ptemcee"):
+        like = (HyperModelLikelihood(likes) if len(likes) >= 2
+                else likes[first_id])
+        kw = params.sampler_kwargs
+        run_ptmcmc(like, params.output_dir, int(kw.get("nsteps", 10000)),
+                   params=params, resume=resume,
+                   ntemps=int(kw.get("ntemps", 1)),
+                   nchains=int(kw.get("nwalkers", 64)))
+    else:
+        like = likes[first_id]
+        if len(likes) > 1:
+            print(f"note: nested sampling uses model {first_id}; run "
+                  "per-model for evidences (reference Bilby branch "
+                  "behavior)")
+        kw = params.sampler_kwargs
+        run_nested(like, outdir=params.output_dir, label=params.label,
+                   nlive=int(kw.get("nlive", 500)),
+                   dlogz=float(kw.get("dlogz", 0.1)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

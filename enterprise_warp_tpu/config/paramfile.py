@@ -163,6 +163,8 @@ class Params:
 
         if not self.models:
             self.create_model(0)
+        if "out" not in self.__dict__:
+            self.out = "out/"
         self.label = os.path.basename(os.path.normpath(self.out))
         self.override_params_using_opts()
         self.set_default_params()
@@ -229,7 +231,6 @@ class Params:
         d.setdefault("overwrite", "False")
         d.setdefault("array_analysis", "False")
         d.setdefault("sampler", "ptmcmcsampler")
-        d.setdefault("out", "out/")
         d.setdefault("paramfile_label",
                      os.path.splitext(
                          os.path.basename(self.input_file_name))[0])
@@ -300,9 +301,11 @@ class Params:
             with open(datadir, "rb") as fh:
                 pkl = pickle.load(fh)
             pairs = [(p.name, p) for p in pkl]
-        elif datadir.endswith(".npz") or \
-                (os.path.isdir(datadir)
-                 and glob_nonempty(datadir, "*.psr.npz")):
+        elif datadir.endswith(".npz"):
+            psr = Pulsar.load_npz(datadir)
+            pairs = [(psr.name, psr)]
+        elif os.path.isdir(datadir) and glob_nonempty(datadir,
+                                                      "*.psr.npz"):
             import glob as _glob
             files = sorted(_glob.glob(os.path.join(datadir, "*.psr.npz")))
             loaded = [Pulsar.load_npz(f) for f in files]

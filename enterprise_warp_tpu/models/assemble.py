@@ -20,10 +20,11 @@ import numpy as np
 
 from ..config.modeldict import get_noise_dict
 from .build import build_pulsar_likelihood
+from .prior_mixin import PriorMixin
 from .terms import CommonTerm, TermList
 
 
-class MultiPulsarLikelihood:
+class MultiPulsarLikelihood(PriorMixin):
     """Sum of per-pulsar likelihoods with a shared global parameter vector.
 
     Handles uncorrelated models and common-spectrum (no-ORF) signals: the
@@ -57,23 +58,6 @@ class MultiPulsarLikelihood:
         self.loglike = jax.jit(loglike)
         self.loglike_batch = jax.jit(jax.vmap(loglike))
 
-    def log_prior(self, theta):
-        theta = jnp.atleast_1d(theta)
-        out = 0.0
-        for i, p in enumerate(self.params):
-            out = out + p.prior.logpdf(theta[..., i])
-        return out
-
-    def from_unit(self, u):
-        cols = [p.prior.from_unit(u[..., i])
-                for i, p in enumerate(self.params)]
-        return jnp.stack(cols, axis=-1)
-
-    def sample_prior(self, rng, n=1):
-        out = np.empty((n, self.ndim))
-        for i, p in enumerate(self.params):
-            out[:, i] = [p.prior.sample(rng) for _ in range(n)]
-        return out
 
 
 def build_terms_for_model(params_model, psrs, noise_model_obj):

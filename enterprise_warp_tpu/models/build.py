@@ -22,6 +22,7 @@ from ..ops import quantization_matrix
 from ..ops.kernel import marginalized_loglike, whiten_inputs
 from ..ops.spectra import (broken_powerlaw_psd, free_spectrum_psd,
                            powerlaw_psd)
+from .prior_mixin import PriorMixin
 from .priors import Constant, Parameter
 from .terms import BasisTerm, CommonTerm, TermList, WhiteTerm
 
@@ -54,7 +55,7 @@ class _BasisBlock:
     col_slice: slice = None
 
 
-class PulsarLikelihood:
+class PulsarLikelihood(PriorMixin):
     """Compiled single-pulsar likelihood.
 
     Attributes
@@ -76,24 +77,6 @@ class PulsarLikelihood:
         self.loglike = jax.jit(loglike_fn)
         self.loglike_batch = jax.jit(jax.vmap(loglike_fn))
 
-    def log_prior(self, theta):
-        theta = jnp.atleast_1d(theta)
-        out = 0.0
-        for i, p in enumerate(self.params):
-            out = out + p.prior.logpdf(theta[..., i])
-        return out
-
-    def from_unit(self, u):
-        """Unit-cube transform across all sampled parameters."""
-        cols = [p.prior.from_unit(u[..., i])
-                for i, p in enumerate(self.params)]
-        return jnp.stack(cols, axis=-1)
-
-    def sample_prior(self, rng, n=1):
-        out = np.empty((n, self.ndim))
-        for i, p in enumerate(self.params):
-            out[:, i] = [p.prior.sample(rng) for _ in range(n)]
-        return out
 
 
 def _resolve_params(all_params, fixed_values):

@@ -1,0 +1,34 @@
+"""Shared prior interface for compiled likelihood objects.
+
+Every likelihood container (single-pulsar, multi-pulsar, joint PTA,
+hypermodel) exposes the same prior operations over its ``params`` list;
+this mixin is the single implementation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class PriorMixin:
+    """Requires ``self.params`` (list of Parameter with priors)."""
+
+    def log_prior(self, theta):
+        theta = jnp.atleast_1d(theta)
+        out = 0.0
+        for i, p in enumerate(self.params):
+            out = out + p.prior.logpdf(theta[..., i])
+        return out
+
+    def from_unit(self, u):
+        """Unit-cube transform across all sampled parameters."""
+        cols = [p.prior.from_unit(u[..., i])
+                for i, p in enumerate(self.params)]
+        return jnp.stack(cols, axis=-1)
+
+    def sample_prior(self, rng, n=1):
+        out = np.empty((n, len(self.params)))
+        for i, p in enumerate(self.params):
+            out[:, i] = [p.prior.sample(rng) for _ in range(n)]
+        return out

@@ -1,0 +1,20 @@
+"""Native samplers: adaptive PT-MCMC, nested sampling, product-space models.
+
+Replaces the external sampler stack the reference drives through Bilby /
+PTMCMCSampler / enterprise_extensions
+(``/root/reference/enterprise_warp/bilby_warp.py``,
+``examples/run_example_paramfile.py:25-57``) with JAX kernels that evaluate
+the likelihood in ``vmap``-batched blocks on device — the single biggest
+speedup lever over the reference's one-theta-per-step Python callback.
+
+On-disk outputs keep the reference contract (``chain_1.txt`` with four
+trailing PTMCMC columns, ``pars.txt``, ``cov.npy``, Bilby-style result JSON)
+so the results layer is sampler-agnostic.
+"""
+
+from .ptmcmc import PTSampler, run_ptmcmc
+from .nested import run_nested
+from .hypermodel import HyperModelLikelihood
+
+__all__ = ["PTSampler", "run_ptmcmc", "run_nested",
+           "HyperModelLikelihood"]

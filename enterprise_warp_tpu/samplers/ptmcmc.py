@@ -1,0 +1,392 @@
+"""Adaptive parallel-tempering MCMC with vmapped walkers.
+
+Native replacement for PTMCMCSampler as driven by the reference
+(``examples/run_example_paramfile.py:25-30``; jump-mix weights
+``SCAMweight/AMweight/DEweight`` from the paramfile,
+``enterprise_warp.py:117-119``). The three classic jump families are kept —
+
+- SCAM: single-component adaptive metropolis along one covariance
+  eigendirection,
+- AM: full adaptive-metropolis jump from the empirical covariance,
+- DE: differential evolution using a history ring buffer —
+
+but the execution model is inverted for TPU: W walkers (ntemps x nchains)
+advance *simultaneously*, each step evaluating the likelihood once for all
+walkers through one ``vmap``-batched jit'd call, and K steps run inside one
+``lax.scan`` block on device. Covariance/eigen adaptation happens on host
+between blocks (every ``covUpdate`` steps), exactly where PTMCMCSampler
+adapts too.
+
+On-disk contract matches PTMCMCSampler: ``chain_1.txt`` rows are
+``[theta..., lnpost, lnlike, accept_rate, pt_accept_rate]`` (the 4 trailing
+columns the results layer strips, ``results.py:479-480``), ``cov.npy`` holds
+the jump covariance, and an explicit ``state.npz`` checkpoint (positions,
+RNG key, adaptation state) provides resume — the failure-recovery mechanism
+the reference delegates to sampler internals (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HISTORY = 1000     # DE history ring length (per walker)
+
+
+@dataclass
+class PTState:
+    x: np.ndarray          # (W, ndim) positions
+    lnl: np.ndarray        # (W,)
+    lnp: np.ndarray        # (W,)
+    key: np.ndarray        # PRNG key
+    cov: np.ndarray        # (ndim, ndim) adapted jump covariance
+    history: np.ndarray    # (_HISTORY, ndim) DE buffer (cold walkers)
+    hist_len: int
+    step: int
+    accepted: np.ndarray   # (W,) cumulative acceptances
+    swaps_accepted: int
+    swaps_proposed: int
+
+
+def _temperature_ladder(ntemps, tmax=None):
+    if ntemps == 1:
+        return np.ones(1)
+    c = (tmax ** (1.0 / (ntemps - 1))) if tmax else 1.7
+    return c ** np.arange(ntemps)
+
+
+class PTSampler:
+    """Adaptive PT-MCMC over a compiled likelihood object.
+
+    ``like`` provides ``loglike_batch``, ``log_prior``, ``sample_prior``,
+    ``params``/``param_names``/``ndim`` (a :class:`PulsarLikelihood`,
+    :class:`MultiPulsarLikelihood`, joint PTA likelihood, or
+    :class:`HyperModelLikelihood`).
+    """
+
+    def __init__(self, like, outdir, ntemps=2, nchains=8, seed=0,
+                 scam_weight=30, am_weight=15, de_weight=50,
+                 cov_update=1000, swap_every=10, tmax=None,
+                 init_cov=None, burn=0):
+        self.like = like
+        self.outdir = outdir
+        self.ntemps = ntemps
+        self.nchains = nchains
+        self.W = ntemps * nchains
+        self.ndim = like.ndim
+        weights = np.array([scam_weight, am_weight, de_weight], float)
+        self.jump_probs = weights / weights.sum()
+        self.cov_update = cov_update
+        self.swap_every = swap_every
+        self.burn = burn     # steps before covariance adaptation engages
+        self.seed = seed
+        # temperature per walker: chains-major layout [T0 chains..., T1...]
+        self.temps = np.repeat(_temperature_ladder(ntemps, tmax), nchains)
+        self.init_cov = init_cov
+        self._lnprior_batch = jax.jit(jax.vmap(
+            lambda t: like.log_prior(t)))
+        self._compiled_block = None
+        self._block_steps = -1
+        os.makedirs(outdir, exist_ok=True)
+
+    # ---------------- initialization / resume -------------------------- #
+    def _fresh_state(self):
+        rng = np.random.default_rng(self.seed)
+        x0 = self.like.sample_prior(rng, self.W)
+        lnl = np.asarray(self.like.loglike_batch(jnp.asarray(x0)))
+        # re-draw any walker that landed on a non-finite corner
+        for _ in range(20):
+            bad = ~np.isfinite(lnl)
+            if not bad.any():
+                break
+            x0[bad] = self.like.sample_prior(rng, int(bad.sum()))
+            lnl = np.asarray(self.like.loglike_batch(jnp.asarray(x0)))
+        lnp = np.asarray(self._lnprior_batch(jnp.asarray(x0)))
+        cov = self.init_cov if self.init_cov is not None else \
+            np.diag(self._prior_scales() ** 2 * 0.01)
+        history = np.tile(x0[:1], (_HISTORY, 1))
+        return PTState(x=x0, lnl=lnl, lnp=lnp,
+                       key=np.asarray(jax.random.PRNGKey(self.seed)),
+                       cov=cov, history=history, hist_len=1, step=0,
+                       accepted=np.zeros(self.W), swaps_accepted=0,
+                       swaps_proposed=0)
+
+    def _prior_scales(self):
+        scales = np.ones(self.ndim)
+        for i, p in enumerate(self.like.params):
+            pr = p.prior
+            if hasattr(pr, "lo"):
+                scales[i] = (pr.hi - pr.lo)
+            elif hasattr(pr, "sigma"):
+                scales[i] = pr.sigma
+        return scales
+
+    @property
+    def _ckpt_path(self):
+        return os.path.join(self.outdir, "state.npz")
+
+    def _save_state(self, st: PTState):
+        np.savez(self._ckpt_path, x=st.x, lnl=st.lnl, lnp=st.lnp,
+                 key=st.key, cov=st.cov, history=st.history,
+                 hist_len=st.hist_len, step=st.step,
+                 accepted=st.accepted, swaps_accepted=st.swaps_accepted,
+                 swaps_proposed=st.swaps_proposed)
+
+    def _load_state(self):
+        z = np.load(self._ckpt_path)
+        return PTState(x=z["x"], lnl=z["lnl"], lnp=z["lnp"], key=z["key"],
+                       cov=z["cov"], history=z["history"],
+                       hist_len=int(z["hist_len"]), step=int(z["step"]),
+                       accepted=z["accepted"],
+                       swaps_accepted=int(z["swaps_accepted"]),
+                       swaps_proposed=int(z["swaps_proposed"]))
+
+    # ---------------- the jitted block --------------------------------- #
+    def _make_block(self, nsteps):
+        like = self.like
+        temps = jnp.asarray(self.temps)
+        jump_p = jnp.asarray(self.jump_probs)
+        W, nd = self.W, self.ndim
+        ntemps, nchains = self.ntemps, self.nchains
+        swap_every = self.swap_every
+
+        def one_step(carry, step_idx):
+            x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop, \
+                eigvecs, eigvals, chol = carry
+            key, k1, k2, k3, k4, k5, k6 = jax.random.split(key, 7)
+
+            # --- proposals (all three families, select per walker) ----
+            z = jax.random.normal(k1, (W, nd))
+            # AM: full covariance jump
+            am = x + (z @ chol.T) * (2.38 / jnp.sqrt(nd))
+            # SCAM: one random eigendirection per walker
+            j = jax.random.randint(k2, (W,), 0, nd)
+            scam_dir = eigvecs[:, j].T                    # (W, nd)
+            scam = x + scam_dir * (
+                jnp.sqrt(eigvals[j])[:, None] * 2.38
+                * jax.random.normal(k3, (W, 1)))
+            # DE: difference of two random history entries
+            ia = jax.random.randint(k4, (W,), 0, hist_len)
+            ib = jax.random.randint(k5, (W,), 0, hist_len)
+            gamma_de = 2.38 / jnp.sqrt(2 * nd)
+            de = x + gamma_de * (hist[ia] - hist[ib])
+
+            u = jax.random.uniform(k6, (W,))
+            choice = jnp.searchsorted(jnp.cumsum(jump_p), u)
+            prop = jnp.where((choice == 0)[:, None], scam,
+                             jnp.where((choice == 1)[:, None], am, de))
+
+            key, ka = jax.random.split(key)
+            lnp_new = like.log_prior(prop)
+            lnl_new = like.loglike_batch(prop)
+            lnl_new = jnp.where(jnp.isneginf(lnp_new), -jnp.inf, lnl_new)
+            log_ratio = (lnp_new - lnp) + (lnl_new - lnl) / temps
+            accept = jnp.log(jax.random.uniform(ka, (W,))) < log_ratio
+            x = jnp.where(accept[:, None], prop, x)
+            lnl = jnp.where(accept, lnl_new, lnl)
+            lnp = jnp.where(accept, lnp_new, lnp)
+            acc = acc + accept
+
+            # --- parallel-tempering swaps every swap_every steps ------
+            def do_swap(args):
+                x, lnl, lnp, key, sacc, sprop = args
+                key, ks = jax.random.split(key)
+                xt = x.reshape(ntemps, nchains, nd)
+                lt = lnl.reshape(ntemps, nchains)
+                pt = lnp.reshape(ntemps, nchains)
+                tl = temps.reshape(ntemps, nchains)
+                usw = jax.random.uniform(ks, (ntemps - 1, nchains))
+
+                def swap_pair(i, args):
+                    xt, lt, pt, sacc, sprop = args
+                    # swap between rung i and i+1
+                    beta_diff = 1.0 / tl[i] - 1.0 / tl[i + 1]
+                    log_r = beta_diff * (lt[i + 1] - lt[i])
+                    sw = jnp.log(usw[i]) < log_r
+                    xi = jnp.where(sw[:, None], xt[i + 1], xt[i])
+                    xj = jnp.where(sw[:, None], xt[i], xt[i + 1])
+                    li = jnp.where(sw, lt[i + 1], lt[i])
+                    lj = jnp.where(sw, lt[i], lt[i + 1])
+                    pi = jnp.where(sw, pt[i + 1], pt[i])
+                    pj = jnp.where(sw, pt[i], pt[i + 1])
+                    xt = xt.at[i].set(xi).at[i + 1].set(xj)
+                    lt = lt.at[i].set(li).at[i + 1].set(lj)
+                    pt = pt.at[i].set(pi).at[i + 1].set(pj)
+                    return xt, lt, pt, sacc + jnp.sum(sw), \
+                        sprop + nchains
+
+                xt, lt, pt, sacc, sprop = jax.lax.fori_loop(
+                    0, ntemps - 1, swap_pair, (xt, lt, pt, sacc, sprop))
+                return (xt.reshape(W, nd), lt.reshape(W),
+                        pt.reshape(W), key, sacc, sprop)
+
+            if ntemps > 1:
+                x, lnl, lnp, key, sacc, sprop = jax.lax.cond(
+                    (step_idx % swap_every) == swap_every - 1,
+                    do_swap, lambda a: a, (x, lnl, lnp, key, sacc, sprop))
+
+            # --- DE history ring: store one cold walker per step ------
+            slot = (hist_len + step_idx) % _HISTORY
+            pick = step_idx % nchains
+            hist = hist.at[slot].set(x[pick])
+
+            cold = x[:nchains]
+            cold_lnl = lnl[:nchains]
+            cold_lnp = lnp[:nchains]
+            return ((x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
+                     eigvecs, eigvals, chol),
+                    (cold, cold_lnl, cold_lnp))
+
+        @partial(jax.jit, static_argnames=())
+        def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
+                  eigvecs, eigvals, chol):
+            carry = (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
+                     eigvecs, eigvals, chol)
+            carry, (cs, cl, cp) = jax.lax.scan(
+                one_step, carry, jnp.arange(nsteps))
+            return carry, cs, cl, cp
+
+        return block
+
+    # ---------------- public API --------------------------------------- #
+    def sample(self, nsamp, resume=True, verbose=True, thin=1,
+               block_size=None):
+        """Run ``nsamp`` total steps, writing the cold chains to
+        ``chain_1.txt`` (reference format) every block."""
+        block_size = block_size or self.cov_update
+        if resume and os.path.exists(self._ckpt_path):
+            st = self._load_state()
+            if verbose:
+                print(f"resuming from step {st.step}")
+        else:
+            st = self._fresh_state()
+            # fresh run: truncate chain file
+            open(os.path.join(self.outdir, "chain_1.txt"), "w").close()
+
+        chain_path = os.path.join(self.outdir, "chain_1.txt")
+        np.savetxt(os.path.join(self.outdir, "pars.txt"),
+                   self.like.param_names, fmt="%s")
+
+        while st.step < nsamp:
+            todo = int(min(block_size, nsamp - st.step))
+            if self._compiled_block is None or \
+                    self._block_steps != todo:
+                self._block = self._make_block(todo)
+                self._block_steps = todo
+                self._compiled_block = True
+
+            # eigendecomposition of the adapted covariance (host side)
+            cov = st.cov + 1e-12 * np.eye(self.ndim)
+            eigvals, eigvecs = np.linalg.eigh(cov)
+            eigvals = np.maximum(eigvals, 1e-16)
+            chol = np.linalg.cholesky(cov)
+
+            carry, cold, cold_lnl, cold_lnp = self._block(
+                jnp.asarray(st.x), jnp.asarray(st.lnl),
+                jnp.asarray(st.lnp), jnp.asarray(st.key),
+                jnp.asarray(st.history), st.hist_len,
+                jnp.asarray(st.accepted), st.swaps_accepted,
+                st.swaps_proposed, jnp.asarray(eigvecs),
+                jnp.asarray(eigvals), jnp.asarray(chol))
+            (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
+             *_unused) = carry
+            st.x = np.asarray(x)
+            st.lnl = np.asarray(lnl)
+            st.lnp = np.asarray(lnp)
+            st.key = np.asarray(key)
+            st.history = np.asarray(hist)
+            st.hist_len = int(min(st.hist_len + todo, _HISTORY))
+            st.accepted = np.asarray(acc)
+            st.swaps_accepted = int(sacc)
+            st.swaps_proposed = int(sprop)
+            st.step += todo
+
+            # --- write cold chains (interleaved walkers) -------------- #
+            cs = np.asarray(cold)[::thin]          # (steps, nchains, nd)
+            cl = np.asarray(cold_lnl)[::thin]
+            cp = np.asarray(cold_lnp)[::thin]
+            acc_rate = float(np.mean(st.accepted[:self.nchains])
+                             / max(st.step, 1))
+            swap_rate = (st.swaps_accepted / st.swaps_proposed
+                         if st.swaps_proposed else 0.0)
+            rows = np.concatenate([
+                cs.reshape(-1, self.ndim),
+                (cp + cl).reshape(-1, 1),
+                cl.reshape(-1, 1),
+                np.full((cs.shape[0] * self.nchains, 1), acc_rate),
+                np.full((cs.shape[0] * self.nchains, 1), swap_rate),
+            ], axis=1)
+            with open(chain_path, "ab") as fh:
+                np.savetxt(fh, rows)
+
+            # --- adapt covariance from recent cold samples ------------ #
+            flat = cs.reshape(-1, self.ndim)
+            if flat.shape[0] > 10 and st.step > self.burn:
+                new_cov = np.cov(flat.T)
+                if self.ndim == 1:
+                    new_cov = new_cov.reshape(1, 1)
+                w = min(0.5, flat.shape[0] / max(st.step, 1))
+                st.cov = (1 - w) * st.cov + w * new_cov
+            np.save(os.path.join(self.outdir, "cov.npy"), st.cov)
+            self._save_state(st)
+            if verbose:
+                print(f"step {st.step}/{nsamp} acc={acc_rate:.3f} "
+                      f"swap={swap_rate:.3f} "
+                      f"maxlnl={np.max(st.lnl):.2f}")
+        return st
+
+    def __init_subclass__(cls):
+        pass
+
+
+def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
+               verbose=True, **kw):
+    """Convenience entry honoring the paramfile's jump weights."""
+    opts = dict(seed=seed)
+    thin = 1
+    if params is not None:
+        opts.update(
+            scam_weight=getattr(params, "SCAMweight", 30),
+            am_weight=getattr(params, "AMweight", 15),
+            de_weight=getattr(params, "DEweight", 50),
+            cov_update=getattr(params, "covUpdate", 1000) or 1000,
+        )
+        skw = getattr(params, "sampler_kwargs", {})
+        thin = int(getattr(params, "thin", skw.get("thin", 1)) or 1)
+        opts["burn"] = int(getattr(params, "burn",
+                                   skw.get("burn", 0)) or 0)
+        if getattr(params, "mcmc_covm", None) is not None:
+            cov = _covm_from_csv(params.mcmc_covm, like.param_names)
+            if cov is not None:
+                opts["init_cov"] = cov
+        ntemps = params.sampler_kwargs.get("ntemps", 2) \
+            if hasattr(params, "sampler_kwargs") else 2
+        opts["ntemps"] = max(int(ntemps), 1)
+    opts.update(kw)
+    sampler = PTSampler(like, outdir, **opts)
+    sampler.sample(nsamp, resume=resume, verbose=verbose, thin=thin)
+    return sampler
+
+
+def _covm_from_csv(covm_df, param_names):
+    """Extract an initial jump covariance for the given parameters from a
+    results-layer block-diagonal covariance CSV (reference
+    ``enterprise_warp.py:252-256``/``results.py:517-557``)."""
+    try:
+        have = [n for n in param_names if n in covm_df.columns]
+        if not have:
+            return None
+        sub = covm_df.loc[have, have].to_numpy()
+        full = np.diag(np.ones(len(param_names)))
+        idx = [param_names.index(n) for n in have]
+        for a, ia in enumerate(idx):
+            for b, ib in enumerate(idx):
+                full[ia, ib] = sub[a, b]
+        return full
+    except Exception:
+        return None

@@ -1,0 +1,14 @@
+"""Simulation: noise injection and synthetic-dataset generation.
+
+Native replacement for the reference's libstempo bridge
+(``/root/reference/enterprise_warp/libstempo_warp.py``): white noise per
+backend, red/DM/chromatic Fourier-series injection from PSD priors, and
+whole fake-PTA generation. Injection uses the *same* design matrices as the
+likelihood, guaranteeing round-trip consistency (SURVEY.md §2.2).
+"""
+
+from .noise import (add_noise, inject_white, inject_basis_process,
+                    red_psd, dm_psd, make_fake_pulsar, make_fake_pta)
+
+__all__ = ["add_noise", "inject_white", "inject_basis_process",
+           "red_psd", "dm_psd", "make_fake_pulsar", "make_fake_pta"]

@@ -1,0 +1,196 @@
+"""Noise injection and fake-dataset generation.
+
+Replaces ``libstempo_warp`` (``/root/reference/enterprise_warp/
+libstempo_warp.py``): PSD formulas (``red_psd`` ``:6-8``, ``dm_psd``
+``:14-15``), the PAL2-noise-dict-driven ``add_noise`` (``:53-225``) with its
+backend-flag-convention detection (``:60-75``), and libstempo's fake-pulsar
+construction. Red/DM processes are injected by drawing Fourier coefficients
+from the PSD prior and projecting through the same design matrices the
+likelihood uses — the round-trip (inject -> recover posterior) is exact by
+construction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import constants as const
+from ..io.par import ParFile
+from ..io.pulsar import Pulsar
+from ..io.tim import TimFile
+from ..io import timing
+from ..ops import fourier_design, dm_scaling
+from ..ops.spectra import df_from_freqs
+
+_FLAG_CONVENTIONS = ("group", "f", "g", "sys", "be", "B")
+
+
+def red_psd(f, log10_A, gamma):
+    """One-sided power-law PSD in s^3 (reference ``libstempo_warp.py:6-8``
+    convention)."""
+    A2 = 10.0 ** (2.0 * np.asarray(log10_A))
+    return (A2 / (12.0 * np.pi ** 2) * const.fyr ** (gamma - 3.0)
+            * np.asarray(f) ** -gamma)
+
+
+def dm_psd(f, log10_A, gamma):
+    """DM-noise PSD (same shape; chromatic scaling applied per TOA)."""
+    return red_psd(f, log10_A, gamma)
+
+
+def inject_white(psr: Pulsar, efac=None, equad_log10=None, flag=None,
+                 rng=None):
+    """Add per-backend white noise to ``psr.residuals``.
+
+    ``efac``/``equad_log10`` map backend value -> parameter (or scalars for
+    a global term).
+    """
+    rng = rng or np.random.default_rng(0)
+    n = len(psr)
+    sig2 = np.zeros(n)
+    if np.isscalar(efac) or efac is None:
+        e = 1.0 if efac is None else float(efac)
+        sig2 += (e ** 2 - 0.0) * psr.toaerrs ** 2
+    else:
+        masks = psr.backend_masks(flag)
+        for k, v in efac.items():
+            sig2 += (float(v) ** 2) * psr.toaerrs ** 2 * masks[k]
+    if equad_log10 is not None:
+        if np.isscalar(equad_log10):
+            sig2 += 10.0 ** (2 * float(equad_log10))
+        else:
+            masks = psr.backend_masks(flag)
+            for k, v in equad_log10.items():
+                sig2 += 10.0 ** (2 * float(v)) * masks[k]
+    noise = rng.standard_normal(n) * np.sqrt(sig2)
+    psr.residuals = psr.residuals + noise
+    return noise
+
+
+def inject_basis_process(psr: Pulsar, log10_A, gamma, components=30,
+                         chromatic_idx=0.0, fref=1400.0, rng=None,
+                         Tspan=None, return_coeffs=False):
+    """Inject a stationary red process via its Fourier representation.
+
+    Coefficients a_k ~ N(0, phi_k) with phi_k the same per-mode variance
+    the likelihood assigns (``ops.spectra.powerlaw_psd``); the chromatic
+    scaling (fref/nu)^idx reproduces DM (idx=2) or scattering (idx=4)
+    processes.
+    """
+    rng = rng or np.random.default_rng(0)
+    Tspan = Tspan or psr.Tspan
+    F, freqs = fourier_design(psr.toas - psr.toas.min(), components, Tspan)
+    df = df_from_freqs(freqs)
+    phi = np.repeat(red_psd(freqs, log10_A, gamma) * df, 2)
+    coeffs = rng.standard_normal(2 * components) * np.sqrt(phi)
+    sig = F @ coeffs
+    if chromatic_idx:
+        sig = sig * (fref / psr.freqs) ** chromatic_idx
+    psr.residuals = psr.residuals + sig
+    return (sig, coeffs) if return_coeffs else sig
+
+
+def _detect_flag_convention(psr: Pulsar, noise_dict: dict):
+    """Find the TOA flag whose values appear in the noise-dict keys
+    (reference ``libstempo_warp.py:60-75``)."""
+    for flag in _FLAG_CONVENTIONS:
+        vals = psr.flagvals(flag)
+        if vals and any(any(v in key for key in noise_dict) for v in vals):
+            return flag, vals
+    return None, []
+
+
+def add_noise(psr: Pulsar, noise_dict: dict, components=30, seed=0,
+              inc_efac=True, inc_equad=True, inc_red=True, inc_dm=True):
+    """Inject noise described by a PAL2-format noise dict (the shipped
+    ``J1832-0836_noise.json`` schema) into ``psr.residuals``.
+
+    Equivalent of the reference's ``add_noise``
+    (``libstempo_warp.py:53-225``): per-backend efac/equad matched by flag
+    convention, plus 30-component red and DM processes.
+    """
+    rng = np.random.default_rng(seed)
+    flag, vals = _detect_flag_convention(psr, noise_dict)
+
+    efac, equad = {}, {}
+    for key, val in noise_dict.items():
+        for v in vals:
+            if v in key and "efac" in key:
+                efac[v] = val
+            elif v in key and "equad" in key:
+                equad[v] = val
+    unused = [v for v in vals if v not in efac and v not in equad]
+    if unused:
+        print(f"warning: backends with no noise-dict entry: {unused}")
+
+    if inc_efac and efac:
+        inject_white(psr, efac=efac, flag=flag, rng=rng)
+    elif inc_efac:
+        inject_white(psr, efac=1.0, rng=rng)
+    if inc_equad and equad:
+        inject_white(psr, efac=0.0, equad_log10=equad, flag=flag, rng=rng)
+
+    def find(suffix_a, suffix_b):
+        a = [v for k, v in noise_dict.items() if k.endswith(suffix_a)]
+        b = [v for k, v in noise_dict.items() if k.endswith(suffix_b)]
+        return (a[0], b[0]) if a and b else (None, None)
+
+    if inc_red:
+        lgA, gam = find("red_noise_log10_A", "red_noise_gamma")
+        if lgA is not None:
+            inject_basis_process(psr, lgA, gam, components=components,
+                                 rng=rng)
+    if inc_dm:
+        lgA, gam = find("dm_gp_log10_A", "dm_gp_gamma")
+        if lgA is not None:
+            inject_basis_process(psr, lgA, gam, components=components,
+                                 chromatic_idx=2.0, rng=rng)
+    return psr
+
+
+def make_fake_pulsar(name="J0000+0000", ntoa=200, cadence_days=14.0,
+                     toaerr_us=1.0, start_mjd=55000.0, freqs_mhz=1400.0,
+                     backends=("SIM",), raj=1.0, decj=-0.5, seed=0):
+    """Create a barycentric fake pulsar (libstempo ``fakepulsar`` +
+    ``make_ideal`` equivalent): zero residuals, regular cadence, optional
+    multi-backend structure, ready for injection."""
+    rng = np.random.default_rng(seed)
+    mjd = start_mjd + np.arange(ntoa) * cadence_days \
+        + rng.uniform(-0.1, 0.1, ntoa)
+    toas = mjd * const.day
+    nu = (np.full(ntoa, float(freqs_mhz))
+          if np.isscalar(freqs_mhz)
+          else rng.choice(np.asarray(freqs_mhz), ntoa))
+    backend = rng.choice(np.asarray(backends, dtype=object), ntoa)
+    sigma = np.full(ntoa, toaerr_us * 1e-6)
+    # quadratic spindown design matrix (offset, F0, F1 equivalents)
+    t0 = toas - toas.mean()
+    M = np.stack([np.ones(ntoa), t0 / t0.std(),
+                  (t0 / t0.std()) ** 2], axis=1)
+    pos = np.array([np.cos(decj) * np.cos(raj),
+                    np.cos(decj) * np.sin(raj), np.sin(decj)])
+    flags = {"f": backend.copy(), "group": backend.copy(),
+             "B": backend.copy()}
+    par = ParFile()
+    par.name = name
+    par.raj, par.decj = raj, decj
+    par.f0, par.pepoch = 100.0, start_mjd
+    return Pulsar(
+        name=name, toas=toas, toas_rel=toas - toas[0],
+        residuals=np.zeros(ntoa), toaerrs=sigma, freqs=nu, pos=pos,
+        Mmat=M, Mmat_labels=["OFFSET", "F0", "F1"], flags=flags,
+        backend_flags=backend, raj=raj, decj=decj, phase_connected=True,
+        par=par)
+
+
+def make_fake_pta(npsr=10, ntoa=200, toaerr_us=1.0, seed=0, **kw):
+    """A sky-scattered fake PTA (for GWB/ORF tests and benchmarks)."""
+    rng = np.random.default_rng(seed)
+    psrs = []
+    for i in range(npsr):
+        raj = rng.uniform(0, 2 * np.pi)
+        decj = np.arcsin(rng.uniform(-1, 1))
+        psrs.append(make_fake_pulsar(
+            name=f"J{i:04d}+{i:04d}", ntoa=ntoa, toaerr_us=toaerr_us,
+            raj=raj, decj=decj, seed=seed + 1000 + i, **kw))
+    return psrs

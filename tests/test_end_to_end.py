@@ -1,0 +1,116 @@
+"""End-to-end: simulate -> model -> sample -> recover, and the run CLI.
+
+The round-trip test is the project's core correctness contract for the
+whole stack (SURVEY.md §4): noise injected through the same bases the
+likelihood uses must be recovered at the injected parameters.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.models import StandardModels, TermList, \
+    build_pulsar_likelihood
+from enterprise_warp_tpu.samplers import PTSampler
+from enterprise_warp_tpu.sim import (add_noise, inject_basis_process,
+                                     inject_white, make_fake_pulsar)
+
+
+class TestRoundTrip:
+    def test_white_and_red_recovery(self, tmp_path):
+        psr = make_fake_pulsar(ntoa=300, backends=("RX1", "RX2"),
+                               toaerr_us=1.0, seed=11)
+        inject_white(psr, efac={"RX1": 1.5, "RX2": 0.7}, rng=np.random.
+                     default_rng(1))
+        inject_basis_process(psr, log10_A=-12.8, gamma=3.5,
+                             components=30, rng=np.random.default_rng(2))
+        m = StandardModels(psr=psr)
+        terms = TermList(psr, [m.efac("by_backend"),
+                               m.spin_noise("powerlaw")])
+        like = build_pulsar_likelihood(psr, terms)
+        assert like.param_names == [
+            "J0000+0000_RX1_efac", "J0000+0000_RX2_efac",
+            "J0000+0000_red_noise_log10_A", "J0000+0000_red_noise_gamma"]
+        s = PTSampler(like, str(tmp_path), ntemps=2, nchains=8, seed=0,
+                      cov_update=500)
+        s.sample(6000, resume=False, verbose=False)
+        chain = np.loadtxt(tmp_path / "chain_1.txt")
+        post = chain[len(chain) // 4:, :4]
+        med = np.median(post, axis=0)
+        # efacs recovered within ~15%
+        assert med[0] == pytest.approx(1.5, rel=0.15)
+        assert med[1] == pytest.approx(0.7, rel=0.2)
+        # red-noise amplitude within ~1 dex, gamma loosely
+        assert med[2] == pytest.approx(-12.8, abs=1.0)
+        assert 1.0 < med[3] < 7.0
+
+    def test_add_noise_pal2_dict(self):
+        psr = make_fake_pulsar(ntoa=200, backends=("CASPSR_40CM",
+                                                   "PDFB_10CM"), seed=3)
+        noise = {
+            "J0000+0000_CASPSR_40CM_efac": 1.2,
+            "J0000+0000_CASPSR_40CM_log10_equad": -6.5,
+            "J0000+0000_PDFB_10CM_efac": 0.9,
+            "J0000+0000_PDFB_10CM_log10_equad": -7.0,
+            "J0000+0000_red_noise_log10_A": -13.0,
+            "J0000+0000_red_noise_gamma": 4.0,
+            "J0000+0000_dm_gp_log10_A": -13.5,
+            "J0000+0000_dm_gp_gamma": 2.0,
+        }
+        add_noise(psr, noise, seed=4)
+        assert np.std(psr.residuals) > 0
+        # white level should be at least the efac-scaled toaerr scale
+        assert np.std(psr.residuals) > 0.8e-6
+
+
+class TestRunCLI:
+    def test_ptmcmc_run_and_resume(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        prfile = tmp_path / "run.dat"
+        prfile.write_text(
+            "paramfile_label: t1\n"
+            "datadir: /root/reference/examples/data/\n"
+            "out: out/\n"
+            "array_analysis: False\n"
+            "sampler: ptmcmcsampler\n"
+            "SCAMweight: 30\nAMweight: 15\nDEweight: 50\n"
+            "nsamp: 1200\n"
+            "{0}\n"
+            "noise_model_file: /root/reference/examples/"
+            "example_noisemodels/default_noise_example_1.json\n")
+        from enterprise_warp_tpu.cli import main
+        assert main(["--prfile", str(prfile), "--num", "0"]) == 0
+        outdir = "out/examp_1_t1/0_J1832-0836/"
+        chain = np.loadtxt(outdir + "chain_1.txt")
+        assert chain.shape[1] == 12 + 4
+        pars = open(outdir + "pars.txt").read().split()
+        assert len(pars) == 12
+        assert os.path.exists(outdir + "cov.npy")
+        assert os.path.exists(outdir + "state.npz")
+        # resume appends
+        n1 = len(chain)
+        prfile.write_text(prfile.read_text().replace(
+            "nsamp: 1200", "nsamp: 2400"))
+        assert main(["--prfile", str(prfile), "--num", "0"]) == 0
+        assert len(np.loadtxt(outdir + "chain_1.txt")) == 2 * n1
+
+    def test_setup_only_mode(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        prfile = tmp_path / "run.dat"
+        prfile.write_text(
+            "paramfile_label: t2\n"
+            "datadir: /root/reference/examples/data/\n"
+            "out: out/\n"
+            "array_analysis: False\n"
+            "sampler: ptmcmcsampler\n"
+            "nsamp: 1000\n"
+            "{0}\n"
+            "noise_model_file: /root/reference/examples/"
+            "example_noisemodels/default_noise_example_1.json\n")
+        from enterprise_warp_tpu.cli import main
+        assert main(["--prfile", str(prfile), "--mpi_regime", "1"]) == 0
+        # setup happened, no sampling
+        outdir = "out/examp_1_t2/0_J1832-0836/"
+        assert os.path.exists(outdir + "pars.txt")
+        assert not os.path.exists(outdir + "chain_1.txt")
