@@ -1,0 +1,121 @@
+"""Benchmark: marginalized-likelihood evals/sec, device vs 1-core CPU.
+
+Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline"}``.
+
+The metric is the north star from BASELINE.json: log-likelihood
+evaluations per second on the flagship single-pulsar noise model
+(J1832-0836-scale: 334 TOAs, 4 backends, by-backend efac+equad + powerlaw
+spin/DM noise, 20 Fourier modes each — the config of the reference's
+single-pulsar example run). The baseline is a single-threaded numpy
+implementation of the same rank-reduced Woodbury solve evaluated one theta
+at a time — the shape of the reference hot path (Enterprise likelihood
+under ``bilby_warp.py:35``: one Python-dict callback per sampler step on
+one CPU core).
+"""
+
+import json
+import os
+import time
+
+os.environ.setdefault("OMP_NUM_THREADS", "1")       # 1-core CPU baseline
+os.environ.setdefault("OPENBLAS_NUM_THREADS", "1")
+os.environ.setdefault("MKL_NUM_THREADS", "1")
+
+import numpy as np  # noqa: E402
+
+BATCH = 1024          # walker batch per device call
+REPS = 10             # timed batched calls
+CPU_EVALS = 30        # timed single-theta CPU-oracle evals
+
+
+def cpu_woodbury_eval(theta, like, statics):
+    """Single-threaded numpy version of the same likelihood math (the
+    per-step cost profile of the reference CPU stack)."""
+    nw, phi, r_w, M_w, T_w = statics(theta)
+    w = 1.0 / nw
+    Ts = T_w * np.sqrt(w)[:, None]
+    Ms = M_w * np.sqrt(w)[:, None]
+    rs = r_w * np.sqrt(w)
+    G = Ts.T @ Ts
+    Sigma = G + np.diag(1.0 / phi)
+    L = np.linalg.cholesky(Sigma)
+    from scipy.linalg import solve_triangular
+    u = solve_triangular(L, Ts.T @ rs, lower=True)
+    V = solve_triangular(L, Ts.T @ Ms, lower=True)
+    A = Ms.T @ Ms - V.T @ V
+    y = Ms.T @ rs - V.T @ u
+    La = np.linalg.cholesky(A)
+    z = solve_triangular(La, y, lower=True)
+    quad = rs @ rs - u @ u - z @ z
+    return -0.5 * (quad + np.sum(np.log(nw)) + np.sum(np.log(phi))
+                   + 2 * np.sum(np.log(np.diag(L)))
+                   + 2 * np.sum(np.log(np.diag(La))))
+
+
+def main():
+    import jax
+
+    from enterprise_warp_tpu.models import build_pulsar_likelihood
+    from enterprise_warp_tpu.ops.kernel import whiten_inputs
+    from enterprise_warp_tpu.ops.spectra import powerlaw_psd
+    from __graft_entry__ import _flagship_single_pulsar
+
+    psr, terms = _flagship_single_pulsar()
+    like = build_pulsar_likelihood(psr, terms)
+    rng = np.random.default_rng(1)
+    thetas = like.sample_prior(rng, BATCH)
+
+    # --- device throughput (batched, jit'd) ---------------------------- #
+    out = like.loglike_batch(thetas)
+    jax.block_until_ready(out)                     # compile
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = like.loglike_batch(thetas)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    device_eps = BATCH * REPS / dt
+
+    # --- 1-core CPU reference (one theta at a time) -------------------- #
+    r_w, M_w, T_w, cs2, _ = whiten_inputs(
+        psr.residuals, psr.toaerrs, psr.Mmat,
+        np.concatenate([b.F if b.row_scale is None
+                        else b.F * b.row_scale[:, None]
+                        for b in terms if hasattr(b, "F")], axis=1))
+
+    names = like.param_names
+    efac_idx = [i for i, n in enumerate(names) if n.endswith("efac")]
+    equad_idx = [i for i, n in enumerate(names)
+                 if n.endswith("log10_equad")]
+    basis_terms = [b for b in terms if hasattr(b, "F")]
+    backends = sorted(set(psr.backend_flags))
+    bmasks = np.stack([psr.backend_flags == b for b in backends])
+
+    def statics(theta):
+        efac = np.ones(len(psr))
+        equad2 = np.zeros(len(psr))
+        for k, (ie, iq) in enumerate(zip(efac_idx, equad_idx)):
+            efac = np.where(bmasks[k], theta[ie], efac)
+            equad2 = np.where(bmasks[k], 10.0 ** (2 * theta[iq]), equad2)
+        nw = efac ** 2 + equad2 / psr.toaerrs ** 2
+        phis, j = [], len(efac_idx) + len(equad_idx)
+        for b in basis_terms:
+            phis.append(np.asarray(
+                powerlaw_psd(b.freqs, b.df, theta[j], theta[j + 1])))
+            j += 2
+        return nw, np.concatenate(phis) * cs2, r_w, M_w, T_w
+
+    t0 = time.perf_counter()
+    for i in range(CPU_EVALS):
+        cpu_woodbury_eval(np.asarray(thetas[i]), like, statics)
+    cpu_eps = CPU_EVALS / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "loglike_evals_per_sec",
+        "value": round(device_eps, 1),
+        "unit": "evals/s (batch=%d, ntoa=334, nbasis=80+tm)" % BATCH,
+        "vs_baseline": round(device_eps / cpu_eps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

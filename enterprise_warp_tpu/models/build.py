@@ -7,6 +7,11 @@ mutable PTA object answering scalar likelihood calls, ``build_pulsar_likelihood`
 returns a :class:`PulsarLikelihood` whose ``loglike`` is a pure jit'd function
 of a flat parameter vector, and whose ``loglike_batch`` is its ``vmap`` over
 a walker batch.
+
+The lowering helpers (``lower_terms``, ``white_static``/``basis_static``,
+``eval_nw``/``eval_phi_T``) are shared with the joint correlated-GWB PTA
+kernel in ``parallel.pta``, which stacks per-pulsar lowered structures and
+couples them through the ORF.
 """
 
 from __future__ import annotations
@@ -20,8 +25,8 @@ import numpy as np
 
 from ..ops import quantization_matrix
 from ..ops.kernel import marginalized_loglike, whiten_inputs
-from ..ops.spectra import (broken_powerlaw_psd, free_spectrum_psd,
-                           powerlaw_psd)
+from ..ops.spectra import (broken_powerlaw_psd, df_from_freqs,
+                           free_spectrum_psd, powerlaw_psd)
 from .prior_mixin import PriorMixin
 from .priors import Constant, Parameter
 from .terms import BasisTerm, CommonTerm, TermList, WhiteTerm
@@ -53,6 +58,7 @@ class _BasisBlock:
     dynamic_idx: Parameter = None
     log_nu_ratio: np.ndarray = None
     col_slice: slice = None
+    orf: str = None                   # spatially-correlated common term
 
 
 class PulsarLikelihood(PriorMixin):
@@ -76,7 +82,6 @@ class PulsarLikelihood(PriorMixin):
         self.gram_mode = gram_mode
         self.loglike = jax.jit(loglike_fn)
         self.loglike_batch = jax.jit(jax.vmap(loglike_fn))
-
 
 
 def _resolve_params(all_params, fixed_values):
@@ -104,17 +109,20 @@ def _resolve_params(all_params, fixed_values):
     return sampled, mapping
 
 
-def build_pulsar_likelihood(psr, terms, fixed_values=None,
-                            gram_mode="split", ecorr_dt=10.0):
-    """Compile a TermList for one pulsar into a :class:`PulsarLikelihood`.
+def lower_terms(psr, terms, ecorr_dt=10.0, common_grid=None):
+    """Lower a TermList into white/basis blocks + the stacked basis matrix.
 
-    ``fixed_values`` maps parameter names to values for Constant-prior
-    parameters (the reference's PAL2-noisefile fixing,
-    ``enterprise_warp.py:504-508``).
+    ``common_grid`` — optional ``(t0, Tspan)`` pair: when given, CommonTerms
+    are lowered on this *shared* PTA-wide Fourier grid (the joint-likelihood
+    case, matching Enterprise's common-Tspan FourierBasisCommonGP); when
+    None they fall back to the pulsar's own span (single-pulsar analysis).
+
+    Returns ``(white_blocks, basis_blocks, T_all)`` where basis blocks of
+    spatially-correlated common terms carry ``orf`` set.
     """
-    ntoa = len(psr)
-    sigma = psr.toaerrs
+    from ..ops import fourier_design
 
+    ntoa = len(psr)
     white_blocks, basis_blocks, basis_cols = [], [], []
     col_cursor = 0
 
@@ -143,19 +151,17 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
                                         col_cursor + U.shape[1])))
                     col_cursor += U.shape[1]
         elif isinstance(t, CommonTerm):
-            # single-pulsar lowering of a common signal: plain Fourier GP
-            # with shared parameter names; spatial ORF handled by the joint
-            # PTA likelihood (parallel subpackage)
-            from ..ops import fourier_design
-            from ..ops.spectra import df_from_freqs
-            Tspan = psr.Tspan
-            F, freqs = fourier_design(psr.toas - psr.toas.min(),
-                                      t.nmodes, Tspan)
+            if common_grid is not None:
+                t0, Tspan = common_grid
+            else:
+                t0, Tspan = psr.toas.min(), psr.Tspan
+            F, freqs = fourier_design(psr.toas - t0, t.nmodes, Tspan)
             basis_cols.append(F)
             basis_blocks.append(_BasisBlock(
                 name=t.name, ncols=F.shape[1], psd=t.psd, freqs=freqs,
                 df=df_from_freqs(freqs), params=t.params,
-                col_slice=slice(col_cursor, col_cursor + F.shape[1])))
+                col_slice=slice(col_cursor, col_cursor + F.shape[1]),
+                orf=t.orf))
             col_cursor += F.shape[1]
         elif isinstance(t, BasisTerm):
             F = t.F
@@ -178,13 +184,13 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
             name="null", ncols=1, psd="null", freqs=None, df=None,
             params=[], fixed_phi=np.array([1.0]),
             col_slice=slice(0, 1)))
-        col_cursor = 1
 
     T_all = np.concatenate(basis_cols, axis=1)
-    r_w, M_w, T_w, col_scale2, _ = whiten_inputs(
-        psr.residuals, sigma, psr.Mmat, T_all)
+    return white_blocks, basis_blocks, T_all
 
-    # gather all parameters in model order
+
+def collect_params(white_blocks, basis_blocks):
+    """All model parameters in canonical (pars.txt) order."""
     all_params = []
     for wb in white_blocks:
         all_params.extend(wb.params)
@@ -192,7 +198,105 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
         all_params.extend(bb.params)
         if bb.dynamic_idx is not None:
             all_params.append(bb.dynamic_idx)
-    sampled, mapping = _resolve_params(all_params, fixed_values)
+    return all_params
+
+
+def white_static(white_blocks, mapping):
+    """Device-ready white-noise block structures."""
+    return [(wb.kind, jnp.asarray(wb.mask_matrix),
+             [mapping[p.name] for p in wb.params])
+            for wb in white_blocks]
+
+
+def basis_static(basis_blocks, mapping):
+    """Device-ready basis block structures."""
+    out = []
+    for bb in basis_blocks:
+        out.append(dict(
+            psd=bb.psd, col_slice=bb.col_slice,
+            freqs=None if bb.freqs is None else jnp.asarray(bb.freqs),
+            df=None if bb.df is None else jnp.asarray(bb.df),
+            idx_map=[mapping[p.name] for p in bb.params],
+            fixed_phi=None if bb.fixed_phi is None else
+            jnp.asarray(bb.fixed_phi),
+            ncols=bb.ncols,
+            dyn=None if bb.dynamic_idx is None else
+            mapping[bb.dynamic_idx.name],
+            lognu=None if bb.log_nu_ratio is None else
+            jnp.asarray(bb.log_nu_ratio),
+            orf=bb.orf))
+    return out
+
+
+def param_value(theta, ref):
+    kind, v = ref
+    return theta[v] if kind == "theta" else v
+
+
+def eval_nw(theta, wb_static, ntoa, sigma2_j):
+    """Whitened white-noise variance per TOA:
+    ``efac_b^2 + 10^(2 equad_b) / sigma^2`` (padded entries must be 1)."""
+    efac_toa = jnp.ones(ntoa)
+    equad2_toa = jnp.zeros(ntoa)
+    for kind, mm, refs in wb_static:
+        vals = jnp.stack([param_value(theta, rf) for rf in refs])
+        if kind == "efac":
+            contrib = vals @ mm
+            covered = jnp.sum(mm, axis=0)
+            efac_toa = contrib + (1.0 - covered) * efac_toa
+        else:
+            equad2_toa = equad2_toa + (10.0 ** (2.0 * vals)) @ mm
+    return efac_toa ** 2 + equad2_toa / sigma2_j
+
+
+def eval_block_phi(theta, bb):
+    """Prior variance vector of one basis block (before column scaling)."""
+    if bb["psd"] == "ecorr":
+        p = param_value(theta, bb["idx_map"][0])
+        return 10.0 ** (2.0 * p) * jnp.ones(bb["ncols"])
+    if bb["fixed_phi"] is not None:
+        return bb["fixed_phi"]
+    if bb["psd"] == "free_spectrum":
+        rho = jnp.stack([param_value(theta, rf) for rf in bb["idx_map"]])
+        return free_spectrum_psd(bb["freqs"], bb["df"], rho)
+    args = [param_value(theta, rf) for rf in bb["idx_map"]]
+    return _PSD_FNS[bb["psd"]](bb["freqs"], bb["df"], *args)
+
+
+def eval_phi_T(theta, bb_static, T_w_j, cs2_j):
+    """(phi, T) at theta: the stacked prior variances (column-scale folded)
+    and the basis matrix with dynamic chromatic scaling applied."""
+    phis = []
+    T_mat = T_w_j
+    for bb in bb_static:
+        phis.append(eval_block_phi(theta, bb))
+        if bb["dyn"] is not None:
+            idx = param_value(theta, bb["dyn"])
+            scale = jnp.exp(idx * bb["lognu"])
+            sl = bb["col_slice"]
+            T_mat = T_mat.at[:, sl].set(T_w_j[:, sl] * scale[:, None])
+    phi = jnp.concatenate(phis) * cs2_j
+    return phi, T_mat
+
+
+def build_pulsar_likelihood(psr, terms, fixed_values=None,
+                            gram_mode="split", ecorr_dt=10.0):
+    """Compile a TermList for one pulsar into a :class:`PulsarLikelihood`.
+
+    ``fixed_values`` maps parameter names to values for Constant-prior
+    parameters (the reference's PAL2-noisefile fixing,
+    ``enterprise_warp.py:504-508``).
+    """
+    ntoa = len(psr)
+    sigma = psr.toaerrs
+
+    white_blocks, basis_blocks, T_all = lower_terms(psr, terms,
+                                                    ecorr_dt=ecorr_dt)
+    r_w, M_w, T_w, col_scale2, _ = whiten_inputs(
+        psr.residuals, sigma, psr.Mmat, T_all)
+
+    sampled, mapping = _resolve_params(
+        collect_params(white_blocks, basis_blocks), fixed_values)
 
     # --- static device arrays ------------------------------------------
     sigma2_j = jnp.asarray(sigma ** 2)
@@ -200,67 +304,12 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     M_w_j = jnp.asarray(M_w)
     T_w_j = jnp.asarray(T_w)
     cs2_j = jnp.asarray(col_scale2)
-    wb_static = [(wb.kind, jnp.asarray(wb.mask_matrix),
-                  [mapping[p.name] for p in wb.params])
-                 for wb in white_blocks]
-    bb_static = []
-    for bb in basis_blocks:
-        entry = dict(psd=bb.psd, col_slice=bb.col_slice,
-                     freqs=None if bb.freqs is None else
-                     jnp.asarray(bb.freqs),
-                     df=None if bb.df is None else jnp.asarray(bb.df),
-                     idx_map=[mapping[p.name] for p in bb.params],
-                     fixed_phi=None if bb.fixed_phi is None else
-                     jnp.asarray(bb.fixed_phi),
-                     ncols=bb.ncols,
-                     dyn=None if bb.dynamic_idx is None else
-                     mapping[bb.dynamic_idx.name],
-                     lognu=None if bb.log_nu_ratio is None else
-                     jnp.asarray(bb.log_nu_ratio))
-        bb_static.append(entry)
-
-    def _get(theta, ref):
-        kind, v = ref
-        return theta[v] if kind == "theta" else v
+    wb_static = white_static(white_blocks, mapping)
+    bb_static = basis_static(basis_blocks, mapping)
 
     def loglike(theta):
-        # white noise
-        efac_toa = jnp.ones(ntoa)
-        equad2_toa = jnp.zeros(ntoa)
-        for kind, mm, refs in wb_static:
-            vals = jnp.stack([_get(theta, rf) for rf in refs])
-            if kind == "efac":
-                contrib = vals @ mm
-                covered = jnp.sum(mm, axis=0)
-                efac_toa = contrib + (1.0 - covered) * efac_toa
-            else:
-                equad2_toa = equad2_toa + (10.0 ** (2.0 * vals)) @ mm
-        nw = efac_toa ** 2 + equad2_toa / sigma2_j
-
-        # basis prior variances
-        phis = []
-        T_mat = T_w_j
-        for bb in bb_static:
-            if bb["psd"] == "ecorr":
-                p = _get(theta, bb["idx_map"][0])
-                phis.append(10.0 ** (2.0 * p) * jnp.ones(bb["ncols"]))
-            elif bb["fixed_phi"] is not None:
-                phis.append(bb["fixed_phi"])
-            elif bb["psd"] == "free_spectrum":
-                rho = jnp.stack([_get(theta, rf)
-                                 for rf in bb["idx_map"]])
-                phis.append(free_spectrum_psd(bb["freqs"], bb["df"], rho))
-            else:
-                args = [_get(theta, rf) for rf in bb["idx_map"]]
-                phis.append(_PSD_FNS[bb["psd"]](bb["freqs"], bb["df"],
-                                                *args))
-            if bb["dyn"] is not None:
-                idx = _get(theta, bb["dyn"])
-                scale = jnp.exp(idx * bb["lognu"])
-                sl = bb["col_slice"]
-                T_mat = T_mat.at[:, sl].set(
-                    T_w_j[:, sl] * scale[:, None])
-        phi = jnp.concatenate(phis) * cs2_j
+        nw = eval_nw(theta, wb_static, ntoa, sigma2_j)
+        phi, T_mat = eval_phi_T(theta, bb_static, T_w_j, cs2_j)
         lnl = marginalized_loglike(nw, phi, r_w_j, M_w_j, T_mat,
                                    gram_mode=gram_mode)
         # a numerically non-PD Sigma (extreme prior corners) yields NaN;

@@ -1,0 +1,23 @@
+"""Device-mesh parallelism for the PTA likelihood.
+
+TPU-native replacement of the reference's multi-node story (MPI/PolyChord
+file-staging protocol, ``/root/reference/enterprise_warp/
+enterprise_warp.py:46-55``): pulsars are sharded over a
+``jax.sharding.Mesh`` axis and coupled through XLA collectives.
+"""
+
+from .orf import (dipole_matrix, hd_matrix, monopole_matrix,  # noqa: F401
+                  orf_matrix)
+from .pta import PTALikelihood, build_pta_likelihood  # noqa: F401
+
+
+def make_psr_mesh(n_devices=None, axis="psr"):
+    """A 1-D device mesh over the pulsar axis."""
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))

@@ -1,0 +1,15 @@
+"""Post-processing over the output-directory contract.
+
+Behavioral port of the reference results framework
+(``/root/reference/enterprise_warp/results.py``): chain loading with
+burn-in, noise files, Bayes factors from product-space model indices,
+corner/trace plots, covariance collection, Bilby-style result-JSON runs,
+and the frequentist optimal statistic — all plain CPU Python over the same
+on-disk layout (``pars.txt`` + ``chain_1.txt`` + ``cov.npy`` per pulsar
+directory), so chains from any backend round-trip.
+"""
+
+from .core import (EnterpriseWarpResult, estimate_from_distribution,  # noqa: F401
+                   make_noise_files, parse_commandline)
+from .bilbylike import BilbyWarpResult  # noqa: F401
+from .optstat import OptimalStatisticResult, OptimalStatisticWarp  # noqa: F401

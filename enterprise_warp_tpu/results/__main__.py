@@ -1,0 +1,36 @@
+"""``python -m enterprise_warp_tpu.results`` — the results CLI.
+
+Dispatch mirror of the reference's ``enterprise_warp/results.py:1041-1071``:
+dynamic import of a user model file, then EnterpriseWarpResult /
+BilbyWarpResult / OptimalStatisticWarp by option.
+"""
+
+import sys
+
+from .core import EnterpriseWarpResult, parse_commandline
+
+
+def main(argv=None):
+    opts = parse_commandline(argv)
+
+    custom = None
+    if opts.custom_models_py and opts.custom_models:
+        from ..cli import import_custom_models
+        custom = import_custom_models(opts.custom_models_py,
+                                      opts.custom_models)
+
+    if opts.optimal_statistic:
+        from .optstat import OptimalStatisticWarp
+        result = OptimalStatisticWarp(opts, custom_models_obj=custom)
+    elif opts.bilby:
+        from .bilbylike import BilbyWarpResult
+        result = BilbyWarpResult(opts, custom_models_obj=custom)
+    else:
+        result = EnterpriseWarpResult(opts, custom_models_obj=custom)
+
+    result.main_pipeline()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

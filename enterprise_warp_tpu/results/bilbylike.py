@@ -1,0 +1,59 @@
+"""Result-JSON (nested-sampling) post-processing.
+
+Equivalent of the reference's ``BilbyWarpResult``
+(``/root/reference/enterprise_warp/results.py:1002-1039``): the same
+pipeline run over ``<label>_result.json`` files written by
+``samplers.run_nested`` (Bilby-compatible schema: ``posterior`` dict of
+per-parameter sample lists, ``log_evidence``, ``parameter_labels``), with
+the posterior DataFrame standing in for the MCMC chain.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from .core import EnterpriseWarpResult, make_noise_files
+
+
+class BilbyWarpResult(EnterpriseWarpResult):
+
+    def find_result_file(self, psr_dir):
+        d = os.path.join(self.outdir_all, psr_dir)
+        if not os.path.isdir(d):
+            return None
+        cands = sorted(f for f in os.listdir(d)
+                       if f.endswith("_result.json"))
+        return os.path.join(d, cands[0]) if cands else None
+
+    def load_chains(self, psr_dir):
+        """Posterior samples from the result JSON, shaped like a chain.
+
+        The 4 diagnostic columns are zeros (no PTMCMC diagnostics in a
+        nested run); burn-in does not apply to weighted-resampled
+        posteriors, so none is taken.
+        """
+        path = self.find_result_file(psr_dir)
+        if path is None:
+            return None
+        with open(path) as fh:
+            result = json.load(fh)
+        pars = result.get("parameter_labels") \
+            or list(result["posterior"].keys())
+        post = result["posterior"]
+        chain = np.stack([np.asarray(post[p], dtype=np.float64)
+                          for p in pars], axis=1)
+        self.last_result = result
+        diag = np.zeros((len(chain), 4))
+        return chain, diag, pars
+
+    def _print_logbf(self, psr_dir, chain, pars):
+        """Nested runs carry evidences directly."""
+        r = getattr(self, "last_result", None)
+        if r is None:
+            return None
+        print(f"   {psr_dir}: log_evidence = "
+              f"{r['log_evidence']:.3f} +- {r['log_evidence_err']:.3f}")
+        return r["log_evidence"]

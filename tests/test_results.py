@@ -1,0 +1,226 @@
+"""Results-layer tests: directory contract round-trip, noisefiles,
+Bayes factors, covariance collection, result-JSON adapter, and the
+optimal statistic on a simulated HD-correlated PTA."""
+
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.results import (BilbyWarpResult,
+                                         EnterpriseWarpResult,
+                                         estimate_from_distribution,
+                                         make_noise_files)
+from enterprise_warp_tpu.results.core import check_if_psr_dir
+
+
+def opts_for(result, **kw):
+    base = dict(result=result, info=0, name="all", corner=0, par=None,
+                chains=0, logbf=0, noisefiles=0, credlevels=0,
+                separate_earliest=0.0, mpi_regime=0, load_separated=0,
+                covm=0, bilby=0, optimal_statistic=0,
+                optimal_statistic_orfs="hd,dipole,monopole",
+                optimal_statistic_nsamples=50, custom_models_py=None,
+                custom_models=None)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+def write_fake_run(outdir, psr="J0000+0000", nsamp=400, ndim=3, seed=0,
+                   nmodel=False):
+    """A synthetic chain in the reference on-disk contract."""
+    rng = np.random.default_rng(seed)
+    d = os.path.join(outdir, f"0_{psr}")
+    os.makedirs(d, exist_ok=True)
+    pars = [f"{psr}_efac", f"{psr}_red_noise_log10_A",
+            f"{psr}_red_noise_gamma"][:ndim]
+    mu = np.array([1.0, -14.0, 3.0])[:ndim]
+    chain = mu + 0.1 * rng.standard_normal((nsamp, ndim))
+    if nmodel:
+        pars = pars + ["nmodel"]
+        # model 1 visited 3x as often as model 0
+        nm = (rng.random(nsamp) < 0.75).astype(float) \
+            + rng.uniform(-0.3, 0.3, nsamp)
+        chain = np.column_stack([chain, nm])
+    diag = np.column_stack([
+        -0.5 * np.sum((chain[:, :ndim] - mu) ** 2, axis=1),
+        -0.5 * np.sum((chain[:, :ndim] - mu) ** 2, axis=1),
+        np.full(nsamp, 0.3), np.zeros(nsamp)])
+    np.savetxt(os.path.join(d, "chain_1.txt"),
+               np.column_stack([chain, diag]))
+    np.savetxt(os.path.join(d, "pars.txt"), pars, fmt="%s")
+    np.save(os.path.join(d, "cov.npy"), np.eye(len(pars)) * 0.01)
+    return d, pars, chain
+
+
+class TestCore:
+    def test_psr_dir_regex(self):
+        assert check_if_psr_dir("0_J1832-0836")
+        assert check_if_psr_dir("12_B1937+21")
+        assert not check_if_psr_dir("noisefiles")
+        assert not check_if_psr_dir("J1832-0836")
+
+    def test_estimates(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(3.0, 0.5, 4000)
+        assert abs(estimate_from_distribution(x, "median") - 3.0) < 0.05
+        assert abs(estimate_from_distribution(x, "mode") - 3.0) < 0.15
+        cl = estimate_from_distribution(x, "credlvl")
+        assert abs(cl["minus"] - 0.5) < 0.1
+        assert abs(cl["plus"] - 0.5) < 0.1
+
+    def test_pipeline_products(self, tmp_path):
+        out = str(tmp_path)
+        write_fake_run(out)
+        r = EnterpriseWarpResult(opts_for(out, noisefiles=1, credlevels=1,
+                                          corner=1, chains=1, covm=1))
+        r.main_pipeline()
+        with open(os.path.join(out, "noisefiles",
+                               "J0000+0000_noise.json")) as fh:
+            noise = json.load(fh)
+        assert abs(noise["J0000+0000_efac"] - 1.0) < 0.1
+        assert os.path.exists(os.path.join(out, "0_J0000+0000",
+                                           "corner.png"))
+        assert os.path.exists(os.path.join(out, "0_J0000+0000",
+                                           "chains.png"))
+        assert os.path.exists(os.path.join(out, "covm_all.csv"))
+
+    def test_burn_in_applied(self, tmp_path):
+        out = str(tmp_path)
+        write_fake_run(out, nsamp=400)
+        r = EnterpriseWarpResult(opts_for(out))
+        chain, diag, pars = r.load_chains("0_J0000+0000")
+        assert len(chain) == 300          # 25% burn-in
+        assert chain.shape[1] == 3        # 4 diag cols stripped
+        assert diag.shape[1] == 4
+
+    def test_logbf_from_nmodel(self, tmp_path, capsys):
+        out = str(tmp_path)
+        write_fake_run(out, nmodel=True, nsamp=4000)
+        r = EnterpriseWarpResult(opts_for(out, logbf=1))
+        chain, _, pars = r.load_chains("0_J0000+0000")
+        counts = r._print_logbf("0_J0000+0000", chain, pars)
+        printed = capsys.readouterr().out
+        assert "logBF[1/0]" in printed
+        # 3:1 visit ratio -> logBF ~ ln 3
+        logbf = np.log(counts[1] / counts[0])
+        assert abs(logbf - np.log(3)) < 0.3
+
+    def test_separate_earliest_roundtrip(self, tmp_path):
+        out = str(tmp_path)
+        d, pars, chain = write_fake_run(out, nsamp=400)
+        r = EnterpriseWarpResult(opts_for(out, separate_earliest=0.25))
+        r._separate_earliest("0_J0000+0000")
+        assert os.path.exists(os.path.join(d, "0_chain_1.txt"))
+        live = np.loadtxt(os.path.join(d, "chain_1.txt"))
+        assert len(live) == 300
+        # load_separated stitches backups + live chain back together
+        r2 = EnterpriseWarpResult(opts_for(out, load_separated=1))
+        full, _, _ = r2.load_chains("0_J0000+0000")
+        assert len(full) == 300           # 400 total, 25% burn
+
+
+class TestBilbyAdapter:
+    def test_result_json_pipeline(self, tmp_path):
+        out = str(tmp_path)
+        d = os.path.join(out, "0_J0001+0001")
+        os.makedirs(d)
+        rng = np.random.default_rng(2)
+        post = {"J0001+0001_efac": rng.normal(1, .1, 500).tolist(),
+                "J0001+0001_red_noise_log10_A":
+                    rng.normal(-14, .3, 500).tolist()}
+        result = dict(label="run", log_evidence=-12.3,
+                      log_evidence_err=0.1,
+                      parameter_labels=list(post.keys()), posterior=post)
+        with open(os.path.join(d, "run_result.json"), "w") as fh:
+            json.dump(result, fh)
+        r = BilbyWarpResult(opts_for(out, noisefiles=1, logbf=1))
+        r.main_pipeline()
+        noise = json.load(open(os.path.join(
+            out, "noisefiles", "J0001+0001_noise.json")))
+        assert abs(noise["J0001+0001_efac"] - 1.0) < 0.1
+
+
+class TestOptimalStatistic:
+    @pytest.fixture(scope="class")
+    def os_setup(self):
+        from enterprise_warp_tpu.models import StandardModels, TermList
+        from enterprise_warp_tpu.results.optstat import make_os_fn
+        from enterprise_warp_tpu.sim.noise import make_fake_pta
+
+        psrs = make_fake_pta(npsr=6, ntoa=120, seed=9)
+        rng = np.random.default_rng(9)
+        for p in psrs:
+            p.residuals = p.toaerrs * rng.standard_normal(len(p))
+        tls = []
+        for p in psrs:
+            m = StandardModels(psr=p)
+            tls.append(TermList(p, [m.efac("by_backend"),
+                                    m.gwb("hd_vary_gamma_5_nfreqs")]))
+        return psrs, tls, make_os_fn(psrs, tls)
+
+    def test_pair_count_and_finiteness(self, os_setup):
+        import jax.numpy as jnp
+        psrs, tls, (fn, pairs, xi, sampled) = os_setup
+        assert len(pairs) == 6 * 5 // 2
+        names = [p.name for p in sampled]
+        theta = np.array([1.0 if n.endswith("efac") else
+                          (-14.0 if "log10_A" in n else 4.33)
+                          for n in names])
+        rho, sig = fn(jnp.asarray(theta))
+        assert np.all(np.isfinite(np.asarray(rho)))
+        assert np.all(np.asarray(sig) > 0)
+
+    def test_injected_gwb_recovered_positive(self, os_setup):
+        """Inject a strong common HD-correlated signal; the HD OS
+        amplitude estimate must be positive and the S/N above the
+        white-noise-only expectation."""
+        import jax.numpy as jnp
+        from enterprise_warp_tpu.models import StandardModels, TermList
+        from enterprise_warp_tpu.results.optstat import (combine_os,
+                                                         make_os_fn)
+        from enterprise_warp_tpu.ops import fourier_design
+        from enterprise_warp_tpu.ops.spectra import powerlaw_psd, \
+            df_from_freqs
+        from enterprise_warp_tpu.parallel.orf import hd_matrix
+        from enterprise_warp_tpu.sim.noise import make_fake_pta
+
+        psrs = make_fake_pta(npsr=8, ntoa=120, seed=4)
+        rng = np.random.default_rng(4)
+        # correlated injection: coefficients ~ N(0, Phi) with
+        # cross-pulsar HD covariance
+        t0 = min(p.toas.min() for p in psrs)
+        t1 = max(p.toas.max() for p in psrs)
+        nmodes = 5
+        lgA, gam = -12.0, 13.0 / 3.0
+        gamma_mat = hd_matrix(np.stack([p.pos for p in psrs]))
+        Lg = np.linalg.cholesky(gamma_mat + 1e-10 * np.eye(len(psrs)))
+        Fs, phis = [], None
+        for p in psrs:
+            F, freqs = fourier_design(p.toas - t0, nmodes, t1 - t0)
+            Fs.append(F)
+            phis = np.asarray(powerlaw_psd(freqs, df_from_freqs(freqs),
+                                           lgA, gam))
+        coef = Lg @ rng.standard_normal((len(psrs), 2 * nmodes)) \
+            * np.sqrt(phis)[None, :]
+        for i, p in enumerate(psrs):
+            p.residuals = (p.toaerrs * rng.standard_normal(len(p))
+                           + Fs[i] @ coef[i])
+        tls = []
+        for p in psrs:
+            m = StandardModels(psr=p)
+            tls.append(TermList(p, [m.efac("by_backend"),
+                                    m.gwb(f"hd_vary_gamma_{nmodes}"
+                                          "_nfreqs")]))
+        fn, pairs, xi, sampled = make_os_fn(psrs, tls)
+        names = [p.name for p in sampled]
+        theta = np.array([1.0 if n.endswith("efac") else
+                          (lgA if "log10_A" in n else gam)
+                          for n in names])
+        rho, sig = (np.asarray(v) for v in fn(jnp.asarray(theta)))
+        pos = np.stack([p.pos for p in psrs])
+        a2, a2e, snr = combine_os(rho, sig, xi, "hd", pos)
+        assert a2 > 0
+        assert snr > 1.0
