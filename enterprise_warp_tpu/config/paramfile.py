@@ -348,7 +348,8 @@ class Params:
             tmax = max(p.toas.max() for p in self.psrs)
             self.Tspan = float(tmax - tmin)
         else:
-            num = self.opts.num if self.opts is not None else 0
+            num = getattr(self.opts, "num", 0) if self.opts is not None \
+                else 0
             if num >= len(pairs):
                 raise IndexError(
                     f"--num {num} out of range: {len(pairs)} pulsars")

@@ -11,8 +11,11 @@ linearized timing-model design matrix).
 from .par import parse_par, ParFile
 from .tim import parse_tim, TimFile
 from .pulsar import Pulsar, load_pulsar, load_pulsars_from_dir
+from .writers import (pulsar_to_timfile, save_pulsar_pair, write_par,
+                      write_tim)
 
 __all__ = [
     "parse_par", "ParFile", "parse_tim", "TimFile",
     "Pulsar", "load_pulsar", "load_pulsars_from_dir",
+    "write_par", "write_tim", "pulsar_to_timfile", "save_pulsar_pair",
 ]

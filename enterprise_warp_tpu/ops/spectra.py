@@ -25,15 +25,33 @@ def _repeat_modes(phi_modes):
     return jnp.repeat(phi_modes, 2)
 
 
+# phi exponent guard: on TPU, x64 emulation keeps the float32 exponent
+# range, so intermediates like f**(-gamma) ~ 1e46 overflow and prefactors
+# ~ 1e-41 flush to zero (0*inf = NaN). All PSDs are therefore evaluated in
+# log space with one final exp, clamped to the f32-representable window.
+# The clamp only binds where a mode is already ~30 orders of magnitude
+# above/below the white-noise level, where lnL is flat in the hyperparams.
+_LOG_PHI_MIN = jnp.log(1e-36)
+_LOG_PHI_MAX = jnp.log(1e35)
+
+
+def _exp_clamped(log_phi):
+    return jnp.exp(jnp.clip(log_phi, _LOG_PHI_MIN, _LOG_PHI_MAX))
+
+
+_LN10 = jnp.log(10.0)
+
+
 def powerlaw_psd(f, df, log10_A, gamma):
     """Power-law red-noise prior variance per Fourier mode.
 
     phi_k = A^2 / (12 pi^2) * fyr^(gamma-3) * f_k^(-gamma) * df_k
+    (evaluated in log space; see exponent-guard note above)
     """
-    A2 = 10.0 ** (2.0 * log10_A)
-    phi = (A2 / (12.0 * jnp.pi ** 2)
-           * const.fyr ** (gamma - 3.0) * f ** (-gamma) * df)
-    return _repeat_modes(phi)
+    log_phi = (2.0 * log10_A * _LN10 - jnp.log(12.0 * jnp.pi ** 2)
+               + (gamma - 3.0) * jnp.log(const.fyr)
+               - gamma * jnp.log(f) + jnp.log(df))
+    return _repeat_modes(_exp_clamped(log_phi))
 
 
 def broken_powerlaw_psd(f, df, log10_A, gamma, fc):
@@ -41,16 +59,17 @@ def broken_powerlaw_psd(f, df, log10_A, gamma, fc):
     spectrum below fc; ``fc < 0`` is interpreted as log10(fc) (reference
     convention at ``enterprise_models.py:561``)."""
     fc = jnp.where(fc < 0, 10.0 ** fc, fc)
-    A2 = 10.0 ** (2.0 * log10_A)
-    phi = (A2 / (12.0 * jnp.pi ** 2) * const.fyr ** (-3.0)
-           * ((f + fc) / const.fyr) ** (-gamma) * df)
-    return _repeat_modes(phi)
+    log_phi = (2.0 * log10_A * _LN10 - jnp.log(12.0 * jnp.pi ** 2)
+               - 3.0 * jnp.log(const.fyr)
+               - gamma * (jnp.log(f + fc) - jnp.log(const.fyr))
+               + jnp.log(df))
+    return _repeat_modes(_exp_clamped(log_phi))
 
 
 def free_spectrum_psd(f, df, log10_rho):
     """Free spectrum: rho_k^2 per mode, independent of f/df."""
     del f, df
-    return _repeat_modes(10.0 ** (2.0 * log10_rho))
+    return _repeat_modes(_exp_clamped(2.0 * log10_rho * _LN10))
 
 
 def df_from_freqs(freqs):

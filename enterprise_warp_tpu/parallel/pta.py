@@ -41,7 +41,7 @@ from ..models.build import (_resolve_params, basis_static, collect_params,
                             eval_block_phi, eval_nw, lower_terms,
                             param_value, white_static)
 from ..models.prior_mixin import PriorMixin
-from ..ops.kernel import _CHUNK, _HIGH, _split_hi_lo, whiten_inputs
+from ..ops.kernel import _HIGH, _gram_pair, whiten_inputs
 from .orf import is_positive_definite, orf_matrix
 
 # Improper-flat-prior stand-in for timing-model columns. Kept inside the
@@ -54,33 +54,11 @@ _TM_PHI = 1.0e30
 def _gram_batched(S, B, mode):
     """Batched Gram over the TOA axis: (P,n,k) x (P,n,l) -> (P,k,l).
 
-    Same precision modes as ``ops.kernel._gram_pair``: 'f64' direct,
-    'f32' single-pass, 'split' hi/lo product splitting with chunked f64
-    accumulation (the TPU default: MXU throughput at ~1e-9 relative error).
-    """
-    if mode == "f64":
-        return jnp.einsum("pik,pil->pkl", S, B, precision=_HIGH)
-    if mode == "f32":
-        out = jnp.einsum("pik,pil->pkl", S.astype(jnp.float32),
-                         B.astype(jnp.float32), precision=_HIGH)
-        return out.astype(S.dtype)
-
-    n = S.shape[1]
-    n_pad = (-n) % _CHUNK
-    if n_pad:
-        S = jnp.pad(S, ((0, 0), (0, n_pad), (0, 0)))
-        B = jnp.pad(B, ((0, 0), (0, n_pad), (0, 0)))
-    nc = S.shape[1] // _CHUNK
-    Sh, Sl = _split_hi_lo(S)
-    Bh, Bl = _split_hi_lo(B)
-
-    def chunked(x, y):
-        xc = x.reshape(x.shape[0], nc, _CHUNK, x.shape[2])
-        yc = y.reshape(y.shape[0], nc, _CHUNK, y.shape[2])
-        parts = jnp.einsum("pcik,pcil->pckl", xc, yc, precision=_HIGH)
-        return jnp.sum(parts.astype(jnp.float64), axis=1)
-
-    return chunked(Sh, Bh) + chunked(Sh, Bl) + chunked(Sl, Bh)
+    A vmap of ``ops.kernel._gram_pair`` over the pulsar axis, so the
+    per-pulsar and joint-PTA paths share one precision scheme ('f64'
+    direct, 'f32' single-pass, 'split' hi/lo product splitting with
+    chunked f64 accumulation — the TPU default)."""
+    return jax.vmap(lambda s, b: _gram_pair(s, b, mode))(S, B)
 
 
 class PTALikelihood(PriorMixin):

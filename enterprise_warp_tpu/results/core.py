@@ -256,16 +256,18 @@ class EnterpriseWarpResult:
         idx = pars.index("nmodel")
         nmodel = np.rint(chain[:, idx]).astype(int)
         ids, counts = np.unique(nmodel, return_counts=True)
+        if len(ids) == 1:
+            # np.unique only reports visited models: a missing competitor
+            # means the sampler never hopped there
+            print(f"   logBF: only model {ids[0]} was ever visited "
+                  "(increase nsamp)")
+            return dict(zip(ids.tolist(), counts.tolist()))
         for i in ids:
             for j in ids:
                 if j <= i:
                     continue
                 ci = counts[ids == i][0]
                 cj = counts[ids == j][0]
-                if ci == 0 or cj == 0:
-                    print(f"   logBF[{j}/{i}]: one model has zero "
-                          "visits (increase nsamp)")
-                    continue
                 logbf = np.log(cj / ci)
                 print(f"   logBF[{j}/{i}] = {logbf:.3f} "
                       f"(visits {cj}:{ci})")

@@ -35,10 +35,10 @@ from ..models.build import (_resolve_params, basis_static, collect_params,
                             white_static)
 from ..ops.kernel import whiten_inputs
 from ..ops.spectra import powerlaw_psd
-from ..parallel.orf import hd_matrix, orf_matrix
+from ..parallel.orf import orf_matrix
+from ..parallel.pta import _TM_PHI
 from .core import EnterpriseWarpResult
 
-_TM_PHI = 1.0e30   # see parallel.pta: must stay inside f32 exponent range
 _GAMMA_GW = 13.0 / 3.0
 
 
@@ -132,8 +132,7 @@ def make_os_fn(psrs, termlists, fixed_values=None, gamma_gw=_GAMMA_GW):
 
 def combine_os(rho, sig, xi, orf_name, pos):
     """Pair statistics -> (A2, A2_err, SNR) for one ORF."""
-    g = orf_matrix(orf_name, pos) if orf_name != "hd" \
-        else hd_matrix(pos, auto=True)
+    g = orf_matrix(orf_name, pos)
     npsr = len(pos)
     gvals = np.array([g[a, b] for a in range(npsr)
                       for b in range(a + 1, npsr)])

@@ -1,0 +1,16 @@
+"""Observability utilities: structured logging, phase timers, throughput
+meters, and JAX profiler hooks.
+
+The reference has no tracing/profiling subsystem at all — observability is
+bare ``print()`` calls throughout (e.g.
+``/root/reference/enterprise_warp/enterprise_warp.py:199-201,213-251``).
+This package is the SURVEY.md §5 replacement: structured logs, per-phase
+timers, an evals/s counter (the north-star metric of BASELINE.json), and
+optional ``jax.profiler`` trace capture.
+"""
+
+from .logging import (EvalRateMeter, PhaseTimer, get_logger, log_phase,
+                      profiler_trace)
+
+__all__ = ["get_logger", "PhaseTimer", "EvalRateMeter", "log_phase",
+           "profiler_trace"]

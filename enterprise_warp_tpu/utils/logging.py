@@ -1,0 +1,124 @@
+"""Structured logging, phase timing, and profiler capture.
+
+Replaces the reference's print-based observability (SURVEY.md §5; e.g.
+``/root/reference/enterprise_warp/enterprise_warp.py:199-201``) with:
+
+- ``get_logger`` — stdlib logging with a single uniform format, level
+  controlled by the ``EWT_LOG`` environment variable;
+- ``PhaseTimer`` / ``log_phase`` — named wall-clock phases (data load,
+  compile, sample, postprocess) reported on exit;
+- ``EvalRateMeter`` — likelihood-evaluations-per-second counter, the
+  north-star metric from BASELINE.json;
+- ``profiler_trace`` — context manager around ``jax.profiler.trace`` for
+  on-demand TPU traces (no-op when no directory is given).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+
+_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+_configured = False
+
+
+def get_logger(name: str = "ewt") -> logging.Logger:
+    """Process-wide logger; level from ``EWT_LOG`` (default INFO)."""
+    global _configured
+    if not _configured:
+        level = os.environ.get("EWT_LOG", "INFO").upper()
+        logging.basicConfig(level=getattr(logging, level, logging.INFO),
+                            format=_FORMAT)
+        _configured = True
+    return logging.getLogger(name)
+
+
+class PhaseTimer:
+    """Accumulates named wall-clock phases.
+
+    >>> timer = PhaseTimer()
+    >>> with timer.phase("compile"):
+    ...     pass
+    >>> timer.report()     # doctest: +SKIP
+    """
+
+    def __init__(self, logger: logging.Logger | None = None):
+        self.durations: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+        self._log = logger
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            dt = time.perf_counter() - t0
+            self.durations[name] = self.durations.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+            if self._log is not None:
+                self._log.info("phase %s: %.3fs (total %.3fs over %d)",
+                               name, dt, self.durations[name],
+                               self.counts[name])
+
+    def report(self) -> dict:
+        return dict(self.durations)
+
+
+@contextlib.contextmanager
+def log_phase(name: str, logger: logging.Logger | None = None):
+    """One-off named phase logged on exit."""
+    log = logger or get_logger()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        log.info("phase %s: %.3fs", name, time.perf_counter() - t0)
+
+
+class EvalRateMeter:
+    """Likelihood-evals/s counter (BASELINE.json north-star metric).
+
+    ``add(n)`` after each batched likelihood call; ``rate()`` is the
+    cumulative throughput, ``window_rate()`` the rate since the last call
+    to ``window_rate``.
+    """
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.total = 0
+        self._win_t = self.t0
+        self._win_n = 0
+
+    def add(self, nevals: int):
+        self.total += int(nevals)
+        self._win_n += int(nevals)
+
+    def rate(self) -> float:
+        dt = time.perf_counter() - self.t0
+        return self.total / dt if dt > 0 else 0.0
+
+    def window_rate(self) -> float:
+        now = time.perf_counter()
+        dt = now - self._win_t
+        out = self._win_n / dt if dt > 0 else 0.0
+        self._win_t, self._win_n = now, 0
+        return out
+
+
+@contextlib.contextmanager
+def profiler_trace(trace_dir: str | None):
+    """Capture a ``jax.profiler`` trace into ``trace_dir`` (no-op if None).
+
+    The resulting trace opens in TensorBoard / Perfetto — the TPU-native
+    replacement for the observability the reference never had.
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
