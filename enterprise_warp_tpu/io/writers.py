@@ -13,6 +13,7 @@ and tests.
 
 from __future__ import annotations
 
+import copy
 import math
 import os
 
@@ -63,7 +64,10 @@ def write_par(par: ParFile, path: str):
                 "PX", "PB", "A1", "ECC", "T0", "OM"):
         attr = key.lower()
         val = par.raw.get(key, getattr(par, attr, 0.0))
-        if float(val) != 0.0 or key == "F0":
+        # zero-valued params are still emitted when present in the source
+        # or marked for fitting (their design-matrix column must survive)
+        if float(val) != 0.0 or key == "F0" or key in par.raw \
+                or par.fit_flags.get(key):
             emit(key, repr(float(val)) if key not in par.raw else val)
     for key in ("PEPOCH", "POSEPOCH", "DMEPOCH", "TZRMJD", "TZRFRQ"):
         attr = key.lower()
@@ -76,6 +80,12 @@ def write_par(par: ParFile, path: str):
                      ("CLK", par.clk)):
         if val:
             emit(key, val, fit=False)
+    # pass through every remaining raw key so real .par metadata
+    # (START/FINISH, TRES, NE_SW, BINARY, ...) survives the round trip
+    handled = {ln.split()[0] for ln in lines} | {"PSR"}
+    for key, val in par.raw.items():
+        if key not in handled:
+            emit(key, val)
     for jmp in par.jumps:
         lines.append(f"JUMP -{jmp.flag} {jmp.flagval} {jmp.value!r} "
                      f"{1 if jmp.fit else 0}")
@@ -181,6 +191,9 @@ def save_pulsar_pair(psr: Pulsar, datadir: str, apply_residuals=True):
     os.makedirs(datadir, exist_ok=True)
     par = psr.par if (psr.par and psr.par.raw) else _synthesize_par(psr)
     if not par.fit_flags.get("F0"):
+        # never mutate the caller's ParFile: adjust a shallow working copy
+        par = copy.copy(par)
+        par.fit_flags = dict(par.fit_flags)
         par.fit_flags["F0"] = True
         par.fit_flags["F1"] = True
     parfile = os.path.join(datadir, f"{psr.name}.par")

@@ -118,6 +118,47 @@ def _gram_pair(S, B, mode):
     return chunked(Sh, Bh) + chunked(Sh, Bl) + chunked(Sl, Bh)
 
 
+# Fallback Cholesky jitter per gram mode, applied to the *unit-diagonal
+# equilibrated* matrix only when the plain factorization fails: bounds the
+# effective condition number at 1/jitter so Gram error (split/f32: set by
+# f32 accumulation within a _CHUNK-row partial sum, ~1e-7..1e-6
+# equilibrated-relative) degrades to a regularized solve instead of a
+# -inf rejection of a possibly high-likelihood point. f64 has no Gram
+# noise — its only failures are genuine condition > 1e16 prior corners,
+# which the NaN -> -inf guard already rejects (matching the reference
+# stack, where scipy's Cholesky raises there) — so it skips the fallback
+# and its second factorization entirely.
+CHOL_JITTER = {"split": 3.0e-6, "f32": 1.0e-5, "f64": 0.0}
+
+
+def equilibrated_cholesky(S, jitter):
+    """Cholesky of a symmetric PD matrix via unit-diagonal equilibration,
+    with an on-failure jitter fallback.
+
+    Returns ``(L, s, logdet)`` with ``L`` the Cholesky factor of
+    ``D^-1/2 S D^-1/2`` (``D = diag(S)``), ``s = D^-1/2`` and
+    ``logdet = log|S|``. A solve against ``S`` becomes
+    ``x -> s * solve(L L^T, s * x)``. Equilibration makes reduced-precision
+    Gram error relative to the *diagonal* rather than the largest matrix
+    entry. When the plain factorization fails (Gram error or genuine
+    condition numbers beyond the dtype made the matrix numerically
+    indefinite), the jittered factor ``chol(. + jitter*I)`` is substituted
+    — so well-conditioned evaluations pay zero accuracy cost and prior
+    corners degrade to a condition-bounded solve instead of ``-inf``.
+    """
+    d = jnp.maximum(jnp.diagonal(S), 1e-30)
+    s = 1.0 / jnp.sqrt(d)
+    Sn = S * s[:, None] * s[None, :]
+    L = jnp.linalg.cholesky(Sn)
+    if jitter:
+        bad = ~jnp.all(jnp.isfinite(L))
+        Lj = jnp.linalg.cholesky(
+            Sn + jitter * jnp.eye(S.shape[-1], dtype=S.dtype))
+        L = jnp.where(bad, Lj, L)
+    logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(L))) + jnp.sum(jnp.log(d))
+    return L, s, logdet
+
+
 @partial(jax.jit, static_argnames=("gram_mode",))
 def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split"):
     """Marginalized GP log-likelihood for one pulsar at one parameter point.
@@ -169,20 +210,19 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split"):
     q = q.astype(f64)
     b = b.astype(f64)
 
+    jitter = CHOL_JITTER[gram_mode]
     Sigma = G + jnp.diag(1.0 / b)
-    L = jnp.linalg.cholesky(Sigma)
-    u = jax.scipy.linalg.solve_triangular(L, X, lower=True)
-    V = jax.scipy.linalg.solve_triangular(L, H, lower=True)
+    L, sS, logdet_sigma = equilibrated_cholesky(Sigma, jitter)
+    u = jax.scipy.linalg.solve_triangular(L, sS * X, lower=True)
+    V = jax.scipy.linalg.solve_triangular(L, sS[:, None] * H, lower=True)
 
     A = P - V.T @ V
     y = q - V.T @ u
-    LA = jnp.linalg.cholesky(A)
-    z = jax.scipy.linalg.solve_triangular(LA, y, lower=True)
+    LA, sA, logdet_a = equilibrated_cholesky(A, CHOL_JITTER[side_mode])
+    z = jax.scipy.linalg.solve_triangular(LA, sA * y, lower=True)
 
     quad = rwr - u @ u - z @ z
     logdet_n = jnp.sum(jnp.log(nw) * (mask if mask is not None else 1.0))
-    logdet_sigma = 2.0 * jnp.sum(jnp.log(jnp.diagonal(L)))
     logdet_b = jnp.sum(jnp.log(b))
-    logdet_a = 2.0 * jnp.sum(jnp.log(jnp.diagonal(LA)))
 
     return -0.5 * (quad + logdet_n + logdet_b + logdet_sigma + logdet_a)

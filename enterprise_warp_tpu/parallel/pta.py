@@ -41,7 +41,8 @@ from ..models.build import (_resolve_params, basis_static, collect_params,
                             eval_block_phi, eval_nw, lower_terms,
                             param_value, white_static)
 from ..models.prior_mixin import PriorMixin
-from ..ops.kernel import _HIGH, _gram_pair, whiten_inputs
+from ..ops.kernel import (CHOL_JITTER, _HIGH, _gram_pair,
+                          equilibrated_cholesky, whiten_inputs)
 from .orf import is_positive_definite, orf_matrix
 
 # Improper-flat-prior stand-in for timing-model columns. Kept inside the
@@ -318,12 +319,12 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
             rows, cols = scatter_idx[ci]
             Sigma = Sigma.at[rows, cols].add(Binv)
 
-        # --- joint solve -------------------------------------------------
-        L = jnp.linalg.cholesky(Sigma)
-        u = jax.scipy.linalg.solve_triangular(L, X.reshape(n_tot),
+        # --- joint solve (equilibrated: see ops.kernel) ------------------
+        L, sS, logdet_sigma = equilibrated_cholesky(
+            Sigma, CHOL_JITTER[gram_mode])
+        u = jax.scipy.linalg.solve_triangular(L, sS * X.reshape(n_tot),
                                               lower=True)
         quad = rwr - u @ u
-        logdet_sigma = 2.0 * jnp.sum(jnp.log(jnp.diagonal(L)))
         lnl = -0.5 * (quad + logdet_n + logphi + logdet_b + logdet_sigma)
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
 

@@ -1,0 +1,116 @@
+"""Generate the shipped example datasets (deterministic).
+
+The reference ships real PPTA data (``/root/reference/examples/data/``:
+a multi-backend pulsar + a synthetic single-backend one). This repo's
+fixtures are *generated* instead — same shape and role, fully synthetic —
+through the framework's own simulation + writer path, so the examples also
+double as a round-trip check:
+
+- ``fake_psr_0``   — 122 evenly spaced single-backend (AXIS) TOAs with
+  white + spin noise (the minimum end-to-end slice of SURVEY.md §7.2);
+- ``J1234-5678``   — 334 TOAs across four backends/three bands with
+  ``-group``/``-f``/``-B`` flags, per-backend white noise plus spin and DM
+  noise; ground truth is written to
+  ``example_noisefiles/J1234-5678_noise.json`` (PAL2 format).
+
+Run from the ``examples/`` directory: ``python make_example_data.py``.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from enterprise_warp_tpu.io import save_pulsar_pair
+from enterprise_warp_tpu.sim.noise import (inject_basis_process,
+                                           inject_white, make_fake_pulsar)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# (backend, band, frequency MHz, fraction of TOAs)
+BACKENDS = (
+    ("CPSR2_20CM", "20CM", 1369.0, 0.35),
+    ("CPSR2_50CM", "50CM", 685.0, 0.20),
+    ("CASPSR_40CM", "40CM", 728.0, 0.20),
+    ("PDFB_10CM", "10CM", 3100.0, 0.25),
+)
+TRUTH = {
+    "J1234-5678_CPSR2_20CM_efac": 1.10,
+    "J1234-5678_CPSR2_50CM_efac": 1.35,
+    "J1234-5678_CASPSR_40CM_efac": 0.95,
+    "J1234-5678_PDFB_10CM_efac": 1.05,
+    "J1234-5678_CPSR2_20CM_log10_equad": -6.6,
+    "J1234-5678_CPSR2_50CM_log10_equad": -6.2,
+    "J1234-5678_CASPSR_40CM_log10_equad": -6.9,
+    "J1234-5678_PDFB_10CM_log10_equad": -7.0,
+    "J1234-5678_red_noise_log10_A": -13.3,
+    "J1234-5678_red_noise_gamma": 3.8,
+    "J1234-5678_dm_gp_log10_A": -13.6,
+    "J1234-5678_dm_gp_gamma": 2.9,
+}
+
+
+def make_fake_psr_0(datadir):
+    # file stem 'fake_psr_0' with a proper J-name inside (the reference
+    # fixture follows the same convention; results-dir matching needs the
+    # J-name)
+    psr = make_fake_pulsar(name="J0042-0000", ntoa=122, cadence_days=30.0,
+                           toaerr_us=1.0, backends=("AXIS",),
+                           freqs_mhz=1400.0, seed=10)
+    inject_white(psr, efac=1.0, rng=np.random.default_rng(11))
+    inject_basis_process(psr, -12.9, 3.5, components=20,
+                         rng=np.random.default_rng(12))
+    parfile, timfile = save_pulsar_pair(psr, datadir)
+    for src in (parfile, timfile):
+        dst = os.path.join(datadir, "fake_psr_0" + os.path.splitext(src)[1])
+        os.replace(src, dst)
+
+
+def make_multibackend(datadir, noisedir):
+    rng = np.random.default_rng(20)
+    ntoa = 334
+    psr = make_fake_pulsar(name="J1234-5678", ntoa=ntoa, cadence_days=12.0,
+                           toaerr_us=1.5, backends=("X",), seed=21,
+                           raj=3.29, decj=-0.99)
+    # impose the backend/band structure on flags and frequencies
+    probs = np.array([b[3] for b in BACKENDS])
+    choice = rng.choice(len(BACKENDS), ntoa, p=probs / probs.sum())
+    groups = np.array([BACKENDS[i][0] for i in choice], dtype=object)
+    bands = np.array([BACKENDS[i][1] for i in choice], dtype=object)
+    psr.freqs = np.array([BACKENDS[i][2] for i in choice]) \
+        * rng.uniform(0.98, 1.02, ntoa)
+    psr.flags = {"f": groups.copy(), "group": groups.copy(), "B": bands}
+    psr.backend_flags = groups.copy()
+    psr.toaerrs = psr.toaerrs * rng.uniform(0.6, 1.8, ntoa)
+
+    efac = {b[0]: TRUTH[f"J1234-5678_{b[0]}_efac"] for b in BACKENDS}
+    equad = {b[0]: TRUTH[f"J1234-5678_{b[0]}_log10_equad"]
+             for b in BACKENDS}
+    inject_white(psr, efac=efac, flag="group",
+                 rng=np.random.default_rng(22))
+    inject_white(psr, efac=0.0, equad_log10=equad, flag="group",
+                 rng=np.random.default_rng(23))
+    inject_basis_process(psr, TRUTH["J1234-5678_red_noise_log10_A"],
+                         TRUTH["J1234-5678_red_noise_gamma"],
+                         components=30, rng=np.random.default_rng(24))
+    inject_basis_process(psr, TRUTH["J1234-5678_dm_gp_log10_A"],
+                         TRUTH["J1234-5678_dm_gp_gamma"],
+                         components=30, chromatic_idx=2.0,
+                         rng=np.random.default_rng(25))
+    save_pulsar_pair(psr, datadir)
+
+    os.makedirs(noisedir, exist_ok=True)
+    with open(os.path.join(noisedir, "J1234-5678_noise.json"), "w") as fh:
+        json.dump(TRUTH, fh, indent=2)
+
+
+def main():
+    datadir = os.path.join(HERE, "data")
+    noisedir = os.path.join(HERE, "example_noisefiles")
+    make_fake_psr_0(datadir)
+    make_multibackend(datadir, noisedir)
+    print(f"wrote fixtures to {datadir} and {noisedir}")
+
+
+if __name__ == "__main__":
+    main()

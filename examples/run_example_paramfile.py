@@ -1,0 +1,16 @@
+"""The de-facto main entry point, as in the reference
+(``/root/reference/examples/run_example_paramfile.py``): parse a paramfile,
+build the model likelihood(s), and dispatch to the sampler branch —
+adaptive PT-MCMC for ``ptmcmcsampler`` (product-space hypermodel when the
+paramfile defines >= 2 models), the native JAX nested sampler for nested
+names (dynesty/nestle/...). All branch logic lives in
+``enterprise_warp_tpu.cli`` (also installed as ``ewt-run``).
+
+    python run_example_paramfile.py --prfile example_params/default_hypermodel.dat --num 0
+    python -m enterprise_warp_tpu.results --result out/... --corner 1 --logbf 1
+"""
+
+from enterprise_warp_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())
