@@ -62,8 +62,26 @@ def _split_mjd(text: str):
     return int(text), 0.0
 
 
-def parse_tim(path: str) -> TimFile:
-    """Parse a tempo2 FORMAT-1 .tim file (recursing into INCLUDEs)."""
+def parse_tim(path: str, engine: str = "auto") -> TimFile:
+    """Parse a tempo2 FORMAT-1 .tim file (recursing into INCLUDEs).
+
+    ``engine``: 'auto' prefers the native C++ core (``native/fastio.cpp``,
+    built on demand) and falls back to this module's Python implementation,
+    which remains the behavioral oracle; 'python' forces the fallback.
+    """
+    if engine == "auto":
+        from ..native import parse_tim_native
+
+        parsed = parse_tim_native(path)
+        if parsed is not None:
+            freqs, mjd_i, sec, errs, names, sites, flags = parsed
+            tf = TimFile(
+                names=np.array(names, dtype=object),
+                freqs=freqs, mjd_int=mjd_i, sec=sec, errs=errs,
+                sites=np.array(sites, dtype=object))
+            tf.flags.update(flags)
+            return tf
+
     names, freqs, mjd_i, secs, errs, sites = [], [], [], [], [], []
     flag_rows: list[dict] = []
 

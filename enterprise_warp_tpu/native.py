@@ -1,0 +1,140 @@
+"""ctypes binding for the native IO core (``native/fastio.cpp``).
+
+The reference's data path runs on native code (tempo2 C++ under
+subprocess, libstempo Cython); here the native IO core is optional but
+preferred: ``load()`` returns the bound library, building it with ``make``
+on first use when a toolchain is available, and ``None`` otherwise — every
+caller has a pure-Python fallback (``io/tim.py``, ``results/core.py``)
+that doubles as the behavioral oracle in tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_PKG_DIR, "_fastio.so")
+_SRC_DIR = os.path.join(os.path.dirname(_PKG_DIR), "native")
+
+
+def _bind(lib):
+    c_dp = ctypes.POINTER(ctypes.c_double)
+    c_ip = ctypes.POINTER(ctypes.c_int64)
+    lib.ewt_tim_parse.argtypes = [ctypes.c_char_p]
+    lib.ewt_tim_parse.restype = ctypes.c_void_p
+    lib.ewt_tim_error.argtypes = [ctypes.c_void_p]
+    lib.ewt_tim_error.restype = ctypes.c_char_p
+    lib.ewt_tim_ntoa.argtypes = [ctypes.c_void_p]
+    lib.ewt_tim_ntoa.restype = ctypes.c_longlong
+    lib.ewt_tim_fill.argtypes = [ctypes.c_void_p, c_dp, c_ip, c_dp, c_dp]
+    lib.ewt_tim_strsize.argtypes = [ctypes.c_void_p]
+    lib.ewt_tim_strsize.restype = ctypes.c_longlong
+    lib.ewt_tim_strs.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ewt_tim_free.argtypes = [ctypes.c_void_p]
+    lib.ewt_read_table.argtypes = [ctypes.c_char_p, c_dp,
+                                   ctypes.c_longlong,
+                                   ctypes.POINTER(ctypes.c_longlong)]
+    lib.ewt_read_table.restype = ctypes.c_longlong
+    return lib
+
+
+def load():
+    """The bound native library, or None (pure-Python fallback)."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("EWT_NO_NATIVE"):
+        return None
+    if os.path.isdir(_SRC_DIR):
+        # always invoke make: a no-op when the .so is fresh, and a rebuild
+        # when fastio.cpp changed (a stale binary would silently win
+        # otherwise). Build failure with an existing .so keeps the old one.
+        try:
+            subprocess.run(["make", "-C", _SRC_DIR], capture_output=True,
+                           timeout=120, check=True)
+        except (OSError, subprocess.SubprocessError):
+            pass
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        _LIB = _bind(ctypes.CDLL(_SO_PATH))
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def parse_tim_native(path: str):
+    """Parse a .tim via the native core.
+
+    Returns ``(freqs, mjd_int, sec, errs, names, sites, flags)`` — flags
+    already columnarized as ``{flag: (ntoa,) object array}`` — or None
+    when the native core is unavailable; raises ValueError on parse errors
+    (unreadable file, cyclic INCLUDE).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    h = lib.ewt_tim_parse(path.encode())
+    try:
+        err = lib.ewt_tim_error(h)
+        if err:
+            msg = err.decode()
+            if msg.startswith("cannot open"):
+                # keep the exception contract of the Python engine
+                raise FileNotFoundError(msg)
+            raise ValueError(msg)
+        n = int(lib.ewt_tim_ntoa(h))
+        freqs = np.empty(n)
+        mjd_i = np.empty(n, dtype=np.int64)
+        sec = np.empty(n)
+        errs = np.empty(n)
+        if n:
+            lib.ewt_tim_fill(
+                h,
+                freqs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                mjd_i.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                sec.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                errs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        size = int(lib.ewt_tim_strsize(h))
+        raw = ctypes.create_string_buffer(size)
+        lib.ewt_tim_strs(h, raw)
+        blocks = bytes(raw.raw[:size]).split(b"\0")
+        names = blocks[0].decode().splitlines()
+        sites = blocks[1].decode().splitlines()
+        flags = {}
+        for blk in blocks[2:]:
+            if not blk:
+                continue
+            lines = blk.decode().split("\n")
+            flags[lines[0]] = np.array(lines[1:n + 1], dtype=object)
+        return freqs, mjd_i, sec, errs, names, sites, flags
+    finally:
+        lib.ewt_tim_free(h)
+
+
+def read_table_native(path: str):
+    """Fast numeric-table read (chain files). Returns a 2-D array or None
+    when unavailable/ambiguous (caller falls back to np.loadtxt)."""
+    lib = load()
+    if lib is None:
+        return None
+    ncols = ctypes.c_longlong(0)
+    total = lib.ewt_read_table(path.encode(), None, 0,
+                               ctypes.byref(ncols))
+    if total <= 0 or ncols.value <= 0 or total % ncols.value != 0:
+        return None
+    out = np.empty(int(total))
+    got = lib.ewt_read_table(
+        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        total, None)
+    if got != total:
+        return None
+    return out.reshape(-1, int(ncols.value))

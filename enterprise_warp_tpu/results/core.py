@@ -71,6 +71,15 @@ def parse_commandline(argv=None):
     return p.parse_args(argv)
 
 
+def _read_table(path):
+    """Numeric table read: native fast path (chain files are the results
+    layer's IO hotspot), np.loadtxt fallback."""
+    from ..native import read_table_native
+
+    out = read_table_native(str(path))
+    return out if out is not None else np.loadtxt(path)
+
+
 def check_if_psr_dir(folder_name: str) -> bool:
     """``<int>_<J|B name>`` pulsar-directory convention (reference
     ``results.py:236-242``)."""
@@ -184,9 +193,9 @@ class EnterpriseWarpResult:
         if chain_file is None:
             return None
         if isinstance(chain_file, list):
-            chain = np.vstack([np.loadtxt(f) for f in chain_file])
+            chain = np.vstack([_read_table(f) for f in chain_file])
         else:
-            chain = np.loadtxt(chain_file)
+            chain = _read_table(chain_file)
         chain = np.atleast_2d(chain)
         burn = int(_BURN_FRACTION * len(chain))
         chain = chain[burn:]
@@ -372,7 +381,7 @@ class EnterpriseWarpResult:
         chain_file = self.get_chain_file_name(psr_dir)
         if chain_file is None or isinstance(chain_file, list):
             return
-        chain = np.atleast_2d(np.loadtxt(chain_file))
+        chain = np.atleast_2d(_read_table(chain_file))
         ncut = int(frac * len(chain))
         if ncut == 0:
             return

@@ -1,0 +1,254 @@
+// Native IO core: tempo2 FORMAT-1 .tim parsing and fast float-table
+// reading, exposed through a C ABI consumed via ctypes
+// (enterprise_warp_tpu/native.py).
+//
+// Role: the reference's data ingestion runs on native code — tempo2 (C++,
+// via subprocess at /root/reference/enterprise_warp/tempo2_warp.py:28-41)
+// and libstempo (Cython over tempo2). This framework's compute path is
+// JAX; the IO runtime around it is likewise native. The Python parser in
+// io/tim.py stays as the behavioral oracle and fallback — both sides are
+// tested for exact agreement on the shipped fixtures.
+//
+// Grammar handled (mirrors io/tim.py): one TOA per line
+//   <name> <freq MHz> <MJD> <err us> <site> [-flag value]...
+// with FORMAT/MODE headers, INCLUDE recursion (depth-capped), '#'/'C '
+// comments, and valueless flags ("-flag" followed by another flag or EOL
+// meaning "1"). MJDs are split two-part (int day, float64
+// seconds-of-day) losslessly.
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct TimData {
+    std::vector<double> freqs, sec, errs;
+    std::vector<int64_t> mjd_i;
+    // string columns serialized for the binding: names and sites
+    // '\n'-joined; flags columnarized (flag -> per-TOA value, "" = absent)
+    std::string names, sites;
+    std::map<std::string, std::vector<std::string>> flagcols;
+    std::string error;
+};
+
+bool is_flag_tok(const char* t, size_t n) {
+    if (n < 2 || t[0] != '-') return false;
+    return !(std::isdigit((unsigned char)t[1]) || t[1] == '.');
+}
+
+void split_mjd(const char* tok, int64_t* day, double* sec) {
+    const char* dot = std::strchr(tok, '.');
+    if (!dot) {
+        *day = std::atoll(tok);
+        *sec = 0.0;
+        return;
+    }
+    std::string ip(tok, dot - tok);
+    std::string fp(dot);            // ".xxxxx"
+    *day = std::atoll(ip.c_str());
+    *sec = std::strtod(fp.c_str(), nullptr) * 86400.0;
+}
+
+void parse_file(const std::string& path, TimData* td, int depth) {
+    if (depth > 16) {
+        td->error = "INCLUDE nesting deeper than 16 at " + path;
+        return;
+    }
+    FILE* fh = std::fopen(path.c_str(), "rb");
+    if (!fh) {
+        td->error = "cannot open " + path;
+        return;
+    }
+    std::string dir;
+    size_t slash = path.find_last_of('/');
+    if (slash != std::string::npos) dir = path.substr(0, slash + 1);
+
+    std::string line;
+    std::vector<char> buf(1 << 16);
+    while (std::fgets(buf.data(), (int)buf.size(), fh)) {
+        line.assign(buf.data());
+        // strip trailing newline/CR
+        while (!line.empty() &&
+               (line.back() == '\n' || line.back() == '\r'))
+            line.pop_back();
+        // tokenize on whitespace
+        std::vector<std::pair<const char*, size_t>> toks;
+        const char* p = line.c_str();
+        while (*p) {
+            while (*p && std::isspace((unsigned char)*p)) ++p;
+            if (!*p) break;
+            const char* start = p;
+            while (*p && !std::isspace((unsigned char)*p)) ++p;
+            toks.emplace_back(start, (size_t)(p - start));
+        }
+        if (toks.empty()) continue;
+        std::string head(toks[0].first, toks[0].second);
+        if (head[0] == '#') continue;
+        if ((head == "C" || head == "CN") && toks.size() > 1) continue;
+        for (auto& c : head) c = (char)std::toupper((unsigned char)c);
+        if (head == "FORMAT" || head == "MODE") continue;
+        if (head == "INCLUDE" && toks.size() >= 2) {
+            std::string inc(toks[1].first, toks[1].second);
+            if (!inc.empty() && inc[0] != '/') inc = dir + inc;
+            parse_file(inc, td, depth + 1);
+            if (!td->error.empty()) { std::fclose(fh); return; }
+            continue;
+        }
+        if (toks.size() < 5) continue;
+
+        std::string t1(toks[1].first, toks[1].second);
+        std::string t2(toks[2].first, toks[2].second);
+        std::string t3(toks[3].first, toks[3].second);
+        td->names.append(toks[0].first, toks[0].second);
+        td->names.push_back('\n');
+        td->freqs.push_back(std::strtod(t1.c_str(), nullptr));
+        int64_t day; double sec;
+        split_mjd(t2.c_str(), &day, &sec);
+        td->mjd_i.push_back(day);
+        td->sec.push_back(sec);
+        td->errs.push_back(std::strtod(t3.c_str(), nullptr));
+        td->sites.append(toks[4].first, toks[4].second);
+        td->sites.push_back('\n');
+
+        size_t toa_idx = td->freqs.size() - 1;
+        size_t i = 5;
+        while (i < toks.size()) {
+            if (is_flag_tok(toks[i].first, toks[i].second)) {
+                std::string key(toks[i].first + 1, toks[i].second - 1);
+                auto& col = td->flagcols[key];
+                col.resize(toa_idx + 1);      // backfill "" for older TOAs
+                if (i + 1 < toks.size() &&
+                    !is_flag_tok(toks[i + 1].first, toks[i + 1].second)) {
+                    col[toa_idx].assign(toks[i + 1].first,
+                                        toks[i + 1].second);
+                    i += 2;
+                } else {
+                    col[toa_idx] = "1";
+                    i += 1;
+                }
+            } else {
+                i += 1;
+            }
+        }
+    }
+    std::fclose(fh);
+}
+
+}  // namespace
+
+extern "C" {
+
+TimData* ewt_tim_parse(const char* path) {
+    TimData* td = new TimData();
+    parse_file(path, td, 0);
+    return td;
+}
+
+const char* ewt_tim_error(TimData* td) {
+    return td->error.empty() ? nullptr : td->error.c_str();
+}
+
+long long ewt_tim_ntoa(TimData* td) {
+    return (long long)td->freqs.size();
+}
+
+void ewt_tim_fill(TimData* td, double* freqs, int64_t* mjd_i, double* sec,
+                  double* errs) {
+    size_t n = td->freqs.size();
+    std::memcpy(freqs, td->freqs.data(), n * sizeof(double));
+    std::memcpy(mjd_i, td->mjd_i.data(), n * sizeof(int64_t));
+    std::memcpy(sec, td->sec.data(), n * sizeof(double));
+    std::memcpy(errs, td->errs.data(), n * sizeof(double));
+}
+
+long long ewt_tim_strsize(TimData* td) {
+    size_t n = td->freqs.size();
+    size_t total = td->names.size() + 1 + td->sites.size() + 1;
+    for (auto& kv : td->flagcols) {
+        total += kv.first.size() + 1;          // flag name + '\n'
+        for (size_t i = 0; i < n; ++i)
+            total += (i < kv.second.size() ? kv.second[i].size() : 0) + 1;
+        total += 1;                            // '\0' block terminator
+    }
+    return (long long)total;
+}
+
+// Layout: names-block '\0' sites-block '\0' then per flag:
+// "<flag>\n<v0>\n...<v_{n-1}>\n" '\0'  (columnarized; "" = flag absent)
+void ewt_tim_strs(TimData* td, char* out) {
+    size_t n = td->freqs.size();
+    std::memcpy(out, td->names.data(), td->names.size());
+    out += td->names.size();
+    *out++ = '\0';
+    std::memcpy(out, td->sites.data(), td->sites.size());
+    out += td->sites.size();
+    *out++ = '\0';
+    for (auto& kv : td->flagcols) {
+        std::memcpy(out, kv.first.data(), kv.first.size());
+        out += kv.first.size();
+        *out++ = '\n';
+        for (size_t i = 0; i < n; ++i) {
+            if (i < kv.second.size()) {
+                std::memcpy(out, kv.second[i].data(),
+                            kv.second[i].size());
+                out += kv.second[i].size();
+            }
+            *out++ = '\n';
+        }
+        *out++ = '\0';
+    }
+}
+
+void ewt_tim_free(TimData* td) { delete td; }
+
+// ---- fast whitespace-separated float table (chain files) -------------
+// Two-call protocol: first with out == nullptr to get the value count
+// (and column count of the first row), then with a buffer to fill.
+// Rows whose parse fails are skipped, matching np.loadtxt strictness
+// loosely enough for PTMCMC chain files (pure numeric).
+
+long long ewt_read_table(const char* path, double* out,
+                         long long max_vals, long long* ncols) {
+    FILE* fh = std::fopen(path, "rb");
+    if (!fh) return -1;
+    std::vector<char> buf(1 << 20);
+    long long count = 0, cols0 = 0;
+    while (std::fgets(buf.data(), (int)buf.size(), fh)) {
+        const char* p = buf.data();
+        long long row = 0;
+        long long row_start = count;
+        while (*p) {
+            while (*p && std::isspace((unsigned char)*p)) ++p;
+            if (!*p || *p == '#') break;
+            char* end = nullptr;
+            double v = std::strtod(p, &end);
+            if (end == p) { row = -1; break; }   // non-numeric token
+            if (out) {
+                if (count >= max_vals) { std::fclose(fh); return count; }
+                out[count] = v;
+            }
+            ++count;
+            ++row;
+            p = end;
+        }
+        if (row < 0) { count = row_start; continue; }  // drop partial row
+        if (row > 0) {
+            if (cols0 == 0) cols0 = row;
+            else if (row != cols0) {             // ragged table: reject,
+                std::fclose(fh);                 // matching np.loadtxt
+                return -2;
+            }
+        }
+    }
+    std::fclose(fh);
+    if (ncols) *ncols = cols0;
+    return count;
+}
+
+}  // extern "C"
