@@ -69,6 +69,9 @@ def parse_tim(path: str, engine: str = "auto") -> TimFile:
     built on demand) and falls back to this module's Python implementation,
     which remains the behavioral oracle; 'python' forces the fallback.
     """
+    if engine not in ("auto", "python"):
+        raise ValueError(f"unknown engine {engine!r}: use 'auto' "
+                         "(native with Python fallback) or 'python'")
     if engine == "auto":
         from ..native import parse_tim_native
 

@@ -38,10 +38,14 @@ def _bind(lib):
     lib.ewt_tim_strsize.restype = ctypes.c_longlong
     lib.ewt_tim_strs.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ewt_tim_free.argtypes = [ctypes.c_void_p]
-    lib.ewt_read_table.argtypes = [ctypes.c_char_p, c_dp,
-                                   ctypes.c_longlong,
-                                   ctypes.POINTER(ctypes.c_longlong)]
-    lib.ewt_read_table.restype = ctypes.c_longlong
+    lib.ewt_table_read.argtypes = [ctypes.c_char_p]
+    lib.ewt_table_read.restype = ctypes.c_void_p
+    lib.ewt_table_size.argtypes = [ctypes.c_void_p]
+    lib.ewt_table_size.restype = ctypes.c_longlong
+    lib.ewt_table_ncols.argtypes = [ctypes.c_void_p]
+    lib.ewt_table_ncols.restype = ctypes.c_longlong
+    lib.ewt_table_fill.argtypes = [ctypes.c_void_p, c_dp]
+    lib.ewt_table_free.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -60,6 +64,11 @@ def load():
         try:
             subprocess.run(["make", "-C", _SRC_DIR], capture_output=True,
                            timeout=120, check=True)
+        except subprocess.CalledProcessError as exc:
+            from .utils import get_logger
+            get_logger("ewt.native").warning(
+                "native core build failed (falling back to Python IO): "
+                "%s", (exc.stderr or b"").decode(errors="replace")[-500:])
         except (OSError, subprocess.SubprocessError):
             pass
     if not os.path.exists(_SO_PATH):
@@ -121,20 +130,22 @@ def parse_tim_native(path: str):
 
 
 def read_table_native(path: str):
-    """Fast numeric-table read (chain files). Returns a 2-D array or None
-    when unavailable/ambiguous (caller falls back to np.loadtxt)."""
+    """Fast numeric-table read (chain files). Returns a 2-D array, or
+    None when the native core is unavailable or the file is not a clean
+    numeric table (non-numeric token, ragged row) — the caller's
+    np.loadtxt fallback then applies its own strict error semantics."""
     lib = load()
     if lib is None:
         return None
-    ncols = ctypes.c_longlong(0)
-    total = lib.ewt_read_table(path.encode(), None, 0,
-                               ctypes.byref(ncols))
-    if total <= 0 or ncols.value <= 0 or total % ncols.value != 0:
-        return None
-    out = np.empty(int(total))
-    got = lib.ewt_read_table(
-        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-        total, None)
-    if got != total:
-        return None
-    return out.reshape(-1, int(ncols.value))
+    h = lib.ewt_table_read(path.encode())
+    try:
+        total = int(lib.ewt_table_size(h))
+        ncols = int(lib.ewt_table_ncols(h))
+        if total <= 0 or ncols <= 0 or total % ncols != 0:
+            return None
+        out = np.empty(total)
+        lib.ewt_table_fill(
+            h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return out.reshape(-1, ncols)
+    finally:
+        lib.ewt_table_free(h)

@@ -13,3 +13,5 @@ from .core import (EnterpriseWarpResult, estimate_from_distribution,  # noqa: F4
                    make_noise_files, parse_commandline)
 from .bilbylike import BilbyWarpResult  # noqa: F401
 from .optstat import OptimalStatisticResult, OptimalStatisticWarp  # noqa: F401
+from .reconstruct import (NoiseReconstructor,  # noqa: F401
+                          get_tempo2_prediction)

@@ -42,17 +42,32 @@ bool is_flag_tok(const char* t, size_t n) {
     return !(std::isdigit((unsigned char)t[1]) || t[1] == '.');
 }
 
-void split_mjd(const char* tok, int64_t* day, double* sec) {
-    const char* dot = std::strchr(tok, '.');
-    if (!dot) {
-        *day = std::atoll(tok);
-        *sec = 0.0;
-        return;
-    }
-    std::string ip(tok, dot - tok);
-    std::string fp(dot);            // ".xxxxx"
+// strtod over the full token; false when any character is left over —
+// the Python oracle's float() raises there, and we must match it.
+bool parse_double(const std::string& tok, double* out) {
+    if (tok.empty()) return false;
+    char* end = nullptr;
+    *out = std::strtod(tok.c_str(), &end);
+    return end == tok.c_str() + tok.size();
+}
+
+bool split_mjd(const std::string& tok, int64_t* day, double* sec) {
+    size_t dot = tok.find('.');
+    std::string ip = (dot == std::string::npos) ? tok
+                                                : tok.substr(0, dot);
+    if (ip.empty()) return false;
+    for (size_t i = (ip[0] == '-' || ip[0] == '+') ? 1 : 0;
+         i < ip.size(); ++i)
+        if (!std::isdigit((unsigned char)ip[i])) return false;
     *day = std::atoll(ip.c_str());
-    *sec = std::strtod(fp.c_str(), nullptr) * 86400.0;
+    if (dot == std::string::npos) {
+        *sec = 0.0;
+        return true;
+    }
+    double frac;
+    if (!parse_double("0" + tok.substr(dot), &frac)) return false;
+    *sec = frac * 86400.0;
+    return true;
 }
 
 void parse_file(const std::string& path, TimData* td, int depth) {
@@ -105,14 +120,21 @@ void parse_file(const std::string& path, TimData* td, int depth) {
         std::string t1(toks[1].first, toks[1].second);
         std::string t2(toks[2].first, toks[2].second);
         std::string t3(toks[3].first, toks[3].second);
+        double freq, err;
+        int64_t day; double sec;
+        if (!parse_double(t1, &freq) || !split_mjd(t2, &day, &sec) ||
+            !parse_double(t3, &err)) {
+            // malformed numeric field: fail loudly like the oracle
+            td->error = "bad numeric TOA field in " + path + ": " + line;
+            std::fclose(fh);
+            return;
+        }
         td->names.append(toks[0].first, toks[0].second);
         td->names.push_back('\n');
-        td->freqs.push_back(std::strtod(t1.c_str(), nullptr));
-        int64_t day; double sec;
-        split_mjd(t2.c_str(), &day, &sec);
+        td->freqs.push_back(freq);
         td->mjd_i.push_back(day);
         td->sec.push_back(sec);
-        td->errs.push_back(std::strtod(t3.c_str(), nullptr));
+        td->errs.push_back(err);
         td->sites.append(toks[4].first, toks[4].second);
         td->sites.push_back('\n');
 
@@ -208,47 +230,58 @@ void ewt_tim_strs(TimData* td, char* out) {
 void ewt_tim_free(TimData* td) { delete td; }
 
 // ---- fast whitespace-separated float table (chain files) -------------
-// Two-call protocol: first with out == nullptr to get the value count
-// (and column count of the first row), then with a buffer to fill.
-// Rows whose parse fails are skipped, matching np.loadtxt strictness
-// loosely enough for PTMCMC chain files (pure numeric).
+// Handle-based single-pass protocol: parse once into a heap buffer, then
+// fill/free. '#' starts a comment (np.loadtxt semantics); any non-numeric
+// token or ragged row is an error — np.loadtxt raises there, and silently
+// dropping/truncating chains would corrupt posterior statistics.
 
-long long ewt_read_table(const char* path, double* out,
-                         long long max_vals, long long* ncols) {
+struct TableData {
+    std::vector<double> vals;
+    long long ncols = 0;
+    bool error = false;
+};
+
+TableData* ewt_table_read(const char* path) {
+    TableData* td = new TableData();
     FILE* fh = std::fopen(path, "rb");
-    if (!fh) return -1;
+    if (!fh) {
+        td->error = true;
+        return td;
+    }
     std::vector<char> buf(1 << 20);
-    long long count = 0, cols0 = 0;
     while (std::fgets(buf.data(), (int)buf.size(), fh)) {
         const char* p = buf.data();
         long long row = 0;
-        long long row_start = count;
         while (*p) {
             while (*p && std::isspace((unsigned char)*p)) ++p;
             if (!*p || *p == '#') break;
             char* end = nullptr;
             double v = std::strtod(p, &end);
-            if (end == p) { row = -1; break; }   // non-numeric token
-            if (out) {
-                if (count >= max_vals) { std::fclose(fh); return count; }
-                out[count] = v;
-            }
-            ++count;
+            if (end == p) { td->error = true; break; }
+            td->vals.push_back(v);
             ++row;
             p = end;
         }
-        if (row < 0) { count = row_start; continue; }  // drop partial row
+        if (td->error) break;
         if (row > 0) {
-            if (cols0 == 0) cols0 = row;
-            else if (row != cols0) {             // ragged table: reject,
-                std::fclose(fh);                 // matching np.loadtxt
-                return -2;
-            }
+            if (td->ncols == 0) td->ncols = row;
+            else if (row != td->ncols) { td->error = true; break; }
         }
     }
     std::fclose(fh);
-    if (ncols) *ncols = cols0;
-    return count;
+    return td;
 }
+
+long long ewt_table_size(TableData* td) {
+    return td->error ? -1 : (long long)td->vals.size();
+}
+
+long long ewt_table_ncols(TableData* td) { return td->ncols; }
+
+void ewt_table_fill(TableData* td, double* out) {
+    std::memcpy(out, td->vals.data(), td->vals.size() * sizeof(double));
+}
+
+void ewt_table_free(TableData* td) { delete td; }
 
 }  // extern "C"

@@ -96,6 +96,28 @@ def test_missing_file_contract_matches_python_engine(lib, tmp_path):
         parse_tim(str(tmp_path / "nope.tim"), engine="python")
 
 
+def test_malformed_numeric_raises_in_both_engines(lib, tmp_path):
+    bad = tmp_path / "bad.tim"
+    bad.write_text("FORMAT 1\na 14OO.0 55000.25 1.0 bat -f A\n")
+    with pytest.raises(ValueError):
+        parse_tim(str(bad), engine="auto")
+    with pytest.raises(ValueError):
+        parse_tim(str(bad), engine="python")
+
+
+def test_unknown_engine_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown engine"):
+        parse_tim(str(tmp_path / "x.tim"), engine="native")
+
+
+def test_read_table_rejects_corrupt_rows(lib, tmp_path):
+    """Non-numeric tokens must not silently drop rows (np.loadtxt
+    raises; truncated chains would corrupt posterior statistics)."""
+    path = tmp_path / "chain_1.txt"
+    path.write_text("1.0 2.0\n3.0 garbage\n5.0 6.0\n")
+    assert native.read_table_native(str(path)) is None
+
+
 def test_results_layer_uses_fast_reader(lib, tmp_path):
     from enterprise_warp_tpu.results.core import _read_table
     arr = np.arange(12.0).reshape(3, 4)
