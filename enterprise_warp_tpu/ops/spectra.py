@@ -15,6 +15,8 @@ over the interleaved (sin, cos) columns.
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 
 from .. import constants as const
@@ -31,15 +33,15 @@ def _repeat_modes(phi_modes):
 # log space with one final exp, clamped to the f32-representable window.
 # The clamp only binds where a mode is already ~30 orders of magnitude
 # above/below the white-noise level, where lnL is flat in the hyperparams.
-_LOG_PHI_MIN = jnp.log(1e-36)
-_LOG_PHI_MAX = jnp.log(1e35)
+_LOG_PHI_MIN = math.log(1e-36)
+_LOG_PHI_MAX = math.log(1e35)
 
 
 def _exp_clamped(log_phi):
     return jnp.exp(jnp.clip(log_phi, _LOG_PHI_MIN, _LOG_PHI_MAX))
 
 
-_LN10 = jnp.log(10.0)
+_LN10 = math.log(10.0)
 
 
 def powerlaw_psd(f, df, log10_A, gamma):
