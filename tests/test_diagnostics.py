@@ -1,0 +1,48 @@
+"""Convergence-diagnostics unit tests: known-process calibration."""
+
+import numpy as np
+
+from enterprise_warp_tpu.utils.diagnostics import (effective_sample_size,
+                                                   gelman_rubin,
+                                                   summarize_chains)
+
+
+def test_iid_chains():
+    rng = np.random.default_rng(0)
+    c = rng.standard_normal((4, 2000))
+    assert abs(gelman_rubin(c) - 1.0) < 0.01
+    ess = effective_sample_size(c)
+    assert 0.8 * 8000 < ess <= 8800
+
+
+def test_ar1_tau():
+    # AR(1) with rho=0.9: integrated autocorrelation time ~ 19
+    rng = np.random.default_rng(1)
+    x = np.zeros((4, 4000))
+    for i in range(1, 4000):
+        x[:, i] = 0.9 * x[:, i - 1] + rng.standard_normal(4)
+    ess = effective_sample_size(x)
+    expect = 4 * 4000 / 19.0
+    assert 0.5 * expect < ess < 1.8 * expect
+    assert gelman_rubin(x) < 1.05
+
+
+def test_diverged_chains_flagged():
+    rng = np.random.default_rng(2)
+    d = rng.standard_normal((4, 2000)) + np.arange(4)[:, None] * 3.0
+    assert gelman_rubin(d) > 1.5
+    assert effective_sample_size(d) < 100
+
+
+def test_summarize_shape_and_worst():
+    rng = np.random.default_rng(3)
+    s = summarize_chains(rng.standard_normal((4, 500, 3)), ["a", "b", "c"])
+    assert set(s) == {"a", "b", "c", "_worst"}
+    assert s["_worst"]["rhat"] >= max(s[k]["rhat"] for k in "abc")
+    assert s["_worst"]["ess"] <= min(s[k]["ess"] for k in "abc")
+    assert abs(s["a"]["mean"]) < 0.1
+
+
+def test_constant_chain_degenerate():
+    c = np.ones((2, 100))
+    assert gelman_rubin(c) == 1.0

@@ -1,0 +1,156 @@
+"""Per-phase timing of the likelihood kernel pieces on the attached device.
+
+Times each computational phase of ``ops.kernel.marginalized_loglike`` in
+isolation over a walker batch, to locate where the batched-eval wall-clock
+goes (VERDICT round-1 item 2: profile before optimizing).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from enterprise_warp_tpu.models import build_pulsar_likelihood
+from enterprise_warp_tpu.ops.kernel import (_gram_pair, equilibrated_cholesky,
+                                            whiten_inputs)
+
+import __graft_entry__ as g
+
+BATCH = 1024
+REPS = 10
+
+
+def timeit(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:42s} {dt*1e3:9.2f} ms/batch")
+    return dt
+
+
+def main():
+    psr, terms = g._flagship_single_pulsar()
+    like = build_pulsar_likelihood(psr, terms)
+    rng = np.random.default_rng(1)
+    thetas = like.sample_prior(rng, BATCH)
+
+    print("device:", jax.devices()[0].platform, "batch:", BATCH)
+
+    # full kernel, current default
+    timeit("full loglike_batch (split)", like.loglike_batch, thetas)
+
+    # pieces ------------------------------------------------------------
+    T = np.concatenate([b.F if b.row_scale is None
+                        else b.F * b.row_scale[:, None]
+                        for b in terms if hasattr(b, "F")], axis=1)
+    r_w, M_w, T_w, cs2, _ = whiten_inputs(
+        psr.residuals, psr.toaerrs, psr.Mmat, T)
+    ntoa, nb = T_w.shape
+    ntm = M_w.shape[1]
+    print(f"ntoa={ntoa} nbasis={nb} ntm={ntm}")
+
+    key = jax.random.PRNGKey(0)
+    w = jnp.exp(0.1 * jax.random.normal(key, (BATCH, ntoa),
+                                        dtype=jnp.float64))
+    Td = jnp.asarray(T_w)
+    Md = jnp.asarray(M_w)
+    rd = jnp.asarray(r_w)
+
+    @jax.jit
+    def gram_split(w):
+        def one(wi):
+            Ts = Td * jnp.sqrt(wi)[:, None]
+            return _gram_pair(Ts, Ts, "split")
+        return jax.vmap(one)(w)
+
+    @jax.jit
+    def gram_f32(w):
+        def one(wi):
+            Ts = Td * jnp.sqrt(wi)[:, None]
+            return _gram_pair(Ts, Ts, "f32")
+        return jax.vmap(one)(w)
+
+    @jax.jit
+    def sides_f64(w):
+        def one(wi):
+            sq = jnp.sqrt(wi)
+            Ts = Td * sq[:, None]
+            Ms = Md * sq[:, None]
+            rs = rd * sq
+            H = _gram_pair(Ts, Ms, "f64")
+            P = _gram_pair(Ms, Ms, "f64")
+            X = _gram_pair(Ts, rs[:, None], "f64")
+            q = _gram_pair(Ms, rs[:, None], "f64")
+            return H, P, X, q
+        return jax.vmap(one)(w)
+
+    @jax.jit
+    def sides_split(w):
+        def one(wi):
+            sq = jnp.sqrt(wi)
+            Ts = Td * sq[:, None]
+            Ms = Md * sq[:, None]
+            rs = rd * sq
+            H = _gram_pair(Ts, Ms, "split")
+            P = _gram_pair(Ms, Ms, "split")
+            X = _gram_pair(Ts, rs[:, None], "split")
+            q = _gram_pair(Ms, rs[:, None], "split")
+            return H, P, X, q
+        return jax.vmap(one)(w)
+
+    G = gram_split(w)
+    G64 = G + jnp.eye(nb, dtype=jnp.float64) * 3.0
+
+    @jax.jit
+    def chol_f64(G):
+        return jax.vmap(lambda S: equilibrated_cholesky(S, 3e-6))(G)
+
+    @jax.jit
+    def chol_f64_nojit(G):
+        return jax.vmap(lambda S: equilibrated_cholesky(S, 0.0))(G)
+
+    @jax.jit
+    def chol_f32(G):
+        Gf = G.astype(jnp.float32)
+        return jax.vmap(lambda S: equilibrated_cholesky(S, 0.0))(Gf)
+
+    X = jax.random.normal(key, (BATCH, nb), dtype=jnp.float64)
+    L64, _, _ = chol_f64_nojit(G64)
+
+    @jax.jit
+    def trisolve_f64(L, X):
+        return jax.vmap(lambda Li, xi: jax.scipy.linalg.solve_triangular(
+            Li, xi, lower=True))(L, X)
+
+    @jax.jit
+    def trisolve_f32(L, X):
+        return jax.vmap(lambda Li, xi: jax.scipy.linalg.solve_triangular(
+            Li, xi, lower=True))(L.astype(jnp.float32),
+                                 X.astype(jnp.float32))
+
+    Hb = jax.random.normal(key, (BATCH, nb, ntm), dtype=jnp.float64)
+
+    @jax.jit
+    def trisolve_mat_f64(L, H):
+        return jax.vmap(lambda Li, Hi: jax.scipy.linalg.solve_triangular(
+            Li, Hi, lower=True))(L, H)
+
+    timeit("gram G split (f32 hi/lo + f64 acc)", gram_split, w)
+    timeit("gram G pure f32", gram_f32, w)
+    timeit("side grams H,P,X,q f64", sides_f64, w)
+    timeit("side grams H,P,X,q split", sides_split, w)
+    timeit("cholesky f64 + jitter refactor", chol_f64, G64)
+    timeit("cholesky f64 single", chol_f64_nojit, G64)
+    timeit("cholesky f32 single", chol_f32, G64)
+    timeit("trisolve f64 (nb x nb) vec", trisolve_f64, L64, X)
+    timeit("trisolve f32 (nb x nb) vec", trisolve_f32, L64, X)
+    timeit("trisolve f64 (nb x nb) x ntm", trisolve_mat_f64, L64, Hb)
+
+
+if __name__ == "__main__":
+    main()
