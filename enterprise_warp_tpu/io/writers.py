@@ -106,8 +106,11 @@ def write_tim(tim: TimFile, path: str, flags_order=None):
                 day += shift
                 frac -= shift
             mjd = f"{day}.{format(frac, '.17f')[2:]}"
+            # error column: %.10g preserves sub-1e-4-us uncertainties that
+            # a fixed %.4f would serialize as 0.0000 (reloading sigma=0
+            # then divides by zero in whiten_inputs)
             row = (f"{tim.names[i]} {tim.freqs[i]:.6f} {mjd} "
-                   f"{tim.errs[i]:.4f} {tim.sites[i]}")
+                   f"{tim.errs[i]:.10g} {tim.sites[i]}")
             for k in flags_order:
                 v = str(tim.flags[k][i])
                 if v:
@@ -137,10 +140,12 @@ def pulsar_to_timfile(psr: Pulsar, par: ParFile | None = None,
     phase residuals. With ``par`` given, noise-free arrival times are first
     aligned to that spin solution's pulse grid (sub-period shifts).
 
-    Precision: the (MJD-int, seconds) split is computed relative to PEPOCH,
-    never through absolute seconds (float64 eps at MJD-scale seconds is
-    ~1 us; relative to PEPOCH it is ~3e-8 s over a 10 yr span — far below
-    the TOA errors).
+    Precision: with ``par`` given, the (MJD-int, seconds) split is computed
+    relative to PEPOCH — never through absolute seconds — so the split adds
+    ~3e-8 s error over a 10 yr span. Without ``par`` the split is taken
+    relative to the first TOA's day for the same reason, but the absolute
+    ``psr.toas`` float64 representation itself carries ~1 us ulp at
+    MJD-scale seconds, which bounds the par=None round-trip precision.
     """
     n = len(psr)
     if par is not None:
@@ -148,12 +153,12 @@ def pulsar_to_timfile(psr: Pulsar, par: ParFile | None = None,
         dt = _align_to_pulses(
             psr.toas - par.pepoch * const.day, par) \
             + (par.pepoch - base) * const.day
-        day_off = np.floor(dt / const.day).astype(np.int64)
-        mjd_int = base + day_off
-        sec = dt - day_off * const.day
     else:
-        mjd_int = np.floor(psr.toas / const.day).astype(np.int64)
-        sec = psr.toas - mjd_int * const.day
+        base = int(np.floor(psr.toas[0] / const.day))
+        dt = psr.toas - base * const.day
+    day_off = np.floor(dt / const.day).astype(np.int64)
+    mjd_int = base + day_off
+    sec = dt - day_off * const.day
     if apply_residuals:
         sec = sec + psr.residuals
     flags = {k: np.asarray(v, dtype=object) for k, v in psr.flags.items()}

@@ -60,8 +60,14 @@ class MultiPulsarLikelihood(PriorMixin):
 
 
 
-def build_terms_for_model(params_model, psrs, noise_model_obj):
-    """Per-pulsar TermLists for one model section."""
+def build_terms_for_model(params_model, psrs, noise_model_obj,
+                          nfreqs_logs=None):
+    """Per-pulsar TermLists for one model section.
+
+    ``nfreqs_logs`` — optional list; when given, ``(psr_name, nfreqs_log)``
+    pairs are appended (the per-selection Fourier-mode-count provenance the
+    reference writes as ``*_nfreqs.txt``,
+    ``enterprise_models.py:503-536``)."""
     termlists = []
     common_signals = getattr(params_model, "common_signals", {}) or {}
     noisemodel = getattr(params_model, "noisemodel", {}) or {}
@@ -78,7 +84,31 @@ def build_terms_for_model(params_model, psrs, noise_model_obj):
             res = getattr(model, term_name)(option=option)
             terms.extend(res if isinstance(res, list) else [res])
         termlists.append(terms)
+        if nfreqs_logs is not None:
+            nfreqs_logs.append((psr.name, list(model.nfreqs_log)))
     return termlists
+
+
+def write_nfreqs_files(output_dir, nfreqs_logs):
+    """Write per-selection Fourier-mode-count provenance files in the
+    reference's ``<selection>_nfreqs.txt`` format — one ``flag;value;n``
+    line per file (``enterprise_models.py:503-536``)."""
+    import os
+
+    paths = []
+    for psr_name, entries in nfreqs_logs:
+        for flag, flagval, nfreqs in entries:
+            if flag in ("no selection", None, "-"):
+                fname, line = "no_selection", f"no selection;-;{nfreqs}\n"
+            else:
+                safe = f"{flag.lstrip('-')}_{flagval}"
+                fname = f"{psr_name}_{safe}"
+                line = f"{flag};{flagval};{nfreqs}\n"
+            path = os.path.join(output_dir, fname + "_nfreqs.txt")
+            with open(path, "w") as fh:
+                fh.write(line)
+            paths.append(path)
+    return paths
 
 
 def has_correlated_common(termlists) -> bool:
@@ -96,8 +126,10 @@ def init_model_likelihoods(params, gram_mode="split", write_pars=True):
                 "('default') is implemented (the reference's "
                 "'ridge_regression' option is broken upstream, "
                 "enterprise_warp.py:453-459)")
+        nfreqs_logs = []
         termlists = build_terms_for_model(pm, params.psrs,
-                                          params.noise_model_obj)
+                                          params.noise_model_obj,
+                                          nfreqs_logs=nfreqs_logs)
         fixed = None
         if getattr(pm, "noisefiles", None):
             fixed = get_noise_dict([p.name for p in params.psrs],
@@ -124,4 +156,5 @@ def init_model_likelihoods(params, gram_mode="split", write_pars=True):
             import os
             np.savetxt(os.path.join(params.output_dir, "pars.txt"),
                        like.param_names, fmt="%s")
+            write_nfreqs_files(params.output_dir, nfreqs_logs)
     return likes

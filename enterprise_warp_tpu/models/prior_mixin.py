@@ -21,6 +21,14 @@ class PriorMixin:
             out = out + p.prior.logpdf(theta[..., i])
         return out
 
+    def log_prior_dims(self, theta):
+        """Per-parameter prior log-densities, shape ``(..., ndim)`` — the
+        proposal-asymmetry correction of prior-draw jumps needs the
+        replaced dimension's density on its own."""
+        theta = jnp.atleast_1d(theta)
+        return jnp.stack([p.prior.logpdf(theta[..., i])
+                          for i, p in enumerate(self.params)], axis=-1)
+
     def from_unit(self, u):
         """Unit-cube transform across all sampled parameters."""
         cols = [p.prior.from_unit(u[..., i])

@@ -57,13 +57,21 @@ def load():
     _TRIED = True
     if os.environ.get("EWT_NO_NATIVE"):
         return None
-    if os.path.isdir(_SRC_DIR):
+    if os.path.isdir(_SRC_DIR) and os.access(_PKG_DIR, os.W_OK):
         # always invoke make: a no-op when the .so is fresh, and a rebuild
         # when fastio.cpp changed (a stale binary would silently win
         # otherwise). Build failure with an existing .so keeps the old one.
+        # A file lock serializes concurrent builders (MPI ranks,
+        # pytest-xdist); the Makefile additionally renames a temp into
+        # place so an unlocked reader never dlopens a partial .so. Skipped
+        # entirely when the package dir is read-only (installed site).
         try:
-            subprocess.run(["make", "-C", _SRC_DIR], capture_output=True,
-                           timeout=120, check=True)
+            import fcntl
+            with open(os.path.join(_PKG_DIR, "_fastio.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                subprocess.run(["make", "-C", _SRC_DIR],
+                               capture_output=True, timeout=120,
+                               check=True)
         except subprocess.CalledProcessError as exc:
             from .utils import get_logger
             get_logger("ewt.native").warning(

@@ -226,7 +226,7 @@ class EnterpriseWarpResult:
                 print(f"   {len(chain)} post-burn samples, "
                       f"{len(pars)} parameters")
             psrname = psr_dir.split("_", 1)[1] if "_" in psr_dir \
-                else (psr_dir or "run")
+                else (psr_dir or self._psrname_from_pars(pars))
             if self.opts.noisefiles:
                 path = make_noise_files(
                     psrname, chain, pars,
@@ -244,6 +244,18 @@ class EnterpriseWarpResult:
                 self._collect_covm(psr_dir, pars)
         if self.opts.covm:
             self._save_covm()
+
+    @staticmethod
+    def _psrname_from_pars(pars):
+        """Single-run layout has no ``<num>_<psr>`` subdir to name the
+        pulsar, but the parameter names carry a ``<JName>_`` prefix;
+        recover it so the noisefile round-trip (keyed by pulsar name,
+        ``assemble.get_noise_dict``) works without psr subdirs."""
+        for p in pars:
+            head = p.split("_", 1)[0]
+            if re.match(r"^[JB]\d{4}[+-]\d{2,4}$", head):
+                return head
+        return "run"
 
     # ------------------------ products -------------------------------- #
     def _make_credlevels(self, psrname, chain, pars):

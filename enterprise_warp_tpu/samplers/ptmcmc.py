@@ -8,7 +8,11 @@ Native replacement for PTMCMCSampler as driven by the reference
 - SCAM: single-component adaptive metropolis along one covariance
   eigendirection,
 - AM: full adaptive-metropolis jump from the empirical covariance,
-- DE: differential evolution using a history ring buffer —
+- DE: differential evolution using a history ring buffer,
+- prior draw: one random dimension redrawn from its prior with the
+  Metropolis-Hastings asymmetry correction (PTMCMCSampler mixes this in
+  via enterprise_extensions' ``setup_sampler``; it is what lets the
+  product-space ``nmodel`` index hop between well-separated models) —
 
 but the execution model is inverted for TPU: W walkers (ntemps x nchains)
 advance *simultaneously*, each step evaluating the likelihood once for all
@@ -71,15 +75,16 @@ class PTSampler:
 
     def __init__(self, like, outdir, ntemps=2, nchains=8, seed=0,
                  scam_weight=30, am_weight=15, de_weight=50,
-                 cov_update=1000, swap_every=10, tmax=None,
-                 init_cov=None, burn=0):
+                 prior_weight=10, cov_update=1000, swap_every=10,
+                 tmax=None, init_cov=None, burn=0):
         self.like = like
         self.outdir = outdir
         self.ntemps = ntemps
         self.nchains = nchains
         self.W = ntemps * nchains
         self.ndim = like.ndim
-        weights = np.array([scam_weight, am_weight, de_weight], float)
+        weights = np.array([scam_weight, am_weight, de_weight,
+                            prior_weight], float)
         self.jump_probs = weights / weights.sum()
         self.cov_update = cov_update
         self.swap_every = swap_every
@@ -158,9 +163,9 @@ class PTSampler:
         def one_step(carry, step_idx):
             x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop, \
                 eigvecs, eigvals, chol = carry
-            key, k1, k2, k3, k4, k5, k6 = jax.random.split(key, 7)
+            key, k1, k2, k3, k4, k5, k6, k7, k8 = jax.random.split(key, 9)
 
-            # --- proposals (all three families, select per walker) ----
+            # --- proposals (all four families, select per walker) -----
             z = jax.random.normal(k1, (W, nd))
             # AM: full covariance jump
             am = x + (z @ chol.T) * (2.38 / jnp.sqrt(nd))
@@ -175,17 +180,30 @@ class PTSampler:
             ib = jax.random.randint(k5, (W,), 0, hist_len)
             gamma_de = 2.38 / jnp.sqrt(2 * nd)
             de = x + gamma_de * (hist[ia] - hist[ib])
+            # prior draw: one random dimension redrawn from its prior
+            jp = jax.random.randint(k7, (W,), 0, nd)
+            onehot = jax.nn.one_hot(jp, nd, dtype=x.dtype)
+            draws = like.from_unit(jax.random.uniform(k8, (W, nd)))
+            pd = x * (1.0 - onehot) + draws * onehot
 
             u = jax.random.uniform(k6, (W,))
             choice = jnp.searchsorted(jnp.cumsum(jump_p), u)
             prop = jnp.where((choice == 0)[:, None], scam,
-                             jnp.where((choice == 1)[:, None], am, de))
+                             jnp.where((choice == 1)[:, None], am,
+                                       jnp.where((choice == 2)[:, None],
+                                                 de, pd)))
 
             key, ka = jax.random.split(key)
             lnp_new = like.log_prior(prop)
             lnl_new = like.loglike_batch(prop)
             lnl_new = jnp.where(jnp.isneginf(lnp_new), -jnp.inf, lnl_new)
-            log_ratio = (lnp_new - lnp) + (lnl_new - lnl) / temps
+            # prior-draw proposal asymmetry: q(x'|x) is the prior density
+            # of the redrawn dimension, so the MH correction is
+            # logpdf_j(x_j) - logpdf_j(x'_j) (zero for the other families)
+            lpd_old = jnp.sum(like.log_prior_dims(x) * onehot, axis=-1)
+            lpd_new = jnp.sum(like.log_prior_dims(prop) * onehot, axis=-1)
+            qcorr = jnp.where(choice == 3, lpd_old - lpd_new, 0.0)
+            log_ratio = (lnp_new - lnp) + (lnl_new - lnl) / temps + qcorr
             accept = jnp.log(jax.random.uniform(ka, (W,))) < log_ratio
             x = jnp.where(accept[:, None], prop, x)
             lnl = jnp.where(accept, lnl_new, lnl)
@@ -354,6 +372,7 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
             scam_weight=getattr(params, "SCAMweight", 30),
             am_weight=getattr(params, "AMweight", 15),
             de_weight=getattr(params, "DEweight", 50),
+            prior_weight=getattr(params, "PriorDrawWeight", 10),
             cov_update=getattr(params, "covUpdate", 1000) or 1000,
         )
         skw = getattr(params, "sampler_kwargs", {})

@@ -88,6 +88,12 @@ void parse_file(const std::string& path, TimData* td, int depth) {
     std::vector<char> buf(1 << 16);
     while (std::fgets(buf.data(), (int)buf.size(), fh)) {
         line.assign(buf.data());
+        // a line longer than the buffer arrives without its newline: keep
+        // reading so it stays ONE logical line (identical to the Python
+        // engine, which reads whole lines regardless of length)
+        while (!line.empty() && line.back() != '\n' &&
+               std::fgets(buf.data(), (int)buf.size(), fh))
+            line.append(buf.data());
         // strip trailing newline/CR
         while (!line.empty() &&
                (line.back() == '\n' || line.back() == '\r'))

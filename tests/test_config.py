@@ -130,6 +130,12 @@ class TestEndToEnd:
         pars = open(os.path.join(p.output_dir, "pars.txt")).read().split()
         assert pars == like.param_names
         assert p.output_dir.endswith("examp_1_v1/0_J1832-0836/")
+        # per-selection Fourier-mode provenance (reference *_nfreqs.txt,
+        # enterprise_models.py:503-536)
+        nf = os.path.join(p.output_dir, "no_selection_nfreqs.txt")
+        assert os.path.exists(nf)
+        flag, val, n = open(nf).read().strip().split(";")
+        assert flag == "no selection" and int(n) > 0
 
     def test_num_selects_fake_pulsar(self, in_tmp):
         opts = make_opts(num=1)

@@ -108,6 +108,27 @@ class TestCore:
         logbf = np.log(counts[1] / counts[0])
         assert abs(logbf - np.log(3)) < 0.3
 
+    def test_single_run_layout_noisefile_named_after_pulsar(self, tmp_path):
+        # no <num>_<psr> subdir: the pulsar name must be recovered from the
+        # parameter-name prefixes so the noisefile round-trip
+        # (get_noise_dict keyed by JName) still works
+        out = str(tmp_path)
+        rng = np.random.default_rng(3)
+        pars = ["J1832-0836_efac", "J1832-0836_red_noise_log10_A"]
+        chain = np.column_stack([
+            1.0 + 0.1 * rng.standard_normal(400),
+            -14.0 + 0.1 * rng.standard_normal(400)])
+        diag = np.zeros((400, 4))
+        np.savetxt(os.path.join(out, "chain_1.txt"),
+                   np.column_stack([chain, diag]))
+        np.savetxt(os.path.join(out, "pars.txt"), pars, fmt="%s")
+        r = EnterpriseWarpResult(opts_for(out, noisefiles=1))
+        r.main_pipeline()
+        path = os.path.join(out, "noisefiles", "J1832-0836_noise.json")
+        assert os.path.exists(path)
+        with open(path) as fh:
+            assert "J1832-0836_efac" in json.load(fh)
+
     def test_separate_earliest_roundtrip(self, tmp_path):
         out = str(tmp_path)
         d, pars, chain = write_fake_run(out, nsamp=400)

