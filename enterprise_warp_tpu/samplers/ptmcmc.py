@@ -152,8 +152,21 @@ class PTSampler:
                        swaps_proposed=int(z["swaps_proposed"]))
 
     # ---------------- the jitted block --------------------------------- #
+    def _log_prior_dims(self, theta):
+        """Per-parameter prior log-densities, ``(..., ndim)``.
+
+        Uses ``like.log_prior_dims`` when provided (PriorMixin subclasses);
+        otherwise derives it from ``like.params`` so any likelihood object
+        exposing ``params`` works with prior-draw jumps."""
+        fn = getattr(self.like, "log_prior_dims", None)
+        if fn is not None:
+            return fn(theta)
+        from ..models.prior_mixin import PriorMixin
+        return PriorMixin.log_prior_dims(self.like, theta)
+
     def _make_block(self, nsteps):
         like = self.like
+        log_prior_dims = self._log_prior_dims
         temps = jnp.asarray(self.temps)
         jump_p = jnp.asarray(self.jump_probs)
         W, nd = self.W, self.ndim
@@ -200,8 +213,8 @@ class PTSampler:
             # prior-draw proposal asymmetry: q(x'|x) is the prior density
             # of the redrawn dimension, so the MH correction is
             # logpdf_j(x_j) - logpdf_j(x'_j) (zero for the other families)
-            lpd_old = jnp.sum(like.log_prior_dims(x) * onehot, axis=-1)
-            lpd_new = jnp.sum(like.log_prior_dims(prop) * onehot, axis=-1)
+            lpd_old = jnp.sum(log_prior_dims(x) * onehot, axis=-1)
+            lpd_new = jnp.sum(log_prior_dims(prop) * onehot, axis=-1)
             qcorr = jnp.where(choice == 3, lpd_old - lpd_new, 0.0)
             log_ratio = (lnp_new - lnp) + (lnl_new - lnl) / temps + qcorr
             accept = jnp.log(jax.random.uniform(ka, (W,))) < log_ratio
@@ -273,9 +286,15 @@ class PTSampler:
 
     # ---------------- public API --------------------------------------- #
     def sample(self, nsamp, resume=True, verbose=True, thin=1,
-               block_size=None):
+               block_size=None, collect=None):
         """Run ``nsamp`` total steps, writing the cold chains to
-        ``chain_1.txt`` (reference format) every block."""
+        ``chain_1.txt`` (reference format) every block.
+
+        If ``collect`` is a list, each block's post-thin cold positions are
+        also appended to it as float32 ``(steps//thin, nchains, ndim)``
+        arrays, so
+        convergence drivers can compute diagnostics incrementally without
+        re-parsing the text chain file (O(steps^2) for long runs)."""
         block_size = block_size or self.cov_update
         if resume and os.path.exists(self._ckpt_path):
             st = self._load_state()
@@ -341,6 +360,8 @@ class PTSampler:
             ], axis=1)
             with open(chain_path, "ab") as fh:
                 np.savetxt(fh, rows)
+            if collect is not None:
+                collect.append(cs.astype(np.float32))
 
             # --- adapt covariance from recent cold samples ------------ #
             flat = cs.reshape(-1, self.ndim)
