@@ -107,21 +107,24 @@ def _gram_pair(S, B, mode):
         return out.astype(S.dtype)
 
     # split mode
-    n = S.shape[0]
-    n_pad = (-n) % _CHUNK
-    S = _pad_to_chunk(S, n_pad)
-    B = _pad_to_chunk(B, n_pad)
-    nc = S.shape[0] // _CHUNK
+    S = _pad_to_chunk(S, (-S.shape[0]) % _CHUNK)
+    B = _pad_to_chunk(B, (-B.shape[0]) % _CHUNK)
     Sh, Sl = _split_hi_lo(S)
     Bh, Bl = _split_hi_lo(B)
+    return (_chunked_f32_gram(Sh, Bh) + _chunked_f32_gram(Sh, Bl)
+            + _chunked_f32_gram(Sl, Bh))
 
-    def chunked(x, y):
-        xc = x.reshape(nc, _CHUNK, x.shape[1])
-        yc = y.reshape(nc, _CHUNK, y.shape[1])
-        parts = jnp.einsum("cik,cil->ckl", xc, yc, precision=_HIGH)
-        return jnp.sum(parts.astype(jnp.float64), axis=0)
 
-    return chunked(Sh, Bh) + chunked(Sh, Bl) + chunked(Sl, Bh)
+def _chunked_f32_gram(x, y):
+    """x^T y of two f32 (row-padded) matrices on the MXU, with per-chunk
+    partials accumulated in f64. The building block of split mode; also
+    used alone when an operand is exactly representable in f32 (its lo
+    split component is identically zero)."""
+    nc = x.shape[0] // _CHUNK
+    xc = x.reshape(nc, _CHUNK, x.shape[1])
+    yc = y.reshape(nc, _CHUNK, y.shape[1])
+    parts = jnp.einsum("cik,cil->ckl", xc, yc, precision=_HIGH)
+    return jnp.sum(parts.astype(jnp.float64), axis=0)
 
 
 # Preconditioner jitter per gram mode, applied to the *unit-diagonal
@@ -167,7 +170,8 @@ def equilibrated_cholesky(S, jitter):
     return L, s, logdet
 
 
-def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2):
+def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
+                            delta_mode="tree"):
     """Solve ``S Z = B`` and compute ``log|S|`` for symmetric PD ``S`` in
     mixed precision (TPU-fast: no emulated-f64 factorization).
 
@@ -232,8 +236,18 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2):
     res_pre = jnp.sum(jnp.square(Bn - mm64(Sn, Z0)))
     Z = jnp.where(res_ref <= res_pre, Z, Z0)
 
+    # delta_mode='split' computes L L^T on the MXU with f64 chunk
+    # accumulation (O(n^3) f32 instead of O(n^3) f64-elementwise tree
+    # ops). L is exactly f32, so ONE chunked product is exact — no hi/lo
+    # splitting needed. Use when n is large (the joint PTA Schur
+    # complement).
     L64 = L.astype(f64)
-    Delta = (Sn - mm64(L64, L64.T)).astype(jnp.float32)
+    if delta_mode == "split":
+        Lp = _pad_to_chunk(L.T, (-n) % _CHUNK)
+        LLt = _chunked_f32_gram(Lp, Lp)
+    else:
+        LLt = mm64(L64, L64.T)
+    Delta = (Sn - LLt).astype(jnp.float32)
     K = jax.scipy.linalg.solve_triangular(L, Delta, lower=True)
     E = jax.scipy.linalg.solve_triangular(L, K.T, lower=True).astype(f64)
     E32 = E.astype(jnp.float32)
