@@ -50,6 +50,16 @@ def main(argv=None):
                         choices=("split", "f32", "f64"))
     opts = parser.parse_args(argv)
 
+    # multi-host: join the jax.distributed process group when the launcher
+    # set EWT_COORDINATOR/EWT_NUM_PROCESSES/EWT_PROCESS_ID (replaces the
+    # reference's --mpi_regime staging, enterprise_warp.py:46-55); a no-op
+    # on ordinary single-host runs
+    from .parallel.distributed import init_distributed
+    pidx, pcnt = init_distributed()
+    if pcnt > 1:
+        print(f"distributed: process {pidx}/{pcnt}, "
+              f"single-writer={'yes' if pidx == 0 else 'no'}")
+
     custom = None
     if opts.custom_models_py and opts.custom_models:
         custom = import_custom_models(opts.custom_models_py,
@@ -89,7 +99,7 @@ def main(argv=None):
         kw = params.sampler_kwargs
         run_nested(like, outdir=params.output_dir, label=params.label,
                    nlive=int(kw.get("nlive", 500)),
-                   dlogz=float(kw.get("dlogz", 0.1)))
+                   dlogz=float(kw.get("dlogz", 0.1)), resume=resume)
     return 0
 
 

@@ -153,8 +153,10 @@ def init_model_likelihoods(params, gram_mode="split", write_pars=True):
         if write_pars and getattr(params, "output_dir", None) and \
                 (params.opts is None
                  or getattr(params.opts, "mpi_regime", 0) != 2):
-            import os
-            np.savetxt(os.path.join(params.output_dir, "pars.txt"),
-                       like.param_names, fmt="%s")
-            write_nfreqs_files(params.output_dir, nfreqs_logs)
+            from ..parallel.distributed import is_primary
+            if is_primary():
+                import os
+                np.savetxt(os.path.join(params.output_dir, "pars.txt"),
+                           like.param_names, fmt="%s")
+                write_nfreqs_files(params.output_dir, nfreqs_logs)
     return likes

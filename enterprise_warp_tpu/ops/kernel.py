@@ -202,15 +202,41 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     n = S.shape[-1]
     if jitter2 is None:
         jitter2 = 30.0 * jitter
-    d = jnp.maximum(jnp.diagonal(S), 1e-30)
-    s = 1.0 / jnp.sqrt(d)
+    # Numerically NULL rows: Schur complements can cancel to a tiny
+    # NEGATIVE diagonal (pure rounding residue of a direction the earlier
+    # elimination already absorbed). Equilibrating such a row by
+    # 1/sqrt(1e-30) overflows the f32 cast and NaNs every jittered
+    # Cholesky retry, poisoning the walker with -inf. Those coordinates
+    # are DROPPED from the solved system (s=0 decouples them; unit pivot
+    # keeps the factorization stable) and charged a conservative
+    # max-diagonal eigenvalue in the logdet — quad contribution 0 and an
+    # overestimated determinant both push lnL DOWN, so the corner can't
+    # become attractive. Rows with a positive diagonal keep the exact
+    # equilibration (bit-identical to the pre-guard behavior, any
+    # dynamic range).
+    diag = jnp.diagonal(S)
+    null = diag <= 0.0
+    dmax = jnp.maximum(jnp.max(diag), 1e-300)
+    d = jnp.where(null, dmax, jnp.maximum(diag, 1e-30))
+    s = jnp.where(null, 0.0, 1.0 / jnp.sqrt(d))
     Sn = S * s[:, None] * s[None, :]
+    Sn = jnp.fill_diagonal(
+        Sn, jnp.where(null, 1.0, jnp.diagonal(Sn)), inplace=False)
     Sn32 = Sn.astype(jnp.float32)
     eye = jnp.eye(n, dtype=jnp.float32)
     L = jnp.linalg.cholesky(Sn32 + jnp.float32(jitter) * eye)
     bad = ~jnp.all(jnp.isfinite(L))
     L = jnp.where(bad, jnp.linalg.cholesky(Sn32 + jnp.float32(jitter2) * eye),
                   L)
+    # last-resort Jacobi preconditioner: when the equilibrated cast is so
+    # far from PSD that both jittered factorizations fail (numerically
+    # null Schur rows with relatively large off-diagonal residue), fall
+    # back to L = I — never NaN. The refined/plain residual comparison
+    # below then picks the better finite solution, and the logdet trace
+    # correction gates itself off, leaving a bounded diagonal
+    # approximation where the alternative was poisoning the walker with
+    # NaN -> -inf.
+    L = jnp.where(jnp.all(jnp.isfinite(L)), L, eye)
 
     def psolve(R):
         x = jax.scipy.linalg.solve_triangular(L, R.astype(jnp.float32),

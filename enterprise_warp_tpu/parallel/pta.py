@@ -613,16 +613,19 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         Atm = P - _bmm64(H, ZH)
         ym = Xm - jnp.sum(H * Zx[:, :, None], axis=1)
         Cmt = Cmg - _bmm64(H, ZC)
-        # the jitter branch only engages on Cholesky failure (exactly
-        # collinear design-matrix columns), degrading that pulsar to a
-        # condition-bounded solve instead of a permanent -inf
-        LA, sA, ld_tm = jax.vmap(
-            lambda A: equilibrated_cholesky(A, CHOL_JITTER["f32"]))(Atm)
-        rhs_m = jnp.concatenate([ym[:, :, None], Cmt], axis=2) \
-            * sA[:, :, None]
-        Wm = jax.vmap(
-            lambda L, R: jax.scipy.linalg.cho_solve((L, True), R)
-        )(LA, rhs_m) * sA[:, :, None]
+        # the (ntm x ntm) blocks are tiny, so factor them by f64
+        # eigendecomposition with a relative eigenvalue clamp: exact at
+        # normal points, and a condition-bounded PSD solve (never NaN) at
+        # prior corners where the jitter-bounded noise solve leaves Atm
+        # numerically indefinite — the corner class where a Cholesky
+        # would poison the whole walker with a permanent -inf
+        evA, VA = jnp.linalg.eigh(Atm)                  # (P,MW), (P,MW,MW)
+        emax = jnp.max(jnp.abs(evA), axis=-1, keepdims=True)
+        evA_cl = jnp.maximum(evA, 1e-13 * emax + 1e-300)
+        ld_tm = jnp.sum(jnp.log(evA_cl), axis=-1)
+        rhs_m = jnp.concatenate([ym[:, :, None], Cmt], axis=2)
+        Wm = jnp.einsum("pij,pj,pkj,pkl->pil", VA, 1.0 / evA_cl, VA,
+                        rhs_m)
         Wy, WC = Wm[:, :, 0], Wm[:, :, 1:]
 
         q1 = jnp.sum(Xn * Zx) + jnp.sum(ym * Wy)
@@ -677,4 +680,10 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
 
     fn = loglike_schur if joint_mode == "schur" else loglike_dense
-    return PTALikelihood(psrs, sampled, fn, gram_mode, mesh=mesh)
+    like = PTALikelihood(psrs, sampled, fn, gram_mode, mesh=mesh)
+    # introspection hook for tools/ (stage profiling, corner debugging)
+    like._stages = dict(common=_common, coupling=_coupling_blocks,
+                        NW=NW, MW=MW, n_g=n_g, npsr=npsr,
+                        jitter=jitter, tm_pad=tm_pad_j,
+                        joint_mode=joint_mode)
+    return like

@@ -76,29 +76,35 @@ def _make_refill(like, nlive, kbatch, nsteps):
 
 def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
                kbatch=None, seed=0, max_iter=100000, verbose=True,
-               label="result"):
+               label="result", resume=True, checkpoint_every=50):
     """Nested sampling over a compiled likelihood object.
 
     Returns a dict with ``log_evidence``, ``log_evidence_err``,
     ``posterior`` (equal-weight samples), ``samples``/``log_weights`` (raw
     dead points), and writes ``<label>_result.json`` into ``outdir``.
+
+    Checkpoint/resume: every ``checkpoint_every`` iterations the full
+    sampler state (live points, dead arrays, evidence accumulator, RNG
+    key, walk scale) is written to ``<label>_nested_ckpt.npz``; with
+    ``resume=True`` (default, matching the reference's Bilby behavior at
+    ``/root/reference/examples/bilby_example.py:44``) an existing
+    checkpoint is loaded and the run continues with an identical random
+    stream, so kill-and-resume reproduces the uninterrupted run. The
+    checkpoint is removed when the run converges.
     """
     nd = like.ndim
     kbatch = kbatch or max(1, nlive // 5)
-    rng_key = jax.random.PRNGKey(seed)
 
-    rng_key, k0 = jax.random.split(rng_key)
-    u = jax.random.uniform(k0, (nlive, nd), dtype=jnp.float64)
-    lnl = like.loglike_batch(like.from_unit(u))
-    # re-draw non-finite starts
-    for _ in range(20):
-        bad = ~jnp.isfinite(lnl)
-        if not bool(jnp.any(bad)):
-            break
-        rng_key, kr = jax.random.split(rng_key)
-        u2 = jax.random.uniform(kr, (nlive, nd), dtype=jnp.float64)
-        u = jnp.where(bad[:, None], u2, u)
-        lnl = like.loglike_batch(like.from_unit(u))
+    from ..parallel.distributed import is_primary
+
+    # single-writer convention: every process READS the checkpoint on
+    # resume (shared filesystem, as in the reference's MPI world) so the
+    # random streams stay identical, but only process 0 writes
+    ckpt_path = None
+    if outdir is not None:
+        if is_primary():
+            os.makedirs(outdir, exist_ok=True)
+        ckpt_path = os.path.join(outdir, f"{label}_nested_ckpt.npz")
 
     iteration = _make_refill(like, nlive, kbatch, nsteps)
 
@@ -110,11 +116,63 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
     lnx_offsets = np.concatenate([[0.0], np.cumsum(dlnx_per)[:-1]])
     dlnx_batch = float(np.sum(dlnx_per))
 
-    dead_u, dead_lnl, dead_lnx, dead_dlnx = [], [], [], []
-    ln_x = 0.0
-    scale = 0.5
-    it = 0
-    lnz = -np.inf          # running logsumexp of dead-point weights
+    if resume and ckpt_path is not None and os.path.exists(ckpt_path):
+        z = np.load(ckpt_path)
+        u = jnp.asarray(z["u"])
+        lnl = jnp.asarray(z["lnl"])
+        rng_key = jnp.asarray(z["rng_key"])
+        scale = float(z["scale"])
+        ln_x = float(z["ln_x"])
+        lnz = float(z["lnz"])
+        it = int(z["it"])
+        dead_u = [z["dead_u"]] if len(z["dead_u"]) else []
+        dead_lnl = [z["dead_lnl"]] if len(z["dead_lnl"]) else []
+        dead_lnx = [z["dead_lnx"]] if len(z["dead_lnx"]) else []
+        dead_dlnx = [z["dead_dlnx"]] if len(z["dead_dlnx"]) else []
+        if verbose:
+            print(f"NS resuming from iteration {it}")
+    else:
+        rng_key = jax.random.PRNGKey(seed)
+        rng_key, k0 = jax.random.split(rng_key)
+        u = jax.random.uniform(k0, (nlive, nd), dtype=jnp.float64)
+        lnl = like.loglike_batch(like.from_unit(u))
+        # re-draw non-finite starts
+        for _ in range(20):
+            bad = ~jnp.isfinite(lnl)
+            if not bool(jnp.any(bad)):
+                break
+            rng_key, kr = jax.random.split(rng_key)
+            u2 = jax.random.uniform(kr, (nlive, nd), dtype=jnp.float64)
+            u = jnp.where(bad[:, None], u2, u)
+            lnl = like.loglike_batch(like.from_unit(u))
+        dead_u, dead_lnl, dead_lnx, dead_dlnx = [], [], [], []
+        ln_x = 0.0
+        scale = 0.5
+        it = 0
+        lnz = -np.inf      # running logsumexp of dead-point weights
+
+    def _write_ckpt():
+        if ckpt_path is None or not is_primary():
+            return
+        # atomic: a kill mid-write (the exact event checkpointing exists
+        # for) must not leave a truncated archive that breaks resume.
+        # Keep the .npz suffix so np.savez doesn't append another one.
+        tmp = ckpt_path[:-len(".npz")] + ".tmp.npz"
+        np.savez(
+            tmp, u=np.asarray(u), lnl=np.asarray(lnl),
+            rng_key=np.asarray(rng_key), scale=scale, ln_x=ln_x,
+            lnz=lnz, it=it,
+            dead_u=(np.concatenate(dead_u) if dead_u
+                    else np.zeros((0, nd))),
+            dead_lnl=(np.concatenate(dead_lnl) if dead_lnl
+                      else np.zeros(0)),
+            dead_lnx=(np.concatenate(dead_lnx) if dead_lnx
+                      else np.zeros(0)),
+            dead_dlnx=(np.concatenate(dead_dlnx) if dead_dlnx
+                       else np.zeros(0)))
+        os.replace(tmp, ckpt_path)
+
+    converged = False
     while it < max_iter:
         u, lnl, rng_key, du, dl, acc = iteration(u, lnl, rng_key,
                                                  jnp.float64(scale))
@@ -142,8 +200,17 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
         if verbose and it % 20 == 0:
             print(f"NS it={it} lnZ={lnz:.3f} dlogz={delta:.4f} "
                   f"acc={a:.2f} scale={scale:.3f}")
+        if it % checkpoint_every == 0:
+            _write_ckpt()
         if delta < dlogz:
+            converged = True
             break
+
+    if converged and ckpt_path is not None and is_primary() \
+            and os.path.exists(ckpt_path):
+        os.remove(ckpt_path)       # run complete; next run starts fresh
+    elif not converged:
+        _write_ckpt()              # max_iter hit: keep state resumable
 
     # fold the remaining live points in: each carries X_final / nlive
     order = np.argsort(np.asarray(lnl))
@@ -186,7 +253,7 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
         num_likelihood_evaluations=int(
             (it * kbatch * nsteps) + nlive),
     )
-    if outdir is not None:
+    if outdir is not None and is_primary():
         os.makedirs(outdir, exist_ok=True)
         with open(os.path.join(outdir, f"{label}_result.json"), "w") as fh:
             json.dump(result, fh)

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..parallel.distributed import is_primary as _is_primary
+
 _HISTORY = 1000     # DE history ring length (per walker)
 
 
@@ -136,6 +138,8 @@ class PTSampler:
         return os.path.join(self.outdir, "state.npz")
 
     def _save_state(self, st: PTState):
+        if not _is_primary():
+            return
         np.savez(self._ckpt_path, x=st.x, lnl=st.lnl, lnp=st.lnp,
                  key=st.key, cov=st.cov, history=st.history,
                  hist_len=st.hist_len, step=st.step,
@@ -303,11 +307,14 @@ class PTSampler:
         else:
             st = self._fresh_state()
             # fresh run: truncate chain file
-            open(os.path.join(self.outdir, "chain_1.txt"), "w").close()
+            if _is_primary():
+                open(os.path.join(self.outdir, "chain_1.txt"),
+                     "w").close()
 
         chain_path = os.path.join(self.outdir, "chain_1.txt")
-        np.savetxt(os.path.join(self.outdir, "pars.txt"),
-                   self.like.param_names, fmt="%s")
+        if _is_primary():
+            np.savetxt(os.path.join(self.outdir, "pars.txt"),
+                       self.like.param_names, fmt="%s")
 
         while st.step < nsamp:
             todo = int(min(block_size, nsamp - st.step))
@@ -358,8 +365,9 @@ class PTSampler:
                 np.full((cs.shape[0] * self.nchains, 1), acc_rate),
                 np.full((cs.shape[0] * self.nchains, 1), swap_rate),
             ], axis=1)
-            with open(chain_path, "ab") as fh:
-                np.savetxt(fh, rows)
+            if _is_primary():
+                with open(chain_path, "ab") as fh:
+                    np.savetxt(fh, rows)
             if collect is not None:
                 collect.append(cs.astype(np.float32))
 
@@ -371,7 +379,8 @@ class PTSampler:
                     new_cov = new_cov.reshape(1, 1)
                 w = min(0.5, flat.shape[0] / max(st.step, 1))
                 st.cov = (1 - w) * st.cov + w * new_cov
-            np.save(os.path.join(self.outdir, "cov.npy"), st.cov)
+            if _is_primary():
+                np.save(os.path.join(self.outdir, "cov.npy"), st.cov)
             self._save_state(st)
             if verbose:
                 print(f"step {st.step}/{nsamp} acc={acc_rate:.3f} "

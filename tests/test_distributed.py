@@ -1,0 +1,107 @@
+"""Multi-host distributed execution: process-group wiring + the
+single-writer convention (replaces the reference's MPI staging protocol,
+``/root/reference/enterprise_warp/enterprise_warp.py:46-55``).
+
+Process count/index are mocked — the secondary-process behavior must be
+testable without a real multi-host cluster.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.parallel import distributed
+
+
+@pytest.fixture
+def as_secondary(monkeypatch):
+    """Pretend to be process 1 of 2."""
+    monkeypatch.setattr(distributed, "process_index", lambda: 1)
+    monkeypatch.setattr(distributed, "process_count", lambda: 2)
+    yield
+
+
+class TestProcessGroup:
+    def test_single_host_noop(self):
+        pidx, pcnt = distributed.init_distributed()
+        assert (pidx, pcnt) == (0, 1)
+        assert distributed.is_primary()
+
+    def test_env_contract_requires_all_three(self, monkeypatch):
+        # partial env must NOT attempt jax.distributed.initialize
+        monkeypatch.setenv("EWT_COORDINATOR", "host0:1234")
+        monkeypatch.delenv("EWT_NUM_PROCESSES", raising=False)
+        monkeypatch.delenv("EWT_PROCESS_ID", raising=False)
+        pidx, pcnt = distributed.init_distributed()
+        assert (pidx, pcnt) == (0, 1)
+
+    def test_initialize_called_with_env(self, monkeypatch):
+        calls = {}
+
+        import jax
+
+        def fake_init(coordinator_address, num_processes, process_id):
+            calls.update(coordinator_address=coordinator_address,
+                         num_processes=num_processes,
+                         process_id=process_id)
+
+        monkeypatch.setenv("EWT_COORDINATOR", "host0:1234")
+        monkeypatch.setenv("EWT_NUM_PROCESSES", "4")
+        monkeypatch.setenv("EWT_PROCESS_ID", "2")
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(distributed, "_INITIALIZED", False)
+        distributed.init_distributed()
+        assert calls == dict(coordinator_address="host0:1234",
+                             num_processes=4, process_id=2)
+        # restore: don't leave the sentinel set for other tests
+        monkeypatch.setattr(distributed, "_INITIALIZED", False)
+
+
+class TestSingleWriter:
+    def test_ptmcmc_secondary_writes_nothing(self, tmp_path, as_secondary):
+        from test_samplers import GaussianLike
+        from enterprise_warp_tpu.samplers import PTSampler
+
+        like = GaussianLike([0.0], [1.0])
+        s = PTSampler(like, str(tmp_path), ntemps=1, nchains=4, seed=0,
+                      cov_update=100)
+        s.sample(200, resume=False, verbose=False)
+        # the sampler ran (state advanced) but the output contract is
+        # untouched on a secondary host
+        assert not os.path.exists(tmp_path / "chain_1.txt")
+        assert not os.path.exists(tmp_path / "pars.txt")
+        assert not os.path.exists(tmp_path / "cov.npy")
+        assert not os.path.exists(tmp_path / "state.npz")
+
+    def test_ptmcmc_primary_writes(self, tmp_path):
+        from test_samplers import GaussianLike
+        from enterprise_warp_tpu.samplers import PTSampler
+
+        like = GaussianLike([0.0], [1.0])
+        s = PTSampler(like, str(tmp_path), ntemps=1, nchains=4, seed=0,
+                      cov_update=100)
+        s.sample(200, resume=False, verbose=False)
+        for f in ("chain_1.txt", "pars.txt", "cov.npy", "state.npz"):
+            assert os.path.exists(tmp_path / f)
+
+    def test_nested_secondary_writes_nothing(self, tmp_path, as_secondary):
+        from test_samplers import GaussianLike
+        from enterprise_warp_tpu.samplers import run_nested
+
+        like = GaussianLike([0.0], [0.5])
+        r = run_nested(like, outdir=str(tmp_path), nlive=150, dlogz=0.5,
+                       seed=0, verbose=False, checkpoint_every=5)
+        assert np.isfinite(r["log_evidence"])
+        assert list(tmp_path.iterdir()) == []
+
+    def test_nfreqs_secondary_writes_nothing(self, tmp_path, as_secondary):
+        from enterprise_warp_tpu.models.assemble import write_nfreqs_files
+
+        # the assemble-layer guard sits above this helper; emulate it the
+        # way init_model_likelihoods does
+        from enterprise_warp_tpu.parallel.distributed import is_primary
+        if is_primary():
+            write_nfreqs_files(str(tmp_path),
+                               {"J0000+0000": [("-be", "X", 30)]})
+        assert list(tmp_path.iterdir()) == []

@@ -351,8 +351,6 @@ class TestCouplingInverse:
         # for x = diag(1/s) V_+ y,  Binv B x == x
         Binv, logdet, B, npsr = self._setup("hd_noauto")
         from enterprise_warp_tpu.parallel.orf import hd_matrix
-        rng = np.random.default_rng(1)
-        pos = rng.standard_normal((npsr, 3))
         # rebuild the same inputs as _setup(seed=0) for the eigenbasis
         rng = np.random.default_rng(0)
         pos = rng.standard_normal((npsr, 3))

@@ -154,6 +154,44 @@ class TestNested:
                                     abs=max(4 * err, 0.25))
 
 
+class TestNestedResume:
+    def test_kill_and_resume_reproduces_lnz(self, tmp_path):
+        like = GaussianLike([0.5, -1.0], [0.4, 0.8])
+        # uninterrupted reference run
+        full = run_nested(like, outdir=str(tmp_path / "full"), nlive=300,
+                          dlogz=0.1, seed=3, verbose=False,
+                          checkpoint_every=10)
+        assert not os.path.exists(
+            tmp_path / "full" / "result_nested_ckpt.npz")
+        # interrupted run: max_iter stops it mid-flight, state persists
+        out2 = tmp_path / "resumed"
+        part = run_nested(like, outdir=str(out2), nlive=300, dlogz=0.1,
+                          seed=3, verbose=False, checkpoint_every=10,
+                          max_iter=20)
+        assert os.path.exists(out2 / "result_nested_ckpt.npz")
+        assert part["num_iterations"] == 20
+        # resume continues the identical random stream to convergence
+        res = run_nested(like, outdir=str(out2), nlive=300, dlogz=0.1,
+                         seed=3, verbose=False, checkpoint_every=10,
+                         resume=True)
+        assert not os.path.exists(out2 / "result_nested_ckpt.npz")
+        assert res["num_iterations"] == full["num_iterations"]
+        assert res["log_evidence"] == pytest.approx(
+            full["log_evidence"], abs=1e-10)
+
+    def test_resume_false_restarts(self, tmp_path):
+        like = GaussianLike([0.0], [0.5])
+        run_nested(like, outdir=str(tmp_path), nlive=200, dlogz=0.1,
+                   seed=1, verbose=False, max_iter=10,
+                   checkpoint_every=5)
+        ck = tmp_path / "result_nested_ckpt.npz"
+        assert ck.exists()
+        r = run_nested(like, outdir=str(tmp_path), nlive=200, dlogz=0.1,
+                       seed=1, verbose=False, resume=False)
+        assert r["log_evidence"] == pytest.approx(
+            like.analytic_lnz, abs=0.5)
+
+
 class TestHyperModel:
     def test_product_space_bayes_factor(self, tmp_path):
         # model 1's likelihood is e^2 times model 0's: BF_10 = e^2
