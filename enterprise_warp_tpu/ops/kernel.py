@@ -216,7 +216,12 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     # dynamic range).
     diag = jnp.diagonal(S)
     null = diag <= 0.0
-    dmax = jnp.maximum(jnp.max(diag), 1e-300)
+    # eigenvalue charge for dropped rows: overestimating is safe (pushes
+    # lnL down), underestimating makes the corner attractive — so anchor
+    # to the largest scale present in the matrix, floored at 1.0 for the
+    # fully-degenerate case where even that is rounding residue
+    dmax = jnp.maximum(jnp.maximum(jnp.max(diag), jnp.max(jnp.abs(S))),
+                       1.0)
     d = jnp.where(null, dmax, jnp.maximum(diag, 1e-30))
     s = jnp.where(null, 0.0, 1.0 / jnp.sqrt(d))
     Sn = S * s[:, None] * s[None, :]

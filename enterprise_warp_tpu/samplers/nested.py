@@ -116,7 +116,23 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
     lnx_offsets = np.concatenate([[0.0], np.cumsum(dlnx_per)[:-1]])
     dlnx_batch = float(np.sum(dlnx_per))
 
-    if resume and ckpt_path is not None and os.path.exists(ckpt_path):
+    def _ckpt_compatible(z):
+        """A stale checkpoint from a different configuration must not be
+        silently resumed against the new run — live points / shrinkage
+        schedule / random stream would all be wrong and lnZ silently
+        corrupted. Identity = sampler geometry + model fingerprint."""
+        want = dict(nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
+                    params_fp=_params_fingerprint(like))
+        for k, v in want.items():
+            if k not in z.files or str(z[k]) != str(v):
+                print(f"NS checkpoint incompatible ({k}: "
+                      f"{z[k] if k in z.files else 'missing'} != {v}); "
+                      "starting fresh")
+                return False
+        return True
+
+    if resume and ckpt_path is not None and os.path.exists(ckpt_path) \
+            and _ckpt_compatible(np.load(ckpt_path, allow_pickle=False)):
         z = np.load(ckpt_path)
         u = jnp.asarray(z["u"])
         lnl = jnp.asarray(z["lnl"])
@@ -169,7 +185,9 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
             dead_lnx=(np.concatenate(dead_lnx) if dead_lnx
                       else np.zeros(0)),
             dead_dlnx=(np.concatenate(dead_dlnx) if dead_dlnx
-                       else np.zeros(0)))
+                       else np.zeros(0)),
+            nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
+            params_fp=_params_fingerprint(like))
         os.replace(tmp, ckpt_path)
 
     converged = False
@@ -264,6 +282,18 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
     result["log_weights"] = logw_norm
     result["posterior_samples"] = posterior
     return result
+
+
+def _params_fingerprint(like):
+    """Cheap model-identity string: parameter names + prior reprs."""
+    parts = []
+    for p in getattr(like, "params", []):
+        parts.append(f"{p.name}:{type(p.prior).__name__}"
+                     f":{getattr(p.prior, 'lo', '')}"
+                     f":{getattr(p.prior, 'hi', '')}"
+                     f":{getattr(p.prior, 'mu', '')}"
+                     f":{getattr(p.prior, 'sigma', '')}")
+    return "|".join(parts)
 
 
 def _logsumexp(x):

@@ -55,8 +55,9 @@ class PTState:
     hist_len: int
     step: int
     accepted: np.ndarray   # (W,) cumulative acceptances
-    swaps_accepted: int
-    swaps_proposed: int
+    swaps_accepted: np.ndarray   # (ntemps-1,) per-rung accepted swaps
+    swaps_proposed: np.ndarray   # (ntemps-1,) per-rung proposed swaps
+    ladder: np.ndarray     # (ntemps,) current temperature ladder
 
 
 def _temperature_ladder(ntemps, tmax=None):
@@ -78,7 +79,8 @@ class PTSampler:
     def __init__(self, like, outdir, ntemps=2, nchains=8, seed=0,
                  scam_weight=30, am_weight=15, de_weight=50,
                  prior_weight=10, cov_update=1000, swap_every=10,
-                 tmax=None, init_cov=None, burn=0):
+                 tmax=None, init_cov=None, burn=0, adapt_ladder=True,
+                 ladder_t0=1000.0, swap_target=0.25):
         self.like = like
         self.outdir = outdir
         self.ntemps = ntemps
@@ -92,8 +94,14 @@ class PTSampler:
         self.swap_every = swap_every
         self.burn = burn     # steps before covariance adaptation engages
         self.seed = seed
-        # temperature per walker: chains-major layout [T0 chains..., T1...]
-        self.temps = np.repeat(_temperature_ladder(ntemps, tmax), nchains)
+        self.init_ladder = _temperature_ladder(ntemps, tmax)
+        # swap-rate-targeted ladder adaptation (Vousden et al. 2016
+        # style, with a decaying rate so ergodicity is preserved):
+        # spacings grow where adjacent rungs swap too eagerly and shrink
+        # where they decouple, each targeting ``swap_target``
+        self.adapt_ladder = adapt_ladder
+        self.ladder_t0 = float(ladder_t0)
+        self.swap_target = float(swap_target)
         self.init_cov = init_cov
         self._lnprior_batch = jax.jit(jax.vmap(
             lambda t: like.log_prior(t)))
@@ -120,8 +128,10 @@ class PTSampler:
         return PTState(x=x0, lnl=lnl, lnp=lnp,
                        key=np.asarray(jax.random.PRNGKey(self.seed)),
                        cov=cov, history=history, hist_len=1, step=0,
-                       accepted=np.zeros(self.W), swaps_accepted=0,
-                       swaps_proposed=0)
+                       accepted=np.zeros(self.W),
+                       swaps_accepted=np.zeros(self.ntemps - 1),
+                       swaps_proposed=np.zeros(self.ntemps - 1),
+                       ladder=self.init_ladder.copy())
 
     def _prior_scales(self):
         scales = np.ones(self.ndim)
@@ -144,16 +154,26 @@ class PTSampler:
                  key=st.key, cov=st.cov, history=st.history,
                  hist_len=st.hist_len, step=st.step,
                  accepted=st.accepted, swaps_accepted=st.swaps_accepted,
-                 swaps_proposed=st.swaps_proposed)
+                 swaps_proposed=st.swaps_proposed, ladder=st.ladder)
 
     def _load_state(self):
         z = np.load(self._ckpt_path)
+        # per-rung counters + adapted ladder; checkpoints from before the
+        # ladder adaptation hold scalar counters -> reset those
+        sacc = np.atleast_1d(np.asarray(z["swaps_accepted"], dtype=float))
+        sprop = np.atleast_1d(np.asarray(z["swaps_proposed"],
+                                         dtype=float))
+        if sacc.shape != (self.ntemps - 1,):
+            sacc = np.zeros(self.ntemps - 1)
+            sprop = np.zeros(self.ntemps - 1)
+        ladder = (np.asarray(z["ladder"]) if "ladder" in z.files
+                  else self.init_ladder.copy())
         return PTState(x=z["x"], lnl=z["lnl"], lnp=z["lnp"], key=z["key"],
                        cov=z["cov"], history=z["history"],
                        hist_len=int(z["hist_len"]), step=int(z["step"]),
                        accepted=z["accepted"],
-                       swaps_accepted=int(z["swaps_accepted"]),
-                       swaps_proposed=int(z["swaps_proposed"]))
+                       swaps_accepted=sacc, swaps_proposed=sprop,
+                       ladder=ladder)
 
     # ---------------- the jitted block --------------------------------- #
     def _log_prior_dims(self, theta):
@@ -171,7 +191,6 @@ class PTSampler:
     def _make_block(self, nsteps):
         like = self.like
         log_prior_dims = self._log_prior_dims
-        temps = jnp.asarray(self.temps)
         jump_p = jnp.asarray(self.jump_probs)
         W, nd = self.W, self.ndim
         ntemps, nchains = self.ntemps, self.nchains
@@ -179,7 +198,7 @@ class PTSampler:
 
         def one_step(carry, step_idx):
             x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop, \
-                eigvecs, eigvals, chol = carry
+                eigvecs, eigvals, chol, temps = carry
             key, k1, k2, k3, k4, k5, k6, k7, k8 = jax.random.split(key, 9)
 
             # --- proposals (all four families, select per walker) -----
@@ -252,8 +271,8 @@ class PTSampler:
                     xt = xt.at[i].set(xi).at[i + 1].set(xj)
                     lt = lt.at[i].set(li).at[i + 1].set(lj)
                     pt = pt.at[i].set(pi).at[i + 1].set(pj)
-                    return xt, lt, pt, sacc + jnp.sum(sw), \
-                        sprop + nchains
+                    return xt, lt, pt, sacc.at[i].add(jnp.sum(sw)), \
+                        sprop.at[i].add(nchains)
 
                 xt, lt, pt, sacc, sprop = jax.lax.fori_loop(
                     0, ntemps - 1, swap_pair, (xt, lt, pt, sacc, sprop))
@@ -274,14 +293,14 @@ class PTSampler:
             cold_lnl = lnl[:nchains]
             cold_lnp = lnp[:nchains]
             return ((x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                     eigvecs, eigvals, chol),
+                     eigvecs, eigvals, chol, temps),
                     (cold, cold_lnl, cold_lnp))
 
         @partial(jax.jit, static_argnames=())
         def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                  eigvecs, eigvals, chol):
+                  eigvecs, eigvals, chol, temps):
             carry = (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                     eigvecs, eigvals, chol)
+                     eigvecs, eigvals, chol, temps)
             carry, (cs, cl, cp) = jax.lax.scan(
                 one_step, carry, jnp.arange(nsteps))
             return carry, cs, cl, cp
@@ -330,13 +349,17 @@ class PTSampler:
             eigvals = np.maximum(eigvals, 1e-16)
             chol = np.linalg.cholesky(cov)
 
+            sacc_before = st.swaps_accepted.copy()
+            sprop_before = st.swaps_proposed.copy()
+            temps = np.repeat(st.ladder, self.nchains)
             carry, cold, cold_lnl, cold_lnp = self._block(
                 jnp.asarray(st.x), jnp.asarray(st.lnl),
                 jnp.asarray(st.lnp), jnp.asarray(st.key),
                 jnp.asarray(st.history), st.hist_len,
-                jnp.asarray(st.accepted), st.swaps_accepted,
-                st.swaps_proposed, jnp.asarray(eigvecs),
-                jnp.asarray(eigvals), jnp.asarray(chol))
+                jnp.asarray(st.accepted), jnp.asarray(st.swaps_accepted),
+                jnp.asarray(st.swaps_proposed), jnp.asarray(eigvecs),
+                jnp.asarray(eigvals), jnp.asarray(chol),
+                jnp.asarray(temps))
             (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
              *_unused) = carry
             st.x = np.asarray(x)
@@ -346,9 +369,21 @@ class PTSampler:
             st.history = np.asarray(hist)
             st.hist_len = int(min(st.hist_len + todo, _HISTORY))
             st.accepted = np.asarray(acc)
-            st.swaps_accepted = int(sacc)
-            st.swaps_proposed = int(sprop)
+            st.swaps_accepted = np.asarray(sacc, dtype=float)
+            st.swaps_proposed = np.asarray(sprop, dtype=float)
             st.step += todo
+
+            # --- swap-rate-targeted ladder adaptation ----------------- #
+            if self.adapt_ladder and self.ntemps > 1:
+                dprop = st.swaps_proposed - sprop_before
+                dacc = st.swaps_accepted - sacc_before
+                if np.all(dprop > 0):
+                    rate = dacc / dprop
+                    kappa = self.ladder_t0 / (st.step + self.ladder_t0)
+                    log_gap = np.log(np.diff(st.ladder))
+                    log_gap += kappa * (rate - self.swap_target)
+                    st.ladder = np.concatenate(
+                        [[1.0], 1.0 + np.cumsum(np.exp(log_gap))])
 
             # --- write cold chains (interleaved walkers) -------------- #
             cs = np.asarray(cold)[::thin]          # (steps, nchains, nd)
@@ -356,8 +391,9 @@ class PTSampler:
             cp = np.asarray(cold_lnp)[::thin]
             acc_rate = float(np.mean(st.accepted[:self.nchains])
                              / max(st.step, 1))
-            swap_rate = (st.swaps_accepted / st.swaps_proposed
-                         if st.swaps_proposed else 0.0)
+            tot_prop = float(np.sum(st.swaps_proposed))
+            swap_rate = (float(np.sum(st.swaps_accepted)) / tot_prop
+                         if tot_prop else 0.0)
             rows = np.concatenate([
                 cs.reshape(-1, self.ndim),
                 (cp + cl).reshape(-1, 1),
@@ -416,6 +452,8 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
         ntemps = params.sampler_kwargs.get("ntemps", 2) \
             if hasattr(params, "sampler_kwargs") else 2
         opts["ntemps"] = max(int(ntemps), 1)
+        if skw.get("Tmax") is not None:
+            opts["tmax"] = float(skw["Tmax"])
     opts.update(kw)
     sampler = PTSampler(like, outdir, **opts)
     sampler.sample(nsamp, resume=resume, verbose=verbose, thin=thin)

@@ -108,6 +108,43 @@ class TestPTMCMC:
         assert n2 == 2 * n1  # appended, not restarted
 
 
+class TestLadderAdaptation:
+    def test_swap_rates_tracked_per_rung(self, tmp_path):
+        like = GaussianLike([0.0, 1.0], [0.5, 0.5])
+        s = PTSampler(like, str(tmp_path), ntemps=4, nchains=8, seed=0,
+                      cov_update=500)
+        st = s.sample(3000, resume=False, verbose=False)
+        assert st.swaps_proposed.shape == (3,)
+        assert np.all(st.swaps_proposed > 0)
+        assert np.all(st.swaps_accepted <= st.swaps_proposed)
+
+    def test_ladder_adapts_toward_target(self, tmp_path):
+        # rungs packed absurdly tight -> ~100% swap acceptance -> the
+        # adaptation must widen the gaps (ladder top grows)
+        like = GaussianLike([0.0], [0.5])
+        s = PTSampler(like, str(tmp_path), ntemps=4, nchains=8, seed=1,
+                      cov_update=500, tmax=1.1, ladder_t0=5000.0)
+        st = s.sample(4000, resume=False, verbose=False)
+        assert st.ladder[0] == 1.0
+        assert np.all(np.diff(st.ladder) > 0)       # stays ordered
+        assert st.ladder[-1] > 1.1 * 1.5            # gaps widened
+        # rates should have come off the ~1.0 ceiling toward the target
+        rates = st.swaps_accepted / st.swaps_proposed
+        assert np.mean(rates) < 0.98
+
+    def test_ladder_persists_through_resume(self, tmp_path):
+        like = GaussianLike([0.0], [0.5])
+        s = PTSampler(like, str(tmp_path), ntemps=3, nchains=4, seed=2,
+                      cov_update=250, tmax=1.2)
+        st1 = s.sample(500, resume=False, verbose=False)
+        s2 = PTSampler(like, str(tmp_path), ntemps=3, nchains=4, seed=2,
+                       cov_update=250, tmax=1.2)
+        st2 = s2.sample(1000, resume=True, verbose=False)
+        assert st2.step == 1000
+        # adaptation continued from the saved ladder, not from scratch
+        assert not np.allclose(st2.ladder, s2.init_ladder)
+
+
 class TestConvergence:
     def test_sample_to_convergence_gaussian(self, tmp_path):
         from enterprise_warp_tpu.samplers.convergence import \
@@ -178,6 +215,18 @@ class TestNestedResume:
         assert res["num_iterations"] == full["num_iterations"]
         assert res["log_evidence"] == pytest.approx(
             full["log_evidence"], abs=1e-10)
+
+    def test_stale_checkpoint_not_resumed(self, tmp_path):
+        # a checkpoint from a different configuration (nlive) must be
+        # ignored, not silently resumed against the new run
+        like = GaussianLike([0.0], [0.5])
+        run_nested(like, outdir=str(tmp_path), nlive=200, dlogz=0.1,
+                   seed=1, verbose=False, max_iter=10, checkpoint_every=5)
+        assert (tmp_path / "result_nested_ckpt.npz").exists()
+        r = run_nested(like, outdir=str(tmp_path), nlive=300, dlogz=0.1,
+                       seed=1, verbose=False, resume=True)
+        assert r["log_evidence"] == pytest.approx(
+            like.analytic_lnz, abs=0.5)
 
     def test_resume_false_restarts(self, tmp_path):
         like = GaussianLike([0.0], [0.5])

@@ -1,0 +1,189 @@
+"""Sampler mixing benchmark: ESS/step and product-space hop rates.
+
+Evidence that the native SCAM/AM/DE/prior-draw jump mix and the adaptive
+temperature ladder reproduce PTMCMCSampler-grade mixing (the reference's
+sampler setup being replaced:
+``/root/reference/examples/run_example_paramfile.py:27-34``). Three hard
+targets:
+
+1. **banana** — strongly correlated Rosenbrock-warped Gaussian (the
+   covariance-adaptation stress test);
+2. **bimodal** — two well-separated Gaussian modes (the tempering +
+   prior-draw stress test; single-temperature random walk cannot cross);
+3. **two-model hypermodel** — product-space nmodel hop rate with and
+   without prior-draw jumps (the mechanism PTMCMCSampler gets from
+   enterprise_extensions' ``setup_sampler`` draws).
+
+Usage: ``python tools/mixing_bench.py [--quick]`` — prints a JSON report
+and writes MIXING.json at the repo root.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+
+from enterprise_warp_tpu.models.priors import (Parameter,   # noqa: E402
+                                               Uniform)
+from enterprise_warp_tpu.samplers import (HyperModelLikelihood,  # noqa: E402
+                                          PTSampler)
+from enterprise_warp_tpu.utils.diagnostics import (  # noqa: E402
+    summarize_chains)
+
+
+class AnalyticLike:
+    """Likelihood wrapper over an arbitrary jax log-density in a box."""
+
+    def __init__(self, fn, ndim, lo=-10.0, hi=10.0, offset=0.0):
+        self.ndim = ndim
+        self.params = [Parameter(f"p{i}", Uniform(lo, hi))
+                       for i in range(ndim)]
+        self.param_names = [p.name for p in self.params]
+        self._fn = lambda t: fn(t) + offset
+        self.loglike = jax.jit(self._fn)
+        self.loglike_batch = jax.jit(jax.vmap(self._fn))
+
+    def log_prior(self, theta):
+        theta = jnp.atleast_1d(theta)
+        out = 0.0
+        for i, p in enumerate(self.params):
+            out = out + p.prior.logpdf(theta[..., i])
+        return out
+
+    def from_unit(self, u):
+        return jnp.stack([p.prior.from_unit(u[..., i])
+                          for i, p in enumerate(self.params)], axis=-1)
+
+    def sample_prior(self, rng, n=1):
+        out = np.empty((n, self.ndim))
+        for i, p in enumerate(self.params):
+            out[:, i] = [p.prior.sample(rng) for _ in range(n)]
+        return out
+
+
+def banana_like(b=0.3):
+    def fn(t):
+        x, y = t[0], t[1]
+        y_w = y - b * (x ** 2 - 4.0)
+        return -0.5 * (x ** 2 / 4.0 + y_w ** 2 / 0.25)
+
+    return AnalyticLike(fn, 2)
+
+
+def bimodal_like(sep=6.0):
+    def fn(t):
+        d0 = jnp.sum((t - sep / 2) ** 2) / 0.5
+        d1 = jnp.sum((t + sep / 2) ** 2) / 0.5
+        return jnp.logaddexp(-0.5 * d0, -0.5 * d1)
+
+    return AnalyticLike(fn, 2)
+
+
+def ess_per_step(like, nsamp, ntemps=4, nchains=8, seed=0, burn_frac=0.4,
+                 **kw):
+    with tempfile.TemporaryDirectory() as outdir:
+        s = PTSampler(like, outdir, ntemps=ntemps, nchains=nchains,
+                      seed=seed, cov_update=1000, **kw)
+        blocks = []
+        s.sample(nsamp, resume=False, verbose=False, collect=blocks)
+        rates = (s_rates(s) if ntemps > 1 else None)
+    c = np.concatenate(blocks, axis=0)           # (steps, nchains, nd)
+    keep = int(c.shape[0] * (1 - burn_frac))
+    chains = np.transpose(c[-keep:], (1, 0, 2)).astype(np.float64)
+    summ = summarize_chains(chains, like.param_names)
+    worst = summ["_worst"]
+    return dict(
+        steps=nsamp,
+        ess_min=round(worst["ess"], 1),
+        ess_per_step=round(worst["ess"] / nsamp, 4),
+        rhat_max=round(worst["rhat"], 4),
+        swap_rates=rates,
+        means={k: round(v["mean"], 3) for k, v in summ.items()
+               if not k.startswith("_")})
+
+
+def s_rates(s):
+    st = s._load_state()
+    with np.errstate(invalid="ignore"):
+        r = st.swaps_accepted / np.maximum(st.swaps_proposed, 1)
+    return [round(float(x), 3) for x in r]
+
+
+def mode_occupancy(like, nsamp, seed):
+    """Fraction of post-burn cold samples in the positive mode (target:
+    0.5) — a direct mode-hopping metric for the bimodal target."""
+    with tempfile.TemporaryDirectory() as outdir:
+        s = PTSampler(like, outdir, ntemps=4, nchains=8, seed=seed,
+                      cov_update=1000)
+        blocks = []
+        s.sample(nsamp, resume=False, verbose=False, collect=blocks)
+    c = np.concatenate(blocks, axis=0)
+    keep = int(c.shape[0] * 0.6)
+    flat = c[-keep:].reshape(-1, like.ndim)
+    return float(np.mean(flat[:, 0] > 0))
+
+
+def hop_rate(prior_weight, nsamp, seed=0, de_weight=50):
+    """Product-space nmodel transition rate on a hard two-model problem
+    (modes of the two models are far apart in parameter space).
+
+    Run single-temperature to isolate the prior-draw mechanism: without
+    tempering, a local random walk can only change model when a jump
+    teleports the shared parameter across the gap — exactly what
+    prior-draw jumps provide (and what the reference gets from
+    enterprise_extensions' setup_sampler draw mix)."""
+    m0 = AnalyticLike(
+        lambda t: -0.5 * jnp.sum((t - 3.0) ** 2) / 0.25, 1)
+    m1 = AnalyticLike(
+        lambda t: -0.5 * jnp.sum((t + 3.0) ** 2) / 0.25, 1,
+        offset=1.0)
+    hyper = HyperModelLikelihood({0: m0, 1: m1})
+    with tempfile.TemporaryDirectory() as outdir:
+        s = PTSampler(hyper, outdir, ntemps=1, nchains=8, seed=seed,
+                      cov_update=1000, prior_weight=prior_weight,
+                      de_weight=de_weight)
+        blocks = []
+        s.sample(nsamp, resume=False, verbose=False, collect=blocks)
+    c = np.concatenate(blocks, axis=0)           # (steps, nchains, nd)
+    nm = c[:, :, hyper.ndim - 1] >= 0.5          # model indicator
+    hops = np.mean(nm[1:] != nm[:-1])
+    frac1 = float(np.mean(nm[c.shape[0] // 2:]))
+    return dict(prior_weight=prior_weight,
+                hop_rate=round(float(hops), 5),
+                frac_model1=round(frac1, 3),
+                logbf_est=round(float(np.log(max(frac1, 1e-9)
+                                             / max(1 - frac1, 1e-9))), 3))
+
+
+def main():
+    quick = "--quick" in sys.argv
+    n = 4000 if quick else 20000
+    report = {}
+
+    report["banana"] = ess_per_step(banana_like(), n, seed=0)
+    report["bimodal"] = ess_per_step(bimodal_like(), n, seed=1)
+    report["bimodal"]["mode_occupancy"] = round(
+        mode_occupancy(bimodal_like(), n, seed=2), 3)
+    # expected logBF = offset 1.0: both models identical up to e^1.
+    # Both prior draws and DE history differences can teleport the shared
+    # parameter across the inter-mode gap; the local-only variant (no DE,
+    # no draws) shows what happens without either mechanism.
+    report["hypermodel_with_prior_draws"] = hop_rate(10, n)
+    report["hypermodel_no_prior_draws"] = hop_rate(0, n)
+    report["hypermodel_local_jumps_only"] = hop_rate(0, n, de_weight=0)
+
+    with open(os.path.join(REPO, "MIXING.json"), "w") as fh:
+        json.dump(report, fh, indent=1)
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()
