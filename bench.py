@@ -182,5 +182,106 @@ def main():
     }))
 
 
+def config_benches():
+    """Per-config throughput for every BASELINE.json config (run with
+    ``python bench.py --configs``; writes CONFIGS_BENCH.json). Kept out
+    of the default run so the driver's headline bench stays fast — the
+    npsr=45 joint build compiles for ~2.5 min."""
+    import jax
+
+    from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                            build_pulsar_likelihood)
+    from enterprise_warp_tpu.parallel import build_pta_likelihood
+    from enterprise_warp_tpu.sim.noise import make_fake_pta
+    from __graft_entry__ import _flagship_single_pulsar
+
+    out = {}
+
+    def moderate_theta(like, seed=3, spread=0.01, batch=1):
+        rng = np.random.default_rng(seed)
+        th = np.empty(like.ndim)
+        for i, n in enumerate(like.param_names):
+            if n.endswith("efac"):
+                th[i] = 1.0 + 0.1 * rng.random()
+            elif "equad" in n or "ecorr" in n:
+                th[i] = -7.0
+            elif n.endswith("log10_A"):
+                th[i] = -14.0
+            elif n.endswith("_idx"):
+                th[i] = 4.0
+            else:
+                th[i] = 3.5
+        return np.tile(th, (batch, 1)) + spread * rng.standard_normal(
+            (batch, like.ndim))
+
+    def run(name, like, batch, note, seed=3):
+        th = moderate_theta(like, seed=seed, batch=batch)
+        t0 = time.perf_counter()
+        o = like.loglike_batch(th)
+        jax.block_until_ready(o)
+        compile_s = time.perf_counter() - t0
+        eps = time_device(like, th, reps=5)
+        out[name] = dict(evals_per_s=round(eps, 1), batch=batch,
+                         compile_s=round(compile_s, 1), note=note)
+        print(f"# config {name}: {eps:.1f} evals/s (batch={batch}, "
+              f"compile {compile_s:.0f}s) — {note}", file=sys.stderr)
+
+    # config 1 (headline single-pulsar noise run) is the default bench.
+
+    # config 2: 10-pulsar simulated PTA, per-pulsar red noise, one
+    # vmap'd joint kernel (no cross-pulsar coupling)
+    psrs = make_fake_pta(npsr=10, ntoa=334, seed=5)
+    rng = np.random.default_rng(5)
+    for p in psrs:
+        p.residuals = p.toaerrs * rng.standard_normal(len(p))
+    tls = []
+    for p in psrs:
+        m = StandardModels(psr=p)
+        tls.append(TermList(p, [m.efac("by_backend"),
+                                m.equad("by_backend"),
+                                m.spin_noise("powerlaw_20_nfreqs")]))
+    run("2_pta10_vmap", build_pta_likelihood(psrs, tls), 256,
+        "10-psr sim PTA, per-psr red noise, pulsar-batched kernel")
+
+    # config 3: 45-pulsar Hellings-Downs correlated GWB joint fit
+    psrs = make_fake_pta(npsr=45, ntoa=500, seed=6)
+    rng = np.random.default_rng(6)
+    for p in psrs:
+        p.residuals = p.toaerrs * rng.standard_normal(len(p))
+    tls = []
+    for p in psrs:
+        m = StandardModels(psr=p)
+        tls.append(TermList(p, [m.efac("by_backend"),
+                                m.equad("by_backend"),
+                                m.spin_noise("powerlaw_30_nfreqs"),
+                                m.gwb("hd_vary_gamma_20_nfreqs")]))
+    run("3_hd45_joint", build_pta_likelihood(psrs, tls), 32,
+        "45-psr HD-correlated GWB joint fit (nested-Schur TPU path)")
+
+    # config 4: DM-variation + chromatic (sampled index) custom model
+    psr, _ = _flagship_single_pulsar()
+    m = StandardModels(psr=psr)
+    terms = TermList(psr, [m.efac("by_backend"), m.equad("by_backend"),
+                           m.spin_noise("powerlaw_20_nfreqs"),
+                           m.dm_noise("powerlaw_20_nfreqs"),
+                           m.chromred("vary_20_nfreqs")])
+    run("4_dm_chromatic", build_pulsar_likelihood(psr, terms), BATCH,
+        "DM + chromatic noise with sampled chromatic index")
+
+    # config 5: batched-walker ensemble (the walker batch IS the
+    # data-parallel ensemble axis; multi-chip extends it over a mesh)
+    psr, terms = _flagship_single_pulsar()
+    run("5_walker_ensemble", build_pulsar_likelihood(psr, terms), 4096,
+        "flagship model, 4096-walker ensemble batch on one chip")
+
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "CONFIGS_BENCH.json"), "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps({"configs": out}))
+
+
 if __name__ == "__main__":
-    main()
+    if "--configs" in sys.argv:
+        config_benches()
+    else:
+        main()

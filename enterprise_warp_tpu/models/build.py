@@ -201,17 +201,27 @@ def collect_params(white_blocks, basis_blocks):
     return all_params
 
 
-def white_static(white_blocks, mapping):
-    """Device-ready white-noise block structures."""
-    return [(wb.kind, jnp.asarray(wb.mask_matrix),
-             [mapping[p.name] for p in wb.params])
-            for wb in white_blocks]
+def white_static(white_blocks, mapping, n_pad=0):
+    """Device-ready white-noise block structures (selection masks padded
+    with zero columns for TOA-axis-sharded builds)."""
+    out = []
+    for wb in white_blocks:
+        mm = wb.mask_matrix
+        if n_pad:
+            mm = np.pad(mm, ((0, 0), (0, n_pad)))
+        out.append((wb.kind, jnp.asarray(mm),
+                    [mapping[p.name] for p in wb.params]))
+    return out
 
 
-def basis_static(basis_blocks, mapping):
-    """Device-ready basis block structures."""
+def basis_static(basis_blocks, mapping, n_pad=0):
+    """Device-ready basis block structures (``log_nu_ratio`` padded with
+    zeros — unit dynamic scale — for TOA-axis-sharded builds)."""
     out = []
     for bb in basis_blocks:
+        lognu = bb.log_nu_ratio
+        if lognu is not None and n_pad:
+            lognu = np.pad(lognu, (0, n_pad))
         out.append(dict(
             psd=bb.psd, col_slice=bb.col_slice,
             freqs=None if bb.freqs is None else jnp.asarray(bb.freqs),
@@ -222,8 +232,7 @@ def basis_static(basis_blocks, mapping):
             ncols=bb.ncols,
             dyn=None if bb.dynamic_idx is None else
             mapping[bb.dynamic_idx.name],
-            lognu=None if bb.log_nu_ratio is None else
-            jnp.asarray(bb.log_nu_ratio),
+            lognu=None if lognu is None else jnp.asarray(lognu),
             orf=bb.orf))
     return out
 
@@ -280,12 +289,22 @@ def eval_phi_T(theta, bb_static, T_w_j, cs2_j):
 
 
 def build_pulsar_likelihood(psr, terms, fixed_values=None,
-                            gram_mode="split", ecorr_dt=10.0):
+                            gram_mode="split", ecorr_dt=10.0,
+                            mesh=None, toa_axis="toa"):
     """Compile a TermList for one pulsar into a :class:`PulsarLikelihood`.
 
     ``fixed_values`` maps parameter names to values for Constant-prior
     parameters (the reference's PAL2-noisefile fixing,
     ``enterprise_warp.py:504-508``).
+
+    ``mesh`` — optional ``jax.sharding.Mesh`` with axis ``toa_axis``: the
+    whitened row arrays (``r_w``/``M_w``/``T_w``, white-noise selection
+    masks) are placed with ``NamedSharding`` along the TOA axis, so for
+    extreme N_toa (real MSP datasets reach 1e4-1e5, SURVEY §5) each
+    device computes its chunk of the O(ntoa * nbasis^2) Gram contractions
+    and XLA all-reduces the small (nbasis x nbasis) partials over ICI.
+    TOAs are padded (mask rows, nw=1) to a shard-divisible count; results
+    are identical to the unsharded build.
     """
     ntoa = len(psr)
     sigma = psr.toaerrs
@@ -298,20 +317,54 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     sampled, mapping = _resolve_params(
         collect_params(white_blocks, basis_blocks), fixed_values)
 
+    # --- TOA-axis padding/sharding over the mesh -----------------------
+    from ..ops.kernel import _CHUNK
+    n_pad = 0
+    if mesh is not None:
+        ndev = mesh.shape[toa_axis]
+        quantum = ndev * _CHUNK     # keep split-mode chunks shard-local
+        n_pad = (-ntoa) % quantum
+    ntoa_tot = ntoa + n_pad
+    mask = None
+    if n_pad:
+        mask = np.concatenate([np.ones(ntoa), np.zeros(n_pad)])
+        pad_rows = ((0, n_pad), (0, 0))
+        r_w = np.pad(r_w, (0, n_pad))
+        M_w = np.pad(M_w, pad_rows)
+        T_w = np.pad(T_w, pad_rows)
+        sigma = np.pad(sigma, (0, n_pad), constant_values=1.0)
+
     # --- static device arrays ------------------------------------------
     sigma2_j = jnp.asarray(sigma ** 2)
     r_w_j = jnp.asarray(r_w)
     M_w_j = jnp.asarray(M_w)
     T_w_j = jnp.asarray(T_w)
     cs2_j = jnp.asarray(col_scale2)
-    wb_static = white_static(white_blocks, mapping)
-    bb_static = basis_static(basis_blocks, mapping)
+    mask_j = None if mask is None else jnp.asarray(mask)
+    wb_static = white_static(white_blocks, mapping, n_pad=n_pad)
+    bb_static = basis_static(basis_blocks, mapping, n_pad=n_pad)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        rows = NamedSharding(mesh, PartitionSpec(toa_axis))
+        rows2 = NamedSharding(mesh, PartitionSpec(toa_axis, None))
+        r_w_j = jax.device_put(r_w_j, rows)
+        M_w_j = jax.device_put(M_w_j, rows2)
+        T_w_j = jax.device_put(T_w_j, rows2)
+        sigma2_j = jax.device_put(sigma2_j, rows)
+        if mask_j is not None:
+            mask_j = jax.device_put(mask_j, rows)
+        wb_static = [
+            (kind,
+             jax.device_put(mm, NamedSharding(
+                 mesh, PartitionSpec(None, toa_axis))),
+             refs)
+            for kind, mm, refs in wb_static]
 
     def loglike(theta):
-        nw = eval_nw(theta, wb_static, ntoa, sigma2_j)
+        nw = eval_nw(theta, wb_static, ntoa_tot, sigma2_j)
         phi, T_mat = eval_phi_T(theta, bb_static, T_w_j, cs2_j)
         lnl = marginalized_loglike(nw, phi, r_w_j, M_w_j, T_mat,
-                                   gram_mode=gram_mode)
+                                   mask=mask_j, gram_mode=gram_mode)
         # a numerically non-PD Sigma (extreme prior corners) yields NaN;
         # the reference stack maps Cholesky failure to -inf likewise
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)

@@ -21,3 +21,10 @@ def make_psr_mesh(n_devices=None, axis="psr"):
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis,))
+
+
+def make_toa_mesh(n_devices=None):
+    """A 1-D device mesh over the TOA axis (extreme-N_toa single-pulsar
+    Gram sharding, SURVEY §5: each device Grams its TOA chunk and XLA
+    all-reduces the small (nbasis x nbasis) partials)."""
+    return make_psr_mesh(n_devices, axis="toa")
