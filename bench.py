@@ -158,7 +158,8 @@ def main():
     from enterprise_warp_tpu.models import StandardModels, TermList
     from enterprise_warp_tpu.sim.noise import make_fake_pulsar
     for ntoa_s, nfreq_s, batch_s in ((334, 20, 256), (334, 20, 4096),
-                                     (1024, 30, 1024), (4096, 50, 1024)):
+                                     (1024, 30, 1024), (4096, 50, 1024),
+                                     (32768, 50, 256)):
         p = make_fake_pulsar(name="B", ntoa=ntoa_s,
                              backends=("X", "Y"),
                              freqs_mhz=(1400.0,), seed=3)

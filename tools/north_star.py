@@ -5,9 +5,15 @@ sampling loop on device vs a 1-core CPU running the oracle-grade f64 path,
 *at matched posterior* (R-hat / ESS gated, posteriors compared).
 
 Usage:
-  python tools/north_star.py leg device   # run the device leg, print JSON
-  python tools/north_star.py leg cpu      # run the 1-core CPU leg
-  python tools/north_star.py              # orchestrate both, write NORTH_STAR.json
+  python tools/north_star.py                    # all three legs
+  python tools/north_star.py legs cpu,scalar    # subset; resumable
+  python tools/north_star.py legs device        # e.g. later, on the chip
+  python tools/north_star.py leg <device|cpu>   # one leg in-process (JSON)
+
+Legs: ``device`` (TPU batched sampler), ``cpu`` (same algorithm, jax-CPU,
+1 core), ``scalar`` (reference-shaped scalar numpy loop). Results merge
+into NORTH_STAR.partial.json (config-fingerprinted; stale legs rerun);
+NORTH_STAR.json is assembled once all three are present.
 
 Each leg runs in its own process (platform/thread forcing must precede jax
 backend init). Both legs run the *same* adaptive PT-MCMC on the same
@@ -34,11 +40,18 @@ CHECK_EVERY = 2500
 MAX_STEPS = 300_000
 
 LEGS = {
-    # chains: device uses a wide walker batch (the TPU lever; W=1024 with
-    # 2 temps is the measured single-chip throughput sweet spot); the CPU
-    # leg gets the minimum that still supports multi-chain R-hat.
-    "device": dict(nchains=512, gram_mode="split"),
-    "cpu": dict(nchains=4, gram_mode="f64"),
+    # chains: the device leg is gated by steps-to-converge x step
+    # latency, not raw evals/s — a medium walker batch converges the
+    # ESS>=1000 gate in ~1/64 the steps of the 4-chain CPU leg while one
+    # batched step costs barely more than a small one; fine-grained
+    # convergence checks stop it close to the minimal converged point.
+    # The CPU leg gets the minimum that still supports multi-chain R-hat.
+    "device": dict(nchains=256, gram_mode="split", check_every=500,
+                   block_size=250),
+    # same fine-grained stopping as the device leg: a coarser check would
+    # overshoot convergence and inflate cpu.steps (and with it ref_wall)
+    "cpu": dict(nchains=4, gram_mode="f64", check_every=500,
+                block_size=None),
 }
 
 
@@ -85,13 +98,15 @@ def run_leg(name):
                             nchains=cfg["nchains"], seed=0)
         rep = sample_to_convergence(
             sampler, target_ess=TARGET_ESS, rhat_max=RHAT_MAX,
-            check_every=CHECK_EVERY, max_steps=MAX_STEPS, verbose=True)
+            check_every=cfg["check_every"], max_steps=MAX_STEPS,
+            block_size=cfg["block_size"], verbose=True)
 
     posterior = {k: {"mean": v["mean"], "std": v["std"]}
                  for k, v in rep.summary.items() if not k.startswith("_")}
     return dict(
         leg=name, platform=jax.devices()[0].platform,
         nchains=cfg["nchains"], gram_mode=cfg["gram_mode"],
+        check_every=cfg["check_every"], block_size=cfg["block_size"],
         converged=rep.converged, steps=rep.steps,
         wall_s=round(rep.wall_s, 2),
         steady_wall_s=round(rep.steady_wall_s, 2),
@@ -168,49 +183,99 @@ def time_scalar_reference_loop(nsteps=2000):
     return nsteps / dt
 
 
-def orchestrate():
-    out = {}
-    for name, env_extra in (
-        ("device", {}),
-        ("cpu", {"EWT_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
-                 "XLA_FLAGS": "--xla_cpu_multi_thread_eigen=false "
-                              "intra_op_parallelism_threads=1",
-                 "OMP_NUM_THREADS": "1", "OPENBLAS_NUM_THREADS": "1"}),
-    ):
-        env = dict(os.environ)
-        env.update(env_extra)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        cmd = [sys.executable, os.path.abspath(__file__), "leg", name]
-        if name == "cpu":
-            # pin to one core if taskset is available (1-core baseline)
-            if subprocess.run(["which", "taskset"],
-                              capture_output=True).returncode == 0:
-                cmd = ["taskset", "-c", "0"] + cmd
-        print(f"=== running {name} leg ===", flush=True)
-        r = subprocess.run(cmd, env=env, capture_output=True, text=True)
-        if r.returncode != 0:
-            print(r.stdout[-2000:])
-            print(r.stderr[-4000:])
-            raise RuntimeError(f"{name} leg failed")
-        print("\n".join(ln for ln in r.stdout.splitlines()
-                        if ln.startswith("  step"))[-800:], flush=True)
-        out[name] = json.loads(r.stdout.splitlines()[-1])
+PARTIAL = os.path.join(REPO, "NORTH_STAR.partial.json")
 
-    # reference-shaped scalar loop: measured steps/s in its own process
+
+def _cpu_env():
+    """Subprocess env for the CPU legs: single-threaded (including
+    XLA:CPU's own Eigen pool, which OMP/BLAS vars do not control), and
+    the PJRT plugin site stripped from PYTHONPATH (a dead accelerator
+    tunnel must not be able to hang a pure-CPU measurement)."""
     env = dict(os.environ)
     env.update({"EWT_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
                 "OMP_NUM_THREADS": "1", "OPENBLAS_NUM_THREADS": "1",
-                "MKL_NUM_THREADS": "1"})
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    print("=== timing reference-shaped scalar numpy loop ===", flush=True)
-    r = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "scalar"],
-        env=env, capture_output=True, text=True)
-    if r.returncode != 0:
-        print(r.stderr[-3000:])
-        raise RuntimeError("scalar timing leg failed")
-    scalar_steps_per_s = float(r.stdout.splitlines()[-1])
+                "MKL_NUM_THREADS": "1",
+                "XLA_FLAGS": "--xla_cpu_multi_thread_eigen=false "
+                             "intra_op_parallelism_threads=1"})
+    env["PYTHONPATH"] = REPO
+    return env
 
+
+def _save_partial(out):
+    tmp = PARTIAL + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, PARTIAL)
+
+
+def run_legs(which):
+    """Run the named legs in subprocesses, merging results into
+    NORTH_STAR.partial.json; assemble NORTH_STAR.json once all three
+    (device, cpu, scalar) are present."""
+    bad = [n for n in which if n not in ("device", "cpu", "scalar")]
+    if bad:
+        raise SystemExit(f"unknown leg(s) {bad}; "
+                         "valid: device, cpu, scalar")
+    out = {}
+    if os.path.exists(PARTIAL):
+        try:
+            with open(PARTIAL) as fh:
+                out = json.load(fh)
+        except ValueError:
+            print(f"warning: corrupt {PARTIAL}; starting fresh")
+            out = {}
+        # drop legs recorded under a different configuration
+        for name in ("device", "cpu"):
+            leg = out.get(name)
+            if leg is not None and any(
+                    leg.get(k) != v for k, v in LEGS[name].items()):
+                print(f"dropping stale '{name}' leg "
+                      "(configuration changed)")
+                del out[name]
+
+    for name in which:
+        if name in ("device", "cpu"):
+            env = dict(os.environ) if name == "device" else _cpu_env()
+            if name == "device":
+                env["PYTHONPATH"] = REPO + os.pathsep + \
+                    env.get("PYTHONPATH", "")
+            cmd = [sys.executable, os.path.abspath(__file__), "leg", name]
+            if name == "cpu" and subprocess.run(
+                    ["which", "taskset"],
+                    capture_output=True).returncode == 0:
+                cmd = ["taskset", "-c", "0"] + cmd
+            print(f"=== running {name} leg ===", flush=True)
+            r = subprocess.run(cmd, env=env, capture_output=True,
+                               text=True)
+            if r.returncode != 0:
+                print(r.stdout[-2000:])
+                print(r.stderr[-4000:])
+                raise RuntimeError(f"{name} leg failed")
+            print("\n".join(ln for ln in r.stdout.splitlines()
+                            if ln.startswith("  step"))[-800:], flush=True)
+            out[name] = json.loads(r.stdout.splitlines()[-1])
+        elif name == "scalar":
+            print("=== timing reference-shaped scalar numpy loop ===",
+                  flush=True)
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "scalar"],
+                env=_cpu_env(), capture_output=True, text=True)
+            if r.returncode != 0:
+                print(r.stderr[-3000:])
+                raise RuntimeError("scalar timing leg failed")
+            out["scalar_steps_per_s"] = float(r.stdout.splitlines()[-1])
+        _save_partial(out)
+
+    if all(k in out for k in ("device", "cpu", "scalar_steps_per_s")):
+        return assemble(out)
+    missing = [k for k in ("device", "cpu", "scalar_steps_per_s")
+               if k not in out]
+    print(f"partial results saved ({PARTIAL}); missing legs: {missing}")
+    return out
+
+
+def assemble(out):
+    scalar_steps_per_s = out["scalar_steps_per_s"]
     # posterior match: means within a fraction of the pooled std
     match, worst = True, 0.0
     for k, d in out["device"]["posterior"].items():
@@ -251,5 +316,7 @@ if __name__ == "__main__":
         print(json.dumps(run_leg(sys.argv[2])))
     elif len(sys.argv) > 1 and sys.argv[1] == "scalar":
         print(time_scalar_reference_loop())
+    elif len(sys.argv) > 2 and sys.argv[1] == "legs":
+        run_legs(sys.argv[2].split(","))
     else:
-        orchestrate()
+        run_legs(["device", "cpu", "scalar"])

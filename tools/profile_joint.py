@@ -1,0 +1,127 @@
+"""Stage-wise wall-clock profile of the joint correlated-GWB likelihood.
+
+Times the three Schur stages + front end of ``parallel.pta.loglike_schur``
+separately (via the likelihood's ``_stages`` introspection hook) so the
+npsr=45 throughput number can be decomposed into Gram / per-pulsar solve /
+TM Schur / coupling / big-S solve shares — the floor analysis the round-2
+verdict asked for.
+
+Usage: python tools/profile_joint.py [npsr] [ntoa] [batch]
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np                                        # noqa: E402
+
+import jax                                                # noqa: E402
+import jax.numpy as jnp                                   # noqa: E402
+
+
+def build(npsr, ntoa):
+    from enterprise_warp_tpu.models import StandardModels, TermList
+    from enterprise_warp_tpu.parallel import build_pta_likelihood
+    from enterprise_warp_tpu.sim.noise import make_fake_pta
+
+    psrs = make_fake_pta(npsr=npsr, ntoa=ntoa, seed=5)
+    rng = np.random.default_rng(5)
+    for p in psrs:
+        p.residuals = p.toaerrs * rng.standard_normal(len(p))
+    tls = []
+    for p in psrs:
+        m = StandardModels(psr=p)
+        tls.append(TermList(p, [m.efac("by_backend"),
+                                m.equad("by_backend"),
+                                m.spin_noise("powerlaw_30_nfreqs"),
+                                m.gwb("hd_vary_gamma_20_nfreqs")]))
+    return build_pta_likelihood(psrs, tls, gram_mode="split")
+
+
+def moderate_batch(like, batch, seed=3):
+    rng = np.random.default_rng(seed)
+    th = np.empty(like.ndim)
+    for i, n in enumerate(like.param_names):
+        if n.endswith("efac"):
+            th[i] = 1.0 + 0.1 * rng.random()
+        elif "equad" in n:
+            th[i] = -7.0
+        elif n.endswith("log10_A"):
+            th[i] = -14.0
+        else:
+            th[i] = 3.5
+    return jnp.asarray(np.tile(th, (batch, 1))
+                       + 0.01 * rng.standard_normal((batch, like.ndim)))
+
+
+def timeit(name, fn, *args, reps=5):
+    out = fn(*args)
+    jax.tree_util.tree_map(lambda x: x.block_until_ready()
+                           if hasattr(x, "block_until_ready") else x, out)
+    t = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.tree_util.tree_map(lambda x: x.block_until_ready()
+                           if hasattr(x, "block_until_ready") else x, out)
+    dt = (time.perf_counter() - t) / reps
+    print(f"  {name:28s} {dt*1e3:9.1f} ms/batch")
+    return dt
+
+
+def main():
+    from enterprise_warp_tpu.ops.kernel import _mixed_psd_solve_logdet
+
+    npsr = int(sys.argv[1]) if len(sys.argv) > 1 else 45
+    ntoa = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+    batch = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+
+    like = build(npsr, ntoa)
+    st = like._stages
+    NW, MW, n_g = st["NW"], st["MW"], st["n_g"]
+    P = st["npsr"]
+    print(f"npsr={P} NW={NW} MW={MW} n_g={n_g} batch={batch} "
+          f"ndim={like.ndim}")
+    tb = moderate_batch(like, batch)
+
+    full = jax.jit(jax.vmap(like._fn))
+    dt_full = timeit("FULL loglike", full, tb)
+
+    common = jax.jit(jax.vmap(st["common"]))
+    dt_common = timeit("frontend (nw/phi/gram/X)", common, tb)
+
+    coupling = jax.jit(jax.vmap(lambda t: st["coupling"](t)[1]))
+    dt_coup = timeit("coupling Binv blocks", coupling, tb)
+
+    # stage 1+2 in isolation on realistic inputs from the front end
+    G, X, *_rest, invphi_N = jax.vmap(st["common"])(tb)
+    Gnn = G[:, :, :NW, :NW] + jax.vmap(jax.vmap(jnp.diag))(invphi_N)
+    RHS = jnp.concatenate(
+        [X[:, :, :NW, None], G[:, :, :NW, NW:]], axis=3)
+
+    solve1 = jax.jit(lambda A, B: jax.vmap(jax.vmap(
+        lambda S, R: _mixed_psd_solve_logdet(S, R, st["jitter"],
+                                             refine=3)))(A, B))
+    dt_s1 = timeit("stage1 per-psr mixed solves", solve1, Gnn, RHS)
+
+    n_s = P * n_g
+    rng = np.random.default_rng(0)
+    A0 = rng.standard_normal((n_s, n_s // 8))
+    S_np = A0 @ A0.T / n_s + 2.0 * np.eye(n_s)
+    Sb = jnp.asarray(np.broadcast_to(S_np, (batch, n_s, n_s)).copy())
+    Xs = jnp.asarray(rng.standard_normal((batch, n_s, 1)))
+    solveS = jax.jit(lambda S, x: jax.vmap(
+        lambda s, xx: _mixed_psd_solve_logdet(
+            s, xx, st["jitter"], refine=3, delta_mode="split"))(S, x))
+    dt_sS = timeit(f"stage3 big-S solve ({n_s}^2)", solveS, Sb, Xs)
+
+    acc = dt_common + dt_coup + dt_s1 + dt_sS
+    print(f"  accounted {acc*1e3:.1f} of {dt_full*1e3:.1f} ms "
+          f"(rest: TM Schur f64 products, S assembly, residual ops)")
+    print(f"  throughput: {batch/dt_full:.1f} evals/s")
+
+
+if __name__ == "__main__":
+    main()
