@@ -73,3 +73,13 @@ def is_positive_definite(name: str) -> bool:
     eigenvalue clamping instead of Cholesky.
     """
     return name != "hd_noauto"
+
+
+def is_low_rank(name: str) -> bool:
+    """Whether the ORF matrix is rank-deficient up to the diagonal
+    jitter (monopole: rank 1; dipole: rank 3). Their inverses carry a
+    1/jitter ~ 1e6 dynamic range, beyond what an f32-preconditioned
+    solve of the GW Schur system can resolve — the joint kernel routes
+    those to the equilibrated-f64 factorization instead. (Hellings-Downs
+    is full-rank and stays on the fast mixed-precision path.)"""
+    return name in ("monopole", "dipole")

@@ -71,7 +71,7 @@ from ..ops.kernel import (CHOL_JITTER, _HIGH, _gram_pair,
                           whiten_inputs)
 from ..ops.spectra import (broken_powerlaw_psd, free_spectrum_psd,
                            powerlaw_psd)
-from .orf import is_positive_definite, orf_matrix
+from .orf import is_low_rank, is_positive_definite, orf_matrix
 
 # Improper-flat-prior stand-in for timing-model columns on the dense oracle
 # path (and the constant that keeps both paths' lnL identical). Kept inside
@@ -646,9 +646,22 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         for ci in range(len(cb_static)):
             rows, cols = schur_idx[ci]
             S = S.at[rows, cols].add(Binvs[ci])
-        Zs, ld_S = _mixed_psd_solve_logdet(
-            S, Xs.reshape(n_s, 1), jitter, refine=3, delta_mode="split")
-        quad = rwr - q1 - jnp.sum(Xs.reshape(n_s) * Zs[:, 0])
+        if any(is_low_rank(blk.orf) for blk in corr_blocks):
+            # monopole/dipole coupling inverses span ~1/jitter = 1e6 in
+            # scale — beyond the f32 preconditioner; factor in f64. The
+            # gram-mode jitter keeps oracle semantics: gram_mode='f64'
+            # passes 0.0, so corners reject with -inf exactly like the
+            # dense oracle path
+            L, sS, ld_S = equilibrated_cholesky(S, CHOL_JITTER[gram_mode])
+            u = jax.scipy.linalg.solve_triangular(
+                L, sS * Xs.reshape(n_s), lower=True)
+            xsx = u @ u
+        else:
+            Zs, ld_S = _mixed_psd_solve_logdet(
+                S, Xs.reshape(n_s, 1), jitter, refine=3,
+                delta_mode="split")
+            xsx = jnp.sum(Xs.reshape(n_s) * Zs[:, 0])
+        quad = rwr - q1 - xsx
         lnl = -0.5 * (quad + logdet_n + logphi + logdet_b
                       + jnp.sum(ld_nn) + jnp.sum(ld_tm) + ld_S + tm_const)
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)

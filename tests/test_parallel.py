@@ -258,6 +258,25 @@ class TestSchurPath:
         assert np.isfinite(v_d)
         assert np.isclose(v_s, v_d, rtol=1e-7, atol=5e-2)
 
+    @pytest.mark.parametrize("opt", ["mono_vary_gamma", "dipo_vary_gamma"])
+    def test_schur_low_rank_orf_matches_dense(self, opt):
+        # monopole/dipole ORFs are rank-deficient up to the diagonal
+        # jitter: their 1/eps-scaled coupling inverses must route the GW
+        # Schur system to the f64 factorization (a mixed-precision solve
+        # is off by O(1..10) in lnL here — regression for that bug)
+        psrs = pta_with_residuals(npsr=5, seed=21)
+        dense = build_pta_likelihood(
+            psrs, gwb_terms(psrs, option=f"{opt}_{NMODES}_nfreqs"),
+            gram_mode="f64", joint_mode="dense")
+        schur = build_pta_likelihood(
+            psrs, gwb_terms(psrs, option=f"{opt}_{NMODES}_nfreqs"),
+            gram_mode="split", joint_mode="schur")
+        for tm in theta_points(dense):
+            v_d = float(dense.loglike(as_theta(dense, tm)))
+            v_s = float(schur.loglike(as_theta(schur, tm)))
+            assert np.isfinite(v_d)
+            assert np.isclose(v_s, v_d, rtol=1e-7, atol=5e-2)
+
     def test_schur_strong_red_noise_corner(self):
         # strong red noise maximizes TM/red cancellation — the regime the
         # per-pulsar f64 timing-model Schur stage exists for

@@ -92,7 +92,9 @@ def main():
     common = jax.jit(jax.vmap(st["common"]))
     dt_common = timeit("frontend (nw/phi/gram/X)", common, tb)
 
-    coupling = jax.jit(jax.vmap(lambda t: st["coupling"](t)[1]))
+    # time the FULL coupling output (Binv blocks + logdet) — timing the
+    # logdet alone would let XLA dead-code-eliminate the Binv einsums
+    coupling = jax.jit(jax.vmap(st["coupling"]))
     dt_coup = timeit("coupling Binv blocks", coupling, tb)
 
     # stage 1+2 in isolation on realistic inputs from the front end
