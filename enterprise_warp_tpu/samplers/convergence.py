@@ -10,6 +10,7 @@ stop when every parameter passes.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
@@ -42,6 +43,28 @@ def chains_from_file(chain_path, nchains, ndim, burn_frac=0.25):
     return c[:, nsteps - keep:]
 
 
+def _robust_loadtxt(path):
+    """``np.loadtxt`` tolerating a partial final line (kill mid-append):
+    rows that fail float parsing — wrong token count OR a token truncated
+    mid-write ('1.2e', '-') — are dropped, wherever they sit."""
+    try:
+        return np.loadtxt(path, ndmin=2)
+    except ValueError:
+        rows = []
+        with open(path) as fh:
+            for ln in fh:
+                try:
+                    vals = [float(t) for t in ln.split()]
+                except ValueError:
+                    continue
+                if vals:
+                    rows.append(vals)
+        if not rows:
+            return np.empty((0, 0))
+        ncol = len(rows[0])
+        return np.array([r for r in rows if len(r) == ncol], ndmin=2)
+
+
 def _chains_from_blocks(blocks, burn_frac):
     """Assemble post-burn (nchains, nkept, ndim) chains from the in-memory
     float32 cold blocks collected by :meth:`PTSampler.sample`."""
@@ -53,7 +76,8 @@ def _chains_from_blocks(blocks, burn_frac):
 
 def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
                           check_every=2000, max_steps=200_000,
-                          burn_frac=0.25, verbose=True, block_size=None):
+                          burn_frac=0.25, verbose=True, block_size=None,
+                          resume=False, on_check=None):
     """Drive ``sampler`` (a :class:`PTSampler`) in ``check_every``-step
     blocks until the worst-parameter split-R-hat and multi-chain ESS of the
     cold chains pass, or ``max_steps`` is reached.
@@ -62,10 +86,19 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
     ``collect`` hook), so each convergence check is an O(steps) concat +
     diagnostics pass — never a re-parse of the multi-GB text chain file.
 
+    With ``resume=True`` an interrupted run is warm-started from the
+    sampler's output directory: the already-written ``chain_1.txt`` rows
+    are re-read ONCE into the in-memory block list and the step counter
+    picks up from the ``state.npz`` checkpoint, so a killed process (e.g.
+    a dropped accelerator tunnel mid-run) costs only the steps since the
+    last block rather than the whole run. Assumes the driver samples
+    unthinned (this function always does).
+
     Returns a :class:`ConvergenceReport`. Wall-clock covers the sampling
     loop only (the likelihood build happens before this call); the first
     block includes jit compilation, so ``steady_wall_s`` is the honest
-    steady-state number.
+    steady-state number. On resume both clocks cover only the current
+    attempt — accumulate across attempts in the caller if needed.
     """
     # cap single device calls: one lax.scan block per call, and a block of
     # thousands of steps is minutes inside one XLA execution — long enough
@@ -75,6 +108,31 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
 
     blocks = []
     steps = 0
+    if resume:
+        chain_path = os.path.join(sampler.outdir, "chain_1.txt")
+        if os.path.exists(sampler._ckpt_path) and \
+                os.path.exists(chain_path):
+            raw = _robust_loadtxt(chain_path)
+            # truncate to the checkpointed step: a kill between the chain
+            # append and the (atomic) state save leaves extra chain rows
+            # the resumed sampler will regenerate
+            ckpt_step = int(np.load(sampler._ckpt_path)["step"])
+            nsteps = min(raw.shape[0] // sampler.nchains, ckpt_step)
+            if nsteps > 0:
+                raw = raw[:nsteps * sampler.nchains]
+                # repair the on-disk chain to exactly the rows we keep:
+                # the resumed sampler APPENDS, so stale post-checkpoint
+                # rows / partial lines would otherwise shift every later
+                # block and corrupt the reference-format file
+                tmp = chain_path + ".tmp"
+                np.savetxt(tmp, raw)
+                os.replace(tmp, chain_path)
+                c = raw[:, :sampler.ndim]
+                blocks.append(c.reshape(nsteps, sampler.nchains,
+                                        sampler.ndim).astype(np.float32))
+                steps = nsteps
+                if verbose:
+                    print(f"  resuming at step {steps}", flush=True)
     t_start = time.perf_counter()
     t_after_first = None
     report = None
@@ -91,6 +149,11 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
         if verbose:
             print(f"  step {steps}: rhat_max={worst['rhat']:.4f} "
                   f"ess_min={worst['ess']:.0f}", flush=True)
+        if on_check is not None:
+            # lets drivers persist attempt progress (steps, wall so far,
+            # steady wall so far) so a killed run loses nothing
+            on_check(steps, time.perf_counter() - t_start,
+                     time.perf_counter() - t_after_first)
         if worst["rhat"] <= rhat_max and worst["ess"] >= target_ess:
             report = ConvergenceReport(
                 converged=True, steps=steps,

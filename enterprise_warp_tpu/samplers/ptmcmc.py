@@ -150,11 +150,15 @@ class PTSampler:
     def _save_state(self, st: PTState):
         if not _is_primary():
             return
-        np.savez(self._ckpt_path, x=st.x, lnl=st.lnl, lnp=st.lnp,
+        # atomic write: a kill mid-savez must not corrupt the checkpoint
+        # the next attempt resumes from
+        tmp = self._ckpt_path + ".tmp.npz"
+        np.savez(tmp, x=st.x, lnl=st.lnl, lnp=st.lnp,
                  key=st.key, cov=st.cov, history=st.history,
                  hist_len=st.hist_len, step=st.step,
                  accepted=st.accepted, swaps_accepted=st.swaps_accepted,
                  swaps_proposed=st.swaps_proposed, ladder=st.ladder)
+        os.replace(tmp, self._ckpt_path)
 
     def _load_state(self):
         z = np.load(self._ckpt_path)

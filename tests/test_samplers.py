@@ -166,6 +166,35 @@ class TestConvergence:
         chain = np.loadtxt(tmp_path / "chain_1.txt")
         assert len(chain) == rep.steps * 8
 
+    def test_convergence_warm_start(self, tmp_path):
+        """A killed convergence run resumes from the outdir: the second
+        driver call picks up chain + checkpoint instead of restarting
+        (the device-leg recovery path for a dropped accelerator)."""
+        from enterprise_warp_tpu.samplers.convergence import \
+            sample_to_convergence
+        like = GaussianLike([0.5, -1.0], [0.4, 0.8])
+        s = PTSampler(like, str(tmp_path), ntemps=2, nchains=8, seed=2,
+                      cov_update=500)
+        # "crash" after 2000 steps (unreachable targets force max_steps)
+        rep1 = sample_to_convergence(s, target_ess=1e9, rhat_max=0.0,
+                                     check_every=1000, max_steps=2000,
+                                     verbose=False, resume=True)
+        assert not rep1.converged and rep1.steps == 2000
+
+        # fresh sampler object = fresh process; warm-start via resume
+        s2 = PTSampler(like, str(tmp_path), ntemps=2, nchains=8, seed=2,
+                       cov_update=500)
+        rep2 = sample_to_convergence(s2, target_ess=400.0, rhat_max=1.02,
+                                     check_every=1000, max_steps=20_000,
+                                     verbose=False, resume=True)
+        assert rep2.converged
+        assert rep2.steps > 2000   # continued, not restarted
+        # all steps (pre- and post-crash) are in the assembled chains
+        chain = np.loadtxt(tmp_path / "chain_1.txt")
+        assert len(chain) == rep2.steps * 8
+        flat = rep2.chains.reshape(-1, like.ndim)
+        np.testing.assert_allclose(flat.mean(0), [0.5, -1.0], atol=0.15)
+
 
 class TestNested:
     def test_evidence_and_posterior(self, tmp_path):

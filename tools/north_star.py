@@ -27,12 +27,25 @@ walker-batch data parallelism is the single biggest speedup lever).
 
 import json
 import os
+import shutil
 import subprocess
 import sys
-import tempfile
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# watchdog: a leg subprocess that prints nothing for this long is
+# presumed wedged (dropped accelerator tunnel blocks forever on a futex
+# inside the PJRT client — observed in round 3) and is killed + retried;
+# legs resume from their checkpoint so a retry costs only the last block
+IDLE_TIMEOUT_S = 1200
+MAX_ATTEMPTS = 6
+PROBE_WAIT_S = 3600   # max wait for the device to come back per attempt
+
+
+def leg_dir(name):
+    return os.path.join(REPO, ".ns_runs", name)
 
 TARGET_ESS = 1000.0
 RHAT_MAX = 1.01
@@ -86,6 +99,14 @@ def build_problem(gram_mode):
 
 
 def run_leg(name):
+    """Run one leg to convergence in a PERSISTENT per-leg directory
+    (``.ns_runs/<leg>`` under the repo, gitignored): a leg killed mid-run
+    — dropped accelerator tunnel, watchdog, OOM — resumes from the
+    sampler checkpoint + on-disk chain instead of restarting, and the
+    wall-clock is accumulated across attempts in a sidecar. The parent
+    (:func:`run_legs`) deletes the directory once the leg lands in the
+    partial, so a finished leg never warm-starts a future re-measurement.
+    """
     cfg = LEGS[name]
     import numpy as np  # noqa: F401
 
@@ -99,13 +120,55 @@ def run_leg(name):
     like = build_problem(cfg["gram_mode"])
     build_s = time.perf_counter() - t0
 
-    with tempfile.TemporaryDirectory() as outdir:
-        sampler = PTSampler(like, outdir, ntemps=2,
-                            nchains=cfg["nchains"], seed=0)
-        rep = sample_to_convergence(
-            sampler, target_ess=TARGET_ESS, rhat_max=RHAT_MAX,
-            check_every=cfg["check_every"], max_steps=MAX_STEPS,
-            block_size=cfg["block_size"], verbose=True)
+    outdir = leg_dir(name)
+    # config stamp: a resume dir left by a killed run under a DIFFERENT
+    # leg configuration or measurement definition must not warm-start
+    # this one (wrong nchains scrambles the chain reshape; wrong problem
+    # mixes parameters; old wall-clock pollutes the measurement)
+    stamp = dict(cfg, meta=META)
+    stamp_path = os.path.join(outdir, "config.json")
+    if os.path.isdir(outdir):
+        old = None
+        if os.path.exists(stamp_path):
+            with open(stamp_path) as fh:
+                old = json.load(fh)
+        if old != stamp:
+            print("discarding resume state from a different "
+                  "configuration", flush=True)
+            shutil.rmtree(outdir)
+    os.makedirs(outdir, exist_ok=True)
+    with open(stamp_path, "w") as fh:
+        json.dump(stamp, fh)
+    wall_path = os.path.join(outdir, "wall.json")
+    prior_wall = {"wall_s": 0.0, "steady_wall_s": 0.0, "attempts": 0}
+    if os.path.exists(wall_path):
+        with open(wall_path) as fh:
+            prior_wall = json.load(fh)
+
+    sampler = PTSampler(like, outdir, ntemps=2,
+                        nchains=cfg["nchains"], seed=0)
+
+    def checkpoint_wall(steps, wall_s, steady_wall_s):
+        # persist the attempt's wall-clock at every check, so a killed
+        # attempt's sampling time still counts toward the honest total
+        tmp = wall_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"wall_s": prior_wall["wall_s"] + wall_s,
+                       "steady_wall_s": prior_wall["steady_wall_s"]
+                       + steady_wall_s,
+                       "attempts": prior_wall["attempts"] + 1}, fh)
+        os.replace(tmp, wall_path)
+
+    rep = sample_to_convergence(
+        sampler, target_ess=TARGET_ESS, rhat_max=RHAT_MAX,
+        check_every=cfg["check_every"], max_steps=MAX_STEPS,
+        block_size=cfg["block_size"], verbose=True, resume=True,
+        on_check=checkpoint_wall)
+
+    checkpoint_wall(rep.steps, rep.wall_s, rep.steady_wall_s)
+    with open(wall_path) as fh:
+        acc = json.load(fh)
+    wall_s, steady_wall_s = acc["wall_s"], acc["steady_wall_s"]
 
     posterior = {k: {"mean": v["mean"], "std": v["std"]}
                  for k, v in rep.summary.items() if not k.startswith("_")}
@@ -114,9 +177,10 @@ def run_leg(name):
         nchains=cfg["nchains"], gram_mode=cfg["gram_mode"],
         check_every=cfg["check_every"], block_size=cfg["block_size"],
         converged=rep.converged, steps=rep.steps,
-        wall_s=round(rep.wall_s, 2),
-        steady_wall_s=round(rep.steady_wall_s, 2),
+        wall_s=round(wall_s, 2),
+        steady_wall_s=round(steady_wall_s, 2),
         build_s=round(build_s, 2),
+        attempts=prior_wall["attempts"] + 1,
         rhat_max=round(rep.rhat_max, 4), ess_min=round(rep.ess_min, 1),
         evals=rep.steps * sampler.W,
         posterior=posterior)
@@ -198,6 +262,87 @@ def time_scalar_reference_loop(nsteps=2000):
 PARTIAL = os.path.join(REPO, "NORTH_STAR.partial.json")
 
 
+def _stream_with_watchdog(cmd, env, idle_timeout):
+    """Run ``cmd`` streaming stdout lines; kill it if it prints nothing
+    for ``idle_timeout`` seconds. Returns ``(returncode_or_None, lines,
+    stderr_text)`` — ``None`` returncode means the watchdog fired."""
+    p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+    lines, err_chunks, last = [], [], [time.time()]
+
+    def _reader():
+        for ln in p.stdout:
+            lines.append(ln.rstrip("\n"))
+            last[0] = time.time()
+            if ln.startswith("  "):
+                print(ln.rstrip(), flush=True)
+
+    def _err_reader():
+        err_chunks.append(p.stderr.read())
+
+    tr = threading.Thread(target=_reader, daemon=True)
+    te = threading.Thread(target=_err_reader, daemon=True)
+    tr.start()
+    te.start()
+    killed = False
+    while p.poll() is None:
+        time.sleep(5)
+        if time.time() - last[0] > idle_timeout:
+            print(f"[watchdog] no output for {idle_timeout}s — killing",
+                  flush=True)
+            p.kill()
+            killed = True
+            break
+    p.wait()
+    tr.join(timeout=10)
+    te.join(timeout=10)
+    return (None if killed else p.returncode), lines, \
+        "".join(c for c in err_chunks if c)
+
+
+def _device_reachable(env, timeout=60):
+    """Probe the leg's platform with a tiny computation in a throwaway
+    subprocess (a dead tunnel hangs the PJRT client forever, so the probe
+    gets a hard timeout)."""
+    code = ("import jax, jax.numpy as jnp;"
+            "jnp.ones((8, 8)).sum().block_until_ready();print('ok')")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True,
+                           timeout=timeout)
+        return r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _drive_leg(name, cmd, env):
+    """Run one leg subprocess under the watchdog, retrying (the leg
+    resumes from its checkpoint) until it completes or MAX_ATTEMPTS is
+    exhausted. Between attempts, wait for the device to answer a probe."""
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        rc, lines, err = _stream_with_watchdog(cmd, env, IDLE_TIMEOUT_S)
+        if rc == 0 and lines:
+            return json.loads(lines[-1])
+        why = "watchdog kill" if rc is None else f"exit {rc}"
+        print(f"[{name} leg] attempt {attempt} failed ({why})",
+              flush=True)
+        if err:
+            print(err[-3000:], flush=True)
+        if attempt == MAX_ATTEMPTS:
+            raise RuntimeError(f"{name} leg failed after "
+                               f"{MAX_ATTEMPTS} attempts")
+        t0 = time.time()
+        while time.time() - t0 < PROBE_WAIT_S:
+            if _device_reachable(env):
+                break
+            print(f"[{name} leg] device unreachable; retrying probe in "
+                  "120s", flush=True)
+            time.sleep(120)
+        else:
+            raise RuntimeError(f"{name} leg: device did not come back "
+                               f"within {PROBE_WAIT_S}s")
+
+
 def _cpu_env():
     """Subprocess env for the CPU legs: single-threaded (including
     XLA:CPU's own Eigen pool, which OMP/BLAS vars do not control), and
@@ -209,9 +354,12 @@ def _cpu_env():
                 "MKL_NUM_THREADS": "1",
                 "XLA_FLAGS": "--xla_cpu_multi_thread_eigen=false "
                              "intra_op_parallelism_threads=1"})
-    # strip only PJRT plugin site dirs; keep other user PYTHONPATH entries
+    # strip only PJRT plugin site dirs (match the path COMPONENT, not a
+    # bare substring — '/home/saxony/libs' must survive); keep other user
+    # PYTHONPATH entries
     keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and "axon" not in p]
+            if p and not any(seg.startswith(".axon")
+                             for seg in p.split(os.sep))]
     env["PYTHONPATH"] = os.pathsep.join([REPO] + keep)
     return env
 
@@ -243,6 +391,9 @@ def run_legs(which):
             print("dropping stale partial (measurement definition "
                   "changed)")
             out = {}
+            # the resume dirs hold old-definition state too
+            for name in ("device", "cpu"):
+                shutil.rmtree(leg_dir(name), ignore_errors=True)
         # drop legs recorded under a different per-leg configuration
         for name in ("device", "cpu"):
             leg = out.get(name)
@@ -251,6 +402,8 @@ def run_legs(which):
                 print(f"dropping stale '{name}' leg "
                       "(configuration changed)")
                 del out[name]
+            if leg is not None and name not in out:
+                shutil.rmtree(leg_dir(name), ignore_errors=True)
     out["meta"] = META
 
     for name in which:
@@ -265,15 +418,8 @@ def run_legs(which):
                     capture_output=True).returncode == 0:
                 cmd = ["taskset", "-c", "0"] + cmd
             print(f"=== running {name} leg ===", flush=True)
-            r = subprocess.run(cmd, env=env, capture_output=True,
-                               text=True)
-            if r.returncode != 0:
-                print(r.stdout[-2000:])
-                print(r.stderr[-4000:])
-                raise RuntimeError(f"{name} leg failed")
-            print("\n".join(ln for ln in r.stdout.splitlines()
-                            if ln.startswith("  step"))[-800:], flush=True)
-            out[name] = json.loads(r.stdout.splitlines()[-1])
+            out[name] = _drive_leg(name, cmd, env)
+            shutil.rmtree(leg_dir(name), ignore_errors=True)
         elif name == "scalar":
             print("=== timing reference-shaped scalar numpy loop ===",
                   flush=True)
