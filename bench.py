@@ -175,12 +175,24 @@ def main():
         print(f"# sweep ntoa={ntoa_s:5d} nbasis={4*nfreq_s:3d} "
               f"batch={batch_s:5d}: {eps:9.0f} evals/s", file=sys.stderr)
 
-    print(json.dumps({
+    out = {
         "metric": "loglike_evals_per_sec",
         "value": round(device_eps, 1),
         "unit": "evals/s (batch=%d, ntoa=334, nbasis=80+tm)" % BATCH,
         "vs_baseline": round(device_eps / cpu_eps, 2),
-    }))
+    }
+    # echo the convergence-gated sampling measurement when it exists
+    # (tools/north_star.py writes NORTH_STAR.json)
+    ns_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "NORTH_STAR.json")
+    if os.path.exists(ns_path):
+        with open(ns_path) as fh:
+            ns = json.load(fh)
+        out["north_star"] = {
+            k: ns[k] for k in ("speedup_vs_reference_shape",
+                               "speedup_vs_own_cpu", "posterior_match",
+                               "north_star_met") if k in ns}
+    print(json.dumps(out))
 
 
 def config_benches():

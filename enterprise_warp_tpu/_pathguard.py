@@ -1,0 +1,25 @@
+"""Accelerator-plugin path guard (pure stdlib — safe to load before jax).
+
+A PJRT plugin site dir on ``sys.path``/``PYTHONPATH`` can hang jax backend
+discovery when the plugin's device tunnel is dead (observed: indefinite
+futex wait inside plugin init). CPU-only consumers — the test suite, the
+north-star CPU/scalar legs — strip such entries before jax initializes.
+
+This module must stay import-light: consumers load it by FILE PATH
+(``importlib.util.spec_from_file_location``) precisely so that importing
+it cannot trigger the package ``__init__`` (which imports jax).
+"""
+
+import os
+
+
+def is_plugin_site(path):
+    """True if ``path`` contains an accelerator-plugin site component
+    (a ``.axon*`` path segment)."""
+    return any(seg.startswith(".axon") for seg in path.split(os.sep))
+
+
+def strip_plugin_site(paths):
+    """Filter an iterable of path strings, dropping plugin site dirs and
+    empties."""
+    return [p for p in paths if p and not is_plugin_site(p)]

@@ -243,11 +243,18 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     # NaN -> -inf.
     L = jnp.where(jnp.all(jnp.isfinite(L)), L, eye)
 
+    # One explicit triangular inverse turns every preconditioner solve
+    # into two tiny MXU matmuls: XLA's batched triangular solve is a
+    # sequential column sweep on TPU, and the solve is hit 2x per
+    # refinement step. Inverse-application error is the same
+    # O(kappa(L) eps_f32) class as the trisolve — and the refinement
+    # targets the computed Sn, so preconditioner quality only affects
+    # the contraction rate, not the answer.
+    Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+
     def psolve(R):
-        x = jax.scipy.linalg.solve_triangular(L, R.astype(jnp.float32),
-                                              lower=True)
-        x = jax.scipy.linalg.solve_triangular(L.T, x, lower=False)
-        return x.astype(f64)
+        x = Linv @ R.astype(jnp.float32)
+        return (Linv.T @ x).astype(f64)
 
     # f64 matmuls lower ~7x faster on TPU as broadcast-multiply +
     # tree-sum than as emulated-f64 dots (same accuracy: genuine f64
@@ -255,32 +262,53 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     def mm64(A, C):
         return jnp.sum(A[:, :, None] * C[None, :, :], axis=1)
 
+    # hi/lo-split MXU product: cheap residuals for the EARLY refinement
+    # iterations at a fraction of the f64 tree-matmul's HBM traffic. The
+    # last TWO residuals stay genuine f64: the split product's f32
+    # accumulation noise (~1e-9 relative) times the equilibrated
+    # condition number sets a solution floor (~kappa * 1e-9), and one
+    # exact step only contracts it by kappa*eps_f32 — two exact steps
+    # recover the all-f64 floor (measured: 3.6e-10 vs 7e-11 at
+    # kappa=1e4, identical beyond).
+    def mm_split(A, C):
+        return _gram_pair(A.T, C, "split")
+
     Bn = s[:, None] * B
     Z0 = psolve(Bn)
     Z = Z0
-    for _ in range(refine):
-        Z = Z + psolve(Bn - mm64(Sn, Z))
+    r0 = None
+    for i in range(refine):
+        exact = i >= refine - 2
+        r = Bn - (mm64(Sn, Z) if exact else mm_split(Sn, Z))
+        if i == 0:
+            r0 = r
+        Z = Z + psolve(r)
     # κ-overflow guard: where refinement diverged (possible once
     # eps_f32 * kappa > 1), fall back to the jitter-regularized
     # preconditioner solution, whichever has the smaller true residual.
     res_ref = jnp.sum(jnp.square(Bn - mm64(Sn, Z)))
-    res_pre = jnp.sum(jnp.square(Bn - mm64(Sn, Z0)))
+    res_pre = jnp.sum(jnp.square(r0 if r0 is not None
+                                 else Bn - mm64(Sn, Z0)))
     Z = jnp.where(res_ref <= res_pre, Z, Z0)
 
     # delta_mode='split' computes L L^T on the MXU with f64 chunk
     # accumulation (O(n^3) f32 instead of O(n^3) f64-elementwise tree
-    # ops). L is exactly f32, so ONE chunked product is exact — no hi/lo
-    # splitting needed. Use when n is large (the joint PTA Schur
-    # complement).
-    L64 = L.astype(f64)
+    # ops). L is exactly f32, so ONE chunked product suffices — but each
+    # f32 product/accumulate rounds at eps_f32, leaving ~6e-8 absolute
+    # noise in Delta that the correction amplifies by kappa (measured:
+    # 1.6e-4 logdet error at kappa=1e4 vs 9e-10 for the tree product, at
+    # ANY chunk size — the rounding is per-product, not per-chunk). So
+    # 'tree' (exact f64) is the default for oracle-grade small-n logdets;
+    # 'split' is for the large joint-PTA Schur complement where O(n^3)
+    # f64 tree ops are prohibitive and the tolerance is looser.
     if delta_mode == "split":
         Lp = _pad_to_chunk(L.T, (-n) % _CHUNK)
         LLt = _chunked_f32_gram(Lp, Lp)
     else:
-        LLt = mm64(L64, L64.T)
+        LLt = mm64(L.astype(f64), L.astype(f64).T)
     Delta = (Sn - LLt).astype(jnp.float32)
-    K = jax.scipy.linalg.solve_triangular(L, Delta, lower=True)
-    E = jax.scipy.linalg.solve_triangular(L, K.T, lower=True).astype(f64)
+    K = Linv @ Delta
+    E = (Linv @ K.T).astype(f64)
     E32 = E.astype(jnp.float32)
     E2 = E32 @ E32
     corr = (jnp.trace(E) - jnp.sum(E * E.T) / 2.0
@@ -382,9 +410,14 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split"):
         # amplification in A leaves ~1e-7 relative error, matching the
         # old all-f64 behavior.
         jitter = CHOL_JITTER[gram_mode]
+        # delta_mode='split': the ~1e-4-class logdet noise it can add at
+        # kappa~1e4 is far below this branch's existing split-Gram error
+        # (|lnL| error up to ~3e-2 at strong red noise), and it removes
+        # the (nb,nb,nb) f64 tree product — the mixed solve's dominant
+        # cost (CPU: 83 -> 18 ms/16-batch)
         ZXH, logdet_sigma = _mixed_psd_solve_logdet(
             Sigma, jnp.concatenate([X[:, None], H], axis=1), jitter,
-            refine=3)
+            refine=3, delta_mode="split")
         zx, ZH = ZXH[:, 0], ZXH[:, 1:]
         A = P - H.T @ ZH
         y = q - ZH.T @ X

@@ -46,9 +46,10 @@ def chains_from_file(chain_path, nchains, ndim, burn_frac=0.25):
 def _robust_loadtxt(path):
     """``np.loadtxt`` tolerating a partial final line (kill mid-append):
     rows that fail float parsing — wrong token count OR a token truncated
-    mid-write ('1.2e', '-') — are dropped, wherever they sit."""
+    mid-write ('1.2e', '-') — are dropped, wherever they sit. Returns
+    ``(array, dropped_any)``."""
     try:
-        return np.loadtxt(path, ndmin=2)
+        return np.loadtxt(path, ndmin=2), False
     except ValueError:
         rows = []
         with open(path) as fh:
@@ -60,9 +61,10 @@ def _robust_loadtxt(path):
                 if vals:
                     rows.append(vals)
         if not rows:
-            return np.empty((0, 0))
+            return np.empty((0, 0)), True
         ncol = len(rows[0])
-        return np.array([r for r in rows if len(r) == ncol], ndmin=2)
+        return np.array([r for r in rows if len(r) == ncol],
+                        ndmin=2), True
 
 
 def _chains_from_blocks(blocks, burn_frac):
@@ -112,21 +114,26 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
         chain_path = os.path.join(sampler.outdir, "chain_1.txt")
         if os.path.exists(sampler._ckpt_path) and \
                 os.path.exists(chain_path):
-            raw = _robust_loadtxt(chain_path)
+            raw, dropped = _robust_loadtxt(chain_path)
             # truncate to the checkpointed step: a kill between the chain
             # append and the (atomic) state save leaves extra chain rows
             # the resumed sampler will regenerate
             ckpt_step = int(np.load(sampler._ckpt_path)["step"])
             nsteps = min(raw.shape[0] // sampler.nchains, ckpt_step)
             if nsteps > 0:
+                truncated = nsteps * sampler.nchains < raw.shape[0]
                 raw = raw[:nsteps * sampler.nchains]
                 # repair the on-disk chain to exactly the rows we keep:
                 # the resumed sampler APPENDS, so stale post-checkpoint
                 # rows / partial lines would otherwise shift every later
-                # block and corrupt the reference-format file
-                tmp = chain_path + ".tmp"
-                np.savetxt(tmp, raw)
-                os.replace(tmp, chain_path)
+                # block and corrupt the reference-format file. Skipped
+                # when the file is already exactly right (clean kill on
+                # a block boundary) — no point rewriting a multi-GB
+                # text file for zero net change.
+                if dropped or truncated:
+                    tmp = chain_path + ".tmp"
+                    np.savetxt(tmp, raw)
+                    os.replace(tmp, chain_path)
                 c = raw[:, :sampler.ndim]
                 blocks.append(c.reshape(nsteps, sampler.nchains,
                                         sampler.ndim).astype(np.float32))

@@ -8,9 +8,28 @@ Environment variables must be set before jax initializes its backends, hence
 the module-level assignment ahead of any jax import.
 """
 
+import importlib.util
 import os
+import pathlib as _pl
+import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# The suite is CPU-only; an accelerator PJRT plugin site dir on the path
+# can hang jax backend discovery when its tunnel is dead. Strip it from
+# this process AND from PYTHONPATH so spawned subprocess tests inherit
+# the same isolation. The guard is loaded by file path so nothing
+# imports jax before the stripping happens.
+_spec = importlib.util.spec_from_file_location(
+    "_pathguard", str(_pl.Path(__file__).resolve().parents[1]
+                      / "enterprise_warp_tpu" / "_pathguard.py"))
+_pathguard = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_pathguard)
+
+sys.path[:] = [p for p in sys.path
+               if not p or not _pathguard.is_plugin_site(p)]
+os.environ["PYTHONPATH"] = os.pathsep.join(_pathguard.strip_plugin_site(
+    os.environ.get("PYTHONPATH", "").split(os.pathsep)))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -354,14 +354,23 @@ def _cpu_env():
                 "MKL_NUM_THREADS": "1",
                 "XLA_FLAGS": "--xla_cpu_multi_thread_eigen=false "
                              "intra_op_parallelism_threads=1"})
-    # strip only PJRT plugin site dirs (match the path COMPONENT, not a
-    # bare substring — '/home/saxony/libs' must survive); keep other user
-    # PYTHONPATH entries
-    keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and not any(seg.startswith(".axon")
-                             for seg in p.split(os.sep))]
+    # strip only PJRT plugin site dirs; keep other user PYTHONPATH
+    # entries (shared predicate: enterprise_warp_tpu/_pathguard.py,
+    # loaded by file path so this module stays jax-import-free)
+    keep = _pathguard().strip_plugin_site(
+        env.get("PYTHONPATH", "").split(os.pathsep))
     env["PYTHONPATH"] = os.pathsep.join([REPO] + keep)
     return env
+
+
+def _pathguard():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_pathguard", os.path.join(REPO, "enterprise_warp_tpu",
+                                   "_pathguard.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _save_partial(out):
@@ -419,6 +428,9 @@ def run_legs(which):
                 cmd = ["taskset", "-c", "0"] + cmd
             print(f"=== running {name} leg ===", flush=True)
             out[name] = _drive_leg(name, cmd, env)
+            # persist the result BEFORE discarding the resume state — a
+            # kill between the two must not cost a completed leg
+            _save_partial(out)
             shutil.rmtree(leg_dir(name), ignore_errors=True)
         elif name == "scalar":
             print("=== timing reference-shaped scalar numpy loop ===",

@@ -5,6 +5,7 @@ isolation over a walker batch, to locate where the batched-eval wall-clock
 goes (VERDICT round-1 item 2: profile before optimizing).
 """
 
+import os
 import time
 
 import jax
@@ -12,13 +13,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from enterprise_warp_tpu.models import build_pulsar_likelihood
-from enterprise_warp_tpu.ops.kernel import (_gram_pair, equilibrated_cholesky,
+from enterprise_warp_tpu.ops.kernel import (_chunked_f32_gram,
+                                            _mixed_psd_solve_logdet,
+                                            _pad_to_chunk, _CHUNK,
+                                            _gram_pair,
+                                            equilibrated_cholesky,
                                             whiten_inputs)
 
 import __graft_entry__ as g
 
-BATCH = 1024
-REPS = 10
+BATCH = int(os.environ.get("EWT_PROFILE_BATCH", 1024))
+REPS = int(os.environ.get("EWT_PROFILE_REPS", 10))
 
 
 def timeit(name, fn, *args):
@@ -150,6 +155,70 @@ def main():
     timeit("trisolve f64 (nb x nb) vec", trisolve_f64, L64, X)
     timeit("trisolve f32 (nb x nb) vec", trisolve_f32, L64, X)
     timeit("trisolve f64 (nb x nb) x ntm", trisolve_mat_f64, L64, Hb)
+
+    # ---- mixed-solve internals (the TPU hot path after the grams) ----
+    RHS = jax.random.normal(key, (BATCH, nb, ntm + 1), dtype=jnp.float64)
+    Lf = chol_f32(G64)[0]          # (BATCH, nb, nb) f32 factors
+
+    @jax.jit
+    def mixed_tree(G, R):
+        return jax.vmap(lambda S, B: _mixed_psd_solve_logdet(
+            S, B, 3e-6, refine=3, delta_mode="tree"))(G, R)
+
+    @jax.jit
+    def mixed_split(G, R):
+        return jax.vmap(lambda S, B: _mixed_psd_solve_logdet(
+            S, B, 3e-6, refine=3, delta_mode="split"))(G, R)
+
+    @jax.jit
+    def llt_tree(L):
+        L6 = L.astype(jnp.float64)
+        return jax.vmap(lambda Li: jnp.sum(
+            Li[:, :, None] * Li.T[None, :, :], axis=1))(L6)
+
+    @jax.jit
+    def llt_chunked(L):
+        def one(Li):
+            Lp = _pad_to_chunk(Li.T, (-Li.shape[0]) % _CHUNK)
+            return _chunked_f32_gram(Lp, Lp)
+        return jax.vmap(one)(L)
+
+    @jax.jit
+    def linv_matmul_psolve(L, R):
+        def one(Li, Ri):
+            eye = jnp.eye(Li.shape[0], dtype=jnp.float32)
+            Linv = jax.scipy.linalg.solve_triangular(Li, eye, lower=True)
+            x = Linv @ Ri.astype(jnp.float32)
+            return (Linv.T @ x).astype(jnp.float64)
+        return jax.vmap(one)(L, R)
+
+    @jax.jit
+    def trisolve_psolve(L, R):
+        def one(Li, Ri):
+            x = jax.scipy.linalg.solve_triangular(
+                Li, Ri.astype(jnp.float32), lower=True)
+            return jax.scipy.linalg.solve_triangular(
+                Li.T, x, lower=False).astype(jnp.float64)
+        return jax.vmap(one)(L, R)
+
+    @jax.jit
+    def resid_mm64(G, R):
+        return jax.vmap(lambda Si, Zi: jnp.sum(
+            Si[:, :, None] * Zi[None, :, :], axis=1))(G, R)
+
+    @jax.jit
+    def resid_split(G, R):
+        return jax.vmap(lambda Si, Zi: _gram_pair(Si.T, Zi, "split"))(
+            G, R)
+
+    timeit("mixed solve+logdet (delta tree)", mixed_tree, G64, RHS)
+    timeit("mixed solve+logdet (delta split)", mixed_split, G64, RHS)
+    timeit("LLt f64 tree (nb^3)", llt_tree, Lf)
+    timeit("LLt chunked f32 gram", llt_chunked, Lf)
+    timeit("psolve via Linv matmuls", linv_matmul_psolve, Lf, RHS)
+    timeit("psolve via 2x trisolve", trisolve_psolve, Lf, RHS)
+    timeit("residual mm64 (nb x nb x k)", resid_mm64, G64, RHS)
+    timeit("residual split gram", resid_split, G64, RHS)
 
 
 if __name__ == "__main__":
