@@ -18,7 +18,8 @@ import sys
 
 from .config import Params
 from .models.assemble import init_model_likelihoods
-from .samplers import HyperModelLikelihood, run_nested, run_ptmcmc
+from .samplers import (HyperModelLikelihood, run_hmc, run_nested,
+                       run_ptmcmc)
 
 
 def import_custom_models(py_path: str, class_name: str):
@@ -82,6 +83,16 @@ def main(argv=None):
                             params.sampler_kwargs.get("nsamp", 1000000)))
         run_ptmcmc(like, params.output_dir, nsamp,
                    params=params, resume=resume)
+    elif params.sampler == "hmc":
+        like = likes[first_id]
+        if len(likes) > 1:
+            print("note: HMC has no gradient for the discrete nmodel "
+                  "index; using model 0 (use ptmcmcsampler for "
+                  "product-space selection)")
+        kw = params.sampler_kwargs
+        run_hmc(like, params.output_dir,
+                int(getattr(params, "nsamp", kw.get("nsamp", 10000))),
+                params=params, resume=resume)
     elif params.sampler in ("emcee", "ptemcee"):
         like = (HyperModelLikelihood(likes) if len(likes) >= 2
                 else likes[first_id])

@@ -46,6 +46,11 @@ IMPLEMENTED_SAMPLERS = {
     "ultranest": dict(nlive=500, dlogz=0.1),
     "emcee": dict(nwalkers=64, nsteps=10000),
     "ptemcee": dict(nwalkers=64, nsteps=10000, ntemps=4),
+    # native gradient-based sampler (no reference counterpart: the
+    # Enterprise likelihood is a black-box numpy callback; ours is a
+    # differentiable JAX function)
+    "hmc": dict(nsamp=10000, nchains=64, n_leapfrog=16, warmup=1000,
+                target_accept=0.8),
 }
 
 

@@ -14,7 +14,8 @@ so the results layer is sampler-agnostic.
 
 from .ptmcmc import PTSampler, run_ptmcmc
 from .nested import run_nested
+from .hmc import HMCSampler, run_hmc
 from .hypermodel import HyperModelLikelihood
 
 __all__ = ["PTSampler", "run_ptmcmc", "run_nested",
-           "HyperModelLikelihood"]
+           "HMCSampler", "run_hmc", "HyperModelLikelihood"]

@@ -482,8 +482,10 @@ def assemble(out):
         north_star_target=30.0,
         north_star_met=bool(
             ref_wall / out["device"]["steady_wall_s"] >= 30.0 and match))
-    with open(os.path.join(REPO, "NORTH_STAR.json"), "w") as fh:
+    final = os.path.join(REPO, "NORTH_STAR.json")
+    with open(final + ".tmp", "w") as fh:
         json.dump(result, fh, indent=1)
+    os.replace(final + ".tmp", final)
     print(json.dumps({k: v for k, v in result.items()
                       if k not in ("device", "cpu")}))
     return result
