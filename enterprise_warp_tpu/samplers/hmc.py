@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -228,7 +227,7 @@ class HMCSampler:
             return (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar,
                     mass, acc, ndiv, mu), (z, lnl, p_acc)
 
-        @partial(jax.jit, static_argnames=())
+        @jax.jit
         def block(z, key, log_eps, log_eps_bar, h_bar, mass, acc, ndiv,
                   iter0, mu):
             (lp, lnl), g = vgrad(z)
@@ -254,8 +253,9 @@ class HMCSampler:
             # a kill between the chain append and the (atomic) state
             # save leaves rows past the checkpoint that the resumed run
             # will regenerate — truncate the file to the checkpointed
-            # step so rows are never duplicated
-            if os.path.exists(chain_path0):
+            # step so rows are never duplicated (primary-only, like
+            # every other write here)
+            if _is_primary() and os.path.exists(chain_path0):
                 from .convergence import _robust_loadtxt
                 raw, dropped = _robust_loadtxt(chain_path0)
                 want = st.step * self.W

@@ -28,7 +28,15 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# algorithm-quality metrics are platform-independent — run on CPU. The
+# env var alone is NOT enough: an accelerator plugin's sitecustomize may
+# import jax at interpreter startup (freezing the platform default), so
+# force the config explicitly — the only override that still works
+# post-import. A dead tunnel otherwise hangs the first device call.
+os.environ["JAX_PLATFORMS"] = "cpu"
 import jax                                                  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp                                     # noqa: E402
 
 from enterprise_warp_tpu.models.priors import (Parameter,   # noqa: E402
@@ -87,14 +95,8 @@ def bimodal_like(sep=6.0):
     return AnalyticLike(fn, 2)
 
 
-def ess_per_step(like, nsamp, ntemps=4, nchains=8, seed=0, burn_frac=0.4,
-                 **kw):
-    with tempfile.TemporaryDirectory() as outdir:
-        s = PTSampler(like, outdir, ntemps=ntemps, nchains=nchains,
-                      seed=seed, cov_update=1000, **kw)
-        blocks = []
-        s.sample(nsamp, resume=False, verbose=False, collect=blocks)
-        rates = (s_rates(s) if ntemps > 1 else None)
+def _ess_report(blocks, like, nsamp, burn_frac, **extra):
+    """Shared reporting tail: burn, diagnostics, per-step ESS."""
     c = np.concatenate(blocks, axis=0)           # (steps, nchains, nd)
     keep = int(c.shape[0] * (1 - burn_frac))
     chains = np.transpose(c[-keep:], (1, 0, 2)).astype(np.float64)
@@ -105,9 +107,38 @@ def ess_per_step(like, nsamp, ntemps=4, nchains=8, seed=0, burn_frac=0.4,
         ess_min=round(worst["ess"], 1),
         ess_per_step=round(worst["ess"] / nsamp, 4),
         rhat_max=round(worst["rhat"], 4),
-        swap_rates=rates,
         means={k: round(v["mean"], 3) for k, v in summ.items()
-               if not k.startswith("_")})
+               if not k.startswith("_")},
+        **extra)
+
+
+def ess_per_step(like, nsamp, ntemps=4, nchains=8, seed=0, burn_frac=0.4,
+                 **kw):
+    with tempfile.TemporaryDirectory() as outdir:
+        s = PTSampler(like, outdir, ntemps=ntemps, nchains=nchains,
+                      seed=seed, cov_update=1000, **kw)
+        blocks = []
+        s.sample(nsamp, resume=False, verbose=False, collect=blocks)
+        rates = (s_rates(s) if ntemps > 1 else None)
+    return _ess_report(blocks, like, nsamp, burn_frac, swap_rates=rates)
+
+
+def ess_per_step_hmc(like, nsamp, nchains=8, seed=0, burn_frac=0.4,
+                     **kw):
+    """Same ESS/step metric for the gradient-based HMC sampler (no
+    tempering; each step costs n_leapfrog gradient evals, so the
+    report includes ESS per GRADIENT too — the honest compute unit)."""
+    from enterprise_warp_tpu.samplers import HMCSampler
+    n_leap = kw.pop("n_leapfrog", 16)
+    with tempfile.TemporaryDirectory() as outdir:
+        s = HMCSampler(like, outdir, nchains=nchains, seed=seed,
+                       n_leapfrog=n_leap, warmup=min(nsamp // 4, 1000),
+                       **kw)
+        blocks = []
+        s.sample(nsamp, resume=False, verbose=False, collect=blocks)
+    rep = _ess_report(blocks, like, nsamp, burn_frac, n_leapfrog=n_leap)
+    rep["ess_per_grad"] = round(rep["ess_min"] / (nsamp * n_leap), 5)
+    return rep
 
 
 def s_rates(s):
@@ -169,6 +200,10 @@ def main():
     report = {}
 
     report["banana"] = ess_per_step(banana_like(), n, seed=0)
+    # gradient-based comparison on the same curved target (HMC has no
+    # mode-hopping mechanism, so the bimodal target stays PT-only)
+    report["banana_hmc"] = ess_per_step_hmc(banana_like(), n // 4,
+                                            seed=0)
     report["bimodal"] = ess_per_step(bimodal_like(), n, seed=1)
     report["bimodal"]["mode_occupancy"] = round(
         mode_occupancy(bimodal_like(), n, seed=2), 3)
@@ -180,8 +215,10 @@ def main():
     report["hypermodel_no_prior_draws"] = hop_rate(0, n)
     report["hypermodel_local_jumps_only"] = hop_rate(0, n, de_weight=0)
 
-    with open(os.path.join(REPO, "MIXING.json"), "w") as fh:
-        json.dump(report, fh, indent=1)
+    if not quick:
+        # --quick is a smoke mode; only full runs publish the artifact
+        with open(os.path.join(REPO, "MIXING.json"), "w") as fh:
+            json.dump(report, fh, indent=1)
     print(json.dumps(report, indent=1))
 
 
