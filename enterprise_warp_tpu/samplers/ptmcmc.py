@@ -80,7 +80,8 @@ class PTSampler:
                  scam_weight=30, am_weight=15, de_weight=50,
                  prior_weight=10, cov_update=1000, swap_every=10,
                  tmax=None, init_cov=None, burn=0, adapt_ladder=True,
-                 ladder_t0=1000.0, swap_target=0.25):
+                 ladder_t0=1000.0, swap_target=0.25,
+                 write_hot_chains=False):
         self.like = like
         self.outdir = outdir
         self.ntemps = ntemps
@@ -99,9 +100,14 @@ class PTSampler:
         # style, with a decaying rate so ergodicity is preserved):
         # spacings grow where adjacent rungs swap too eagerly and shrink
         # where they decouple, each targeting ``swap_target``
-        self.adapt_ladder = adapt_ladder
         self.ladder_t0 = float(ladder_t0)
         self.swap_target = float(swap_target)
+        self.write_hot = bool(write_hot_chains)
+        # hot-chain files are named by rung temperature (the reference
+        # PTMCMCSampler convention) — only meaningful on a STATIC
+        # ladder, so writeHotChains pins it (the reference's ladder is
+        # always static)
+        self.adapt_ladder = adapt_ladder and not self.write_hot
         self.init_cov = init_cov
         self._lnprior_batch = jax.jit(jax.vmap(
             lambda t: like.log_prior(t)))
@@ -199,6 +205,7 @@ class PTSampler:
         W, nd = self.W, self.ndim
         ntemps, nchains = self.ntemps, self.nchains
         swap_every = self.swap_every
+        emit_hot = self.write_hot
 
         def one_step(carry, step_idx):
             x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop, \
@@ -293,21 +300,24 @@ class PTSampler:
             pick = step_idx % nchains
             hist = hist.at[slot].set(x[pick])
 
-            cold = x[:nchains]
-            cold_lnl = lnl[:nchains]
-            cold_lnp = lnp[:nchains]
+            if emit_hot:
+                # full walker ensemble per step, for reference-style
+                # per-temperature chain files (writeHotChains); the
+                # cold slice is rows [:nchains] on the host
+                ys = (x, lnl, lnp)
+            else:
+                ys = (x[:nchains], lnl[:nchains], lnp[:nchains])
             return ((x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                     eigvecs, eigvals, chol, temps),
-                    (cold, cold_lnl, cold_lnp))
+                     eigvecs, eigvals, chol, temps), ys)
 
         @partial(jax.jit, static_argnames=())
         def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                   eigvecs, eigvals, chol, temps):
             carry = (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                      eigvecs, eigvals, chol, temps)
-            carry, (cs, cl, cp) = jax.lax.scan(
+            carry, ys = jax.lax.scan(
                 one_step, carry, jnp.arange(nsteps))
-            return carry, cs, cl, cp
+            return (carry,) + tuple(ys)
 
         return block
 
@@ -329,10 +339,16 @@ class PTSampler:
                 print(f"resuming from step {st.step}")
         else:
             st = self._fresh_state()
-            # fresh run: truncate chain file
+            # fresh run: truncate the cold chain and any stale hot-rung
+            # files from a previous run in the same directory
             if _is_primary():
                 open(os.path.join(self.outdir, "chain_1.txt"),
                      "w").close()
+                import glob as _glob
+                for p in _glob.glob(os.path.join(self.outdir,
+                                                 "chain_*.txt")):
+                    if os.path.basename(p) != "chain_1.txt":
+                        os.remove(p)
 
         chain_path = os.path.join(self.outdir, "chain_1.txt")
         if _is_primary():
@@ -390,9 +406,18 @@ class PTSampler:
                         [[1.0], 1.0 + np.cumsum(np.exp(log_gap))])
 
             # --- write cold chains (interleaved walkers) -------------- #
-            cs = np.asarray(cold)[::thin]          # (steps, nchains, nd)
-            cl = np.asarray(cold_lnl)[::thin]
-            cp = np.asarray(cold_lnp)[::thin]
+            if self.write_hot:
+                # the block emitted the FULL ensemble; cold = first rung
+                full_x = np.asarray(cold)[::thin]
+                full_l = np.asarray(cold_lnl)[::thin]
+                full_p = np.asarray(cold_lnp)[::thin]
+                cs = full_x[:, :self.nchains]
+                cl = full_l[:, :self.nchains]
+                cp = full_p[:, :self.nchains]
+            else:
+                cs = np.asarray(cold)[::thin]      # (steps, nchains, nd)
+                cl = np.asarray(cold_lnl)[::thin]
+                cp = np.asarray(cold_lnp)[::thin]
             acc_rate = float(np.mean(st.accepted[:self.nchains])
                              / max(st.step, 1))
             tot_prop = float(np.sum(st.swaps_proposed))
@@ -408,6 +433,34 @@ class PTSampler:
             if _is_primary():
                 with open(chain_path, "ab") as fh:
                     np.savetxt(fh, rows)
+            if self.write_hot and _is_primary():
+                # reference PTMCMCSampler behavior (writeHotChains): one
+                # chain file per tempered rung. Row format matches the
+                # cold file with rung-local values: lnpost is the
+                # TEMPERED posterior (lnprior + lnlike/T), acc is the
+                # rung's own acceptance rate, and the last column is the
+                # swap rate of the edge joining this rung to the colder
+                # one. The ladder is static here (write_hot pins it), so
+                # the temperature in the filename is exact.
+                for k in range(1, self.ntemps):
+                    sl = slice(k * self.nchains, (k + 1) * self.nchains)
+                    T_k = st.ladder[k]
+                    acc_k = float(np.mean(st.accepted[sl])
+                                  / max(st.step, 1))
+                    swap_k = (float(st.swaps_accepted[k - 1])
+                              / max(st.swaps_proposed[k - 1], 1.0))
+                    nrow = full_x.shape[0] * self.nchains
+                    rows_k = np.concatenate([
+                        full_x[:, sl].reshape(-1, self.ndim),
+                        (full_p[:, sl]
+                         + full_l[:, sl] / T_k).reshape(-1, 1),
+                        full_l[:, sl].reshape(-1, 1),
+                        np.full((nrow, 1), acc_k),
+                        np.full((nrow, 1), swap_k)], axis=1)
+                    hot_path = os.path.join(
+                        self.outdir, f"chain_{T_k:.6g}.txt")
+                    with open(hot_path, "ab") as fh:
+                        np.savetxt(fh, rows_k)
             if collect is not None:
                 collect.append(cs.astype(np.float32))
 
@@ -438,14 +491,17 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
     opts = dict(seed=seed)
     thin = 1
     if params is not None:
+        skw = getattr(params, "sampler_kwargs", {})
         opts.update(
             scam_weight=getattr(params, "SCAMweight", 30),
             am_weight=getattr(params, "AMweight", 15),
             de_weight=getattr(params, "DEweight", 50),
             prior_weight=getattr(params, "PriorDrawWeight", 10),
             cov_update=getattr(params, "covUpdate", 1000) or 1000,
+            write_hot_chains=bool(getattr(
+                params, "writeHotChains",
+                skw.get("writeHotChains", False))),
         )
-        skw = getattr(params, "sampler_kwargs", {})
         thin = int(getattr(params, "thin", skw.get("thin", 1)) or 1)
         opts["burn"] = int(getattr(params, "burn",
                                    skw.get("burn", 0)) or 0)

@@ -166,6 +166,31 @@ class TestConvergence:
         chain = np.loadtxt(tmp_path / "chain_1.txt")
         assert len(chain) == rep.steps * 8
 
+    def test_write_hot_chains(self, tmp_path):
+        """writeHotChains parity: one reference-format chain file per
+        tempered rung (static ladder, tempered lnpost column), cold
+        chain unchanged."""
+        like = GaussianLike([0.0, 1.0], [0.5, 0.5])
+        s = PTSampler(like, str(tmp_path), ntemps=3, nchains=4, seed=0,
+                      write_hot_chains=True)
+        assert not s.adapt_ladder   # hot files imply a static ladder
+        s.sample(400, resume=False, verbose=False)
+        cold = np.loadtxt(tmp_path / "chain_1.txt")
+        assert cold.shape == (400 * 4, like.ndim + 4)
+        hot = sorted(p.name for p in tmp_path.glob("chain_*.txt"))
+        assert len(hot) == 3          # cold + 2 tempered rungs
+        for k, name in enumerate(
+                f"chain_{t:.6g}.txt" for t in s.init_ladder[1:]):
+            h = np.loadtxt(tmp_path / name)
+            assert h.shape == cold.shape
+            assert np.all(np.isfinite(h))
+            # lnpost column is the TEMPERED posterior: lnprior + lnl/T
+            T = s.init_ladder[k + 1]
+            lnpost, lnl = h[:, like.ndim], h[:, like.ndim + 1]
+            lnpri = -2 * np.log(20.0)
+            np.testing.assert_allclose(lnpost, lnpri + lnl / T,
+                                       atol=1e-6)
+
     def test_convergence_warm_start(self, tmp_path):
         """A killed convergence run resumes from the outdir: the second
         driver call picks up chain + checkpoint instead of restarting
