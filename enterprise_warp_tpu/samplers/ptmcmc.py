@@ -406,18 +406,15 @@ class PTSampler:
                         [[1.0], 1.0 + np.cumsum(np.exp(log_gap))])
 
             # --- write cold chains (interleaved walkers) -------------- #
-            if self.write_hot:
-                # the block emitted the FULL ensemble; cold = first rung
-                full_x = np.asarray(cold)[::thin]
-                full_l = np.asarray(cold_lnl)[::thin]
-                full_p = np.asarray(cold_lnp)[::thin]
-                cs = full_x[:, :self.nchains]
-                cl = full_l[:, :self.nchains]
-                cp = full_p[:, :self.nchains]
-            else:
-                cs = np.asarray(cold)[::thin]      # (steps, nchains, nd)
-                cl = np.asarray(cold_lnl)[::thin]
-                cp = np.asarray(cold_lnp)[::thin]
+            # with write_hot the block emitted the FULL ensemble and the
+            # cold rung is columns [:nchains]; otherwise the slice is a
+            # no-op on the already-cold emission
+            full_x = np.asarray(cold)[::thin]      # (steps, *, nd)
+            full_l = np.asarray(cold_lnl)[::thin]
+            full_p = np.asarray(cold_lnp)[::thin]
+            cs = full_x[:, :self.nchains]
+            cl = full_l[:, :self.nchains]
+            cp = full_p[:, :self.nchains]
             acc_rate = float(np.mean(st.accepted[:self.nchains])
                              / max(st.step, 1))
             tot_prop = float(np.sum(st.swaps_proposed))
@@ -445,6 +442,11 @@ class PTSampler:
                 for k in range(1, self.ntemps):
                     sl = slice(k * self.nchains, (k + 1) * self.nchains)
                     T_k = st.ladder[k]
+                    if T_k <= 1.0:
+                        # degenerate ladder (e.g. tmax=1): the rung is
+                        # statistically the cold chain and its filename
+                        # would collide with chain_1.txt — skip it
+                        continue
                     acc_k = float(np.mean(st.accepted[sl])
                                   / max(st.step, 1))
                     swap_k = (float(st.swaps_accepted[k - 1])

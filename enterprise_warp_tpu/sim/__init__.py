@@ -7,8 +7,12 @@ whole fake-PTA generation. Injection uses the *same* design matrices as the
 likelihood, guaranteeing round-trip consistency (SURVEY.md §2.2).
 """
 
-from .noise import (add_noise, inject_white, inject_basis_process,
-                    red_psd, dm_psd, make_fake_pulsar, make_fake_pta)
+from .noise import (add_noise, added_noise_psd_to_vector, inject_white,
+                    inject_basis_process, lorenzian_red_psd,
+                    plot_noise_psd_from_dict, red_psd, red_v1_psd,
+                    dm_psd, make_fake_pulsar, make_fake_pta)
 
-__all__ = ["add_noise", "inject_white", "inject_basis_process",
-           "red_psd", "dm_psd", "make_fake_pulsar", "make_fake_pta"]
+__all__ = ["add_noise", "added_noise_psd_to_vector", "inject_white",
+           "inject_basis_process", "lorenzian_red_psd",
+           "plot_noise_psd_from_dict", "red_psd", "red_v1_psd",
+           "dm_psd", "make_fake_pulsar", "make_fake_pta"]

@@ -38,6 +38,81 @@ def dm_psd(f, log10_A, gamma):
     return red_psd(f, log10_A, gamma)
 
 
+def red_v1_psd(f, log10_A, gamma, fc):
+    """Power-law PSD with a low-frequency turnover at ``fc`` Hz — the
+    reference's v1 convention (``libstempo_warp.py:10-12``):
+    ``A^2/(12 pi^2) fyr^(gamma-3) (f+fc)^-gamma``."""
+    A2 = 10.0 ** (2.0 * np.asarray(log10_A))
+    return (A2 / (12.0 * np.pi ** 2) * const.fyr ** (gamma - 3.0)
+            * (np.asarray(f) + fc) ** -gamma)
+
+
+def lorenzian_red_psd(f, P, fc, alpha):
+    """Lorentzian red-noise PSD ``P / (1 + (f/fc)^2)^(alpha/2)``
+    (reference ``libstempo_warp.py:17-18``; flat below the corner
+    frequency ``fc``, power-law -alpha above)."""
+    return P / (1.0 + (np.asarray(f) / fc) ** 2) ** (alpha / 2.0)
+
+
+def added_noise_psd_to_vector(added_noise_psd_params, param="efac"):
+    """Per-backend dict -> ``(values, backends)`` vectors for white-noise
+    re-injection (reference ``libstempo_warp.py:227-237`` contract)."""
+    vals, bckds = [], []
+    for backend, entry in added_noise_psd_params.items():
+        if isinstance(entry, dict) and param in entry:
+            vals.append(entry[param])
+            bckds.append(backend)
+    return vals, bckds
+
+
+def plot_noise_psd_from_dict(psr, psd_params, backends, ff, ax=None):
+    """Working version of the reference's broken plot helper
+    (``libstempo_warp.py:20-51`` uses ``plt`` without importing it and
+    punts on the DM curve): overlays per-backend white-noise levels, the
+    red-noise PSD (power-law by ``A``/``gamma`` or Lorentzian by
+    ``P``/``fc``/``alpha``), and the DM-noise PSD evaluated at the
+    pulsar's highest observing frequency."""
+    # no backend pin: this helper composes onto interactive figures too
+    # (matplotlib falls back to Agg on headless hosts by itself)
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        _, ax = plt.subplots()
+    ff = np.asarray(ff)
+    for backend in backends:
+        wpsd = psd_params[backend]["rms_toaerr"] * 1e-6
+        ax.loglog(ff, np.repeat(wpsd, len(ff)),
+                  label=f"RMS white noise in {backend}")
+    red = psd_params.get("red")
+    if red:
+        if "A" in red:
+            ax.loglog(ff, red_psd(ff, np.log10(red["A"]), red["gamma"]),
+                      label=(f"Red noise, lgA="
+                             f"{np.log10(red['A']):.2f}, "
+                             f"gamma={red['gamma']:.2f}"))
+        elif "P" in red:
+            ax.loglog(ff, lorenzian_red_psd(ff, red["P"], red["fc"],
+                                            red["alpha"]),
+                      label=(f"Red noise, lgP={np.log10(red['P']):.2f},"
+                             f" alpha={red['alpha']:.2f}"))
+    dm = psd_params.get("dm")
+    if dm and "A" in dm:
+        # timing perturbation of DM noise scales as nu^-2; at the
+        # highest observing frequency the chromatic factor is
+        # (fref/nu_max)^2 relative to the 1400 MHz reference
+        numax = float(np.max(psr.freqs))
+        scale = (1400.0 / numax) ** 2
+        ax.loglog(ff, scale ** 2 * dm_psd(ff, np.log10(dm["A"]),
+                                          dm["gamma"]),
+                  label=(f"DM noise at {numax:.0f} MHz, "
+                         f"lgA={np.log10(dm['A']):.2f}, "
+                         f"gamma={dm['gamma']:.2f}"))
+    ax.set_xlabel("Frequency [Hz]")
+    ax.set_ylabel("PSD [s^3]")
+    ax.legend(fontsize=7)
+    return ax
+
+
 def inject_white(psr: Pulsar, efac=None, equad_log10=None, flag=None,
                  rng=None):
     """Add per-backend white noise to ``psr.residuals``.

@@ -1,0 +1,57 @@
+"""PSD-formula and PSD-export parity with the reference's simulation
+toolkit (``libstempo_warp.py:6-18,20-51,227-237``)."""
+
+import numpy as np
+
+from enterprise_warp_tpu.sim import (added_noise_psd_to_vector,
+                                     lorenzian_red_psd,
+                                     plot_noise_psd_from_dict, red_psd,
+                                     red_v1_psd, make_fake_pulsar)
+
+
+def test_red_v1_reduces_to_powerlaw():
+    f = np.logspace(-9, -7, 20)
+    np.testing.assert_allclose(red_v1_psd(f, -13.5, 4.0, 0.0),
+                               red_psd(f, -13.5, 4.0), rtol=1e-12)
+    # fc > 0 suppresses low frequencies, leaves f >> fc nearly unchanged
+    with_fc = red_v1_psd(f, -13.5, 4.0, 1e-9)
+    assert with_fc[0] < red_psd(f, -13.5, 4.0)[0]
+    np.testing.assert_allclose(with_fc[-1], red_psd(f, -13.5, 4.0)[-1],
+                               rtol=0.05)
+
+
+def test_lorenzian_limits():
+    fc, P, alpha = 1e-8, 3.0, 4.0
+    # flat below the corner
+    np.testing.assert_allclose(lorenzian_red_psd(1e-11, P, fc, alpha),
+                               P, rtol=1e-4)
+    # -alpha power law far above it
+    hi = lorenzian_red_psd(np.array([1e-6, 2e-6]), P, fc, alpha)
+    np.testing.assert_allclose(hi[0] / hi[1], 2.0 ** alpha, rtol=1e-3)
+
+
+def test_added_noise_psd_to_vector():
+    params = {"CASPSR": {"efac": 1.1, "equad": -7.0},
+              "DFB": {"efac": 0.9},
+              "red": {"A": 1e-14, "gamma": 4.0}}
+    vals, bckds = added_noise_psd_to_vector(params, "efac")
+    assert dict(zip(bckds, vals)) == {"CASPSR": 1.1, "DFB": 0.9}
+    vals, bckds = added_noise_psd_to_vector(params, "equad")
+    assert bckds == ["CASPSR"] and vals == [-7.0]
+
+
+def test_plot_noise_psd_from_dict():
+    """The reference version is broken (no plt import, DM branch
+    disabled); ours must actually render all three curve families."""
+    psr = make_fake_pulsar(ntoa=50, backends=("X",),
+                           freqs_mhz=(1400.0, 3100.0), seed=0)
+    ff = np.logspace(-9, -7, 30)
+    psd_params = {"X": {"rms_toaerr": 1.0},
+                  "red": {"A": 1e-14, "gamma": 4.0},
+                  "dm": {"A": 1e-14, "gamma": 3.0}}
+    ax = plot_noise_psd_from_dict(psr, psd_params, ["X"], ff)
+    assert len(ax.lines) == 3       # white + red + dm
+    # lorentzian branch
+    psd_params["red"] = {"P": 1e-20, "fc": 1e-8, "alpha": 4.0}
+    ax2 = plot_noise_psd_from_dict(psr, psd_params, ["X"], ff)
+    assert len(ax2.lines) == 3
