@@ -1,0 +1,98 @@
+"""Unit tests for the north-star measurement harness's failure-recovery
+machinery (watchdog, leg resume-dir stamping, wall accumulation) —
+without running any actual sampling legs."""
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_ns():
+    spec = importlib.util.spec_from_file_location(
+        "north_star", str(REPO / "tools" / "north_star.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_watchdog_kills_silent_process():
+    ns = _load_ns()
+    rc, lines, err = ns._stream_with_watchdog(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        dict(os.environ), 3)
+    assert rc is None          # watchdog fired
+    assert lines == []
+
+
+def test_watchdog_passes_healthy_process():
+    ns = _load_ns()
+    rc, lines, err = ns._stream_with_watchdog(
+        [sys.executable, "-c",
+         "print('  step 1'); print('{\"ok\": 1}')"],
+        dict(os.environ), 30)
+    assert rc == 0
+    assert json.loads(lines[-1]) == {"ok": 1}
+
+
+def test_stream_reports_exit_code_and_stderr():
+    ns = _load_ns()
+    rc, lines, err = ns._stream_with_watchdog(
+        [sys.executable, "-c",
+         "import sys; sys.stderr.write('boom'); sys.exit(3)"],
+        dict(os.environ), 30)
+    assert rc == 3 and "boom" in err
+
+
+def test_cpu_env_strips_only_plugin_site():
+    ns = _load_ns()
+    sep = os.pathsep
+    envpath = sep.join(["/root/.axon_site", "/home/saxony/libs",
+                        "/opt/other"])
+    old = os.environ.get("PYTHONPATH")
+    os.environ["PYTHONPATH"] = envpath
+    try:
+        env = ns._cpu_env()
+    finally:
+        if old is None:
+            del os.environ["PYTHONPATH"]
+        else:
+            os.environ["PYTHONPATH"] = old
+    parts = env["PYTHONPATH"].split(sep)
+    assert "/root/.axon_site" not in parts
+    assert "/home/saxony/libs" in parts     # 'axon' substring survives
+    assert "/opt/other" in parts
+    assert env["JAX_PLATFORMS"] == "cpu"
+
+
+def test_leg_dir_stamp_invalidation(tmp_path, monkeypatch):
+    """A resume dir from a different configuration must be discarded;
+    a matching one must be kept."""
+    ns = _load_ns()
+    monkeypatch.setattr(ns, "leg_dir",
+                        lambda name: str(tmp_path / name))
+    d = tmp_path / "cpu"
+    d.mkdir()
+    (d / "chain_1.txt").write_text("1 2 3\n")
+    # stale stamp -> wiped
+    (d / "config.json").write_text(json.dumps({"nchains": 999}))
+    ns.prepare_leg_dir("cpu", ns.LEGS["cpu"])
+    assert not (d / "chain_1.txt").exists()     # stale state wiped
+
+    (d / "chain_1.txt").write_text("4 5 6\n")
+    ns.prepare_leg_dir("cpu", ns.LEGS["cpu"])
+    assert (d / "chain_1.txt").exists()         # matching stamp kept
+
+    # no stamp at all (pre-stamp directory) -> wiped
+    (d / "config.json").unlink()
+    ns.prepare_leg_dir("cpu", ns.LEGS["cpu"])
+    assert not (d / "chain_1.txt").exists()
+
+    # truncated stamp (kill mid-write) -> wiped, not crashed
+    (d / "chain_1.txt").write_text("7 8 9\n")
+    (d / "config.json").write_text('{"nchains": 4, "me')
+    ns.prepare_leg_dir("cpu", ns.LEGS["cpu"])
+    assert not (d / "chain_1.txt").exists()

@@ -47,6 +47,37 @@ PROBE_WAIT_S = 3600   # max wait for the device to come back per attempt
 def leg_dir(name):
     return os.path.join(REPO, ".ns_runs", name)
 
+
+def prepare_leg_dir(name, cfg):
+    """Create/validate a leg's persistent resume directory.
+
+    Config stamp: a resume dir left by a killed run under a DIFFERENT
+    leg configuration or measurement definition must not warm-start this
+    one (wrong nchains scrambles the chain reshape; wrong problem mixes
+    parameters; old wall-clock pollutes the measurement) — mismatched
+    state is wiped."""
+    outdir = leg_dir(name)
+    stamp = dict(cfg, meta=META)
+    stamp_path = os.path.join(outdir, "config.json")
+    if os.path.isdir(outdir):
+        old = None
+        if os.path.exists(stamp_path):
+            try:
+                with open(stamp_path) as fh:
+                    old = json.load(fh)
+            except ValueError:
+                old = None   # truncated stamp (kill mid-write) -> wipe
+        if old != stamp:
+            print("discarding resume state from a different "
+                  "configuration", flush=True)
+            shutil.rmtree(outdir)
+    os.makedirs(outdir, exist_ok=True)
+    tmp = stamp_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(stamp, fh)
+    os.replace(tmp, stamp_path)
+    return outdir
+
 TARGET_ESS = 1000.0
 RHAT_MAX = 1.01
 MAX_STEPS = 300_000
@@ -120,25 +151,7 @@ def run_leg(name):
     like = build_problem(cfg["gram_mode"])
     build_s = time.perf_counter() - t0
 
-    outdir = leg_dir(name)
-    # config stamp: a resume dir left by a killed run under a DIFFERENT
-    # leg configuration or measurement definition must not warm-start
-    # this one (wrong nchains scrambles the chain reshape; wrong problem
-    # mixes parameters; old wall-clock pollutes the measurement)
-    stamp = dict(cfg, meta=META)
-    stamp_path = os.path.join(outdir, "config.json")
-    if os.path.isdir(outdir):
-        old = None
-        if os.path.exists(stamp_path):
-            with open(stamp_path) as fh:
-                old = json.load(fh)
-        if old != stamp:
-            print("discarding resume state from a different "
-                  "configuration", flush=True)
-            shutil.rmtree(outdir)
-    os.makedirs(outdir, exist_ok=True)
-    with open(stamp_path, "w") as fh:
-        json.dump(stamp, fh)
+    outdir = prepare_leg_dir(name, cfg)
     wall_path = os.path.join(outdir, "wall.json")
     prior_wall = {"wall_s": 0.0, "steady_wall_s": 0.0, "attempts": 0}
     if os.path.exists(wall_path):
