@@ -59,6 +59,10 @@ def parse_commandline(argv=None):
     p.add_argument("-v", "--covm", type=int, default=0,
                    help="collect per-pulsar cov.npy into a block-diagonal "
                         "proposal covariance (csv + pkl)")
+    p.add_argument("-g", "--diagnostics", type=int, default=0,
+                   help="per-parameter split-R-hat / ESS table + JSON "
+                        "(no reference counterpart; convergence is by "
+                        "eye there)")
     p.add_argument("-e", "--bilby", type=int, default=0,
                    help="treat runs as result-JSON (nested) outputs")
     p.add_argument("-o", "--optimal_statistic", type=int, default=0)
@@ -242,6 +246,8 @@ class EnterpriseWarpResult:
                 self._make_chain_plot(psr_dir, chain, diag, pars)
             if self.opts.covm:
                 self._collect_covm(psr_dir, pars)
+            if getattr(self.opts, "diagnostics", 0):
+                self._print_diagnostics(psr_dir, chain, pars)
         if self.opts.covm:
             self._save_covm()
 
@@ -256,6 +262,55 @@ class EnterpriseWarpResult:
             if re.match(r"^[JB]\d{4}[+-]\d{2,4}$", head):
                 return head
         return "run"
+
+    def _infer_nchains(self, psr_dir):
+        """Walker count of the run, from the sampler checkpoint: the
+        chain file interleaves walkers per step, and diagnostics need
+        the (nchains, nsteps) split. Falls back to 1 (split-halves
+        R-hat still applies)."""
+        path = os.path.join(self.outdir_all, psr_dir, "state.npz")
+        if os.path.exists(path):
+            try:
+                z = np.load(path)
+                if "ladder" in z.files:           # PT sampler
+                    return int(z["x"].shape[0]) // max(
+                        len(z["ladder"]), 1)
+                if "z" in z.files:                # HMC sampler
+                    return int(z["z"].shape[0])
+            except Exception:
+                pass
+        return 1
+
+    def _print_diagnostics(self, psr_dir, chain, pars):
+        """Split-R-hat / multi-chain ESS over the post-burn chain — the
+        quantitative convergence check the reference leaves to the
+        user's eye (``nsamp: 1000000`` and look at the trace)."""
+        from ..utils.diagnostics import summarize_chains
+        nch = self._infer_nchains(psr_dir)
+        nsteps = len(chain) // max(nch, 1)
+        if nsteps < 4:
+            print("   (chain too short for diagnostics)")
+            return
+        c = chain[:nsteps * nch].reshape(nsteps, nch, len(pars))
+        c = np.transpose(c, (1, 0, 2))
+        summ = summarize_chains(c, pars)
+        worst = summ["_worst"]
+        worst_par = max(pars, key=lambda p: summ[p]["rhat"])
+        print(f"   diagnostics ({nch} chains x {nsteps} post-burn "
+              f"steps): worst R-hat={worst['rhat']:.4f} at {worst_par} "
+              f"(its ESS={summ[worst_par]['ess']:.0f}; "
+              f"min ESS={worst['ess']:.0f})")
+        for p in pars:
+            s = summ[p]
+            print(f"     {p:40s} rhat={s['rhat']:.4f} "
+                  f"ess={s['ess']:8.0f}")
+        outdir = os.path.join(self.outdir_all, "diagnostics")
+        os.makedirs(outdir, exist_ok=True)
+        name = psr_dir or "run"
+        path = os.path.join(outdir, f"{name}_diagnostics.json")
+        with open(path, "w") as fh:
+            json.dump(summ, fh, indent=1, default=float)
+        print(f"   diagnostics json: {path}")
 
     # ------------------------ products -------------------------------- #
     def _make_credlevels(self, psrname, chain, pars):

@@ -19,6 +19,7 @@ from enterprise_warp_tpu.results.core import check_if_psr_dir
 def opts_for(result, **kw):
     base = dict(result=result, info=0, name="all", corner=0, par=None,
                 chains=0, logbf=0, noisefiles=0, credlevels=0,
+                diagnostics=0,
                 separate_earliest=0.0, mpi_regime=0, load_separated=0,
                 covm=0, bilby=0, optimal_statistic=0,
                 optimal_statistic_orfs="hd,dipole,monopole",
@@ -128,6 +129,23 @@ class TestCore:
         assert os.path.exists(path)
         with open(path) as fh:
             assert "J1832-0836_efac" in json.load(fh)
+
+    def test_diagnostics_option(self, tmp_path, capsys):
+        out = str(tmp_path)
+        d, pars, _ = write_fake_run(out, nsamp=800)
+        # a 4-chain PT checkpoint so nchains inference kicks in
+        np.savez(os.path.join(d, "state.npz"),
+                 x=np.zeros((8, len(pars))), ladder=np.array([1.0, 1.7]))
+        r = EnterpriseWarpResult(opts_for(out, diagnostics=1))
+        r.main_pipeline()
+        text = capsys.readouterr().out
+        assert "worst R-hat=" in text and "4 chains" in text
+        path = os.path.join(out, "diagnostics",
+                            "0_J0000+0000_diagnostics.json")
+        summ = json.load(open(path))
+        assert set(pars) <= set(summ)
+        # iid synthetic chain: converged by construction
+        assert summ["_worst"]["rhat"] < 1.05
 
     def test_separate_earliest_roundtrip(self, tmp_path):
         out = str(tmp_path)
