@@ -30,7 +30,7 @@ from ..io import bary
 from ..ops import fourier_design, dm_scaling
 from ..ops.spectra import df_from_freqs
 from ..ops.fourier import log_freq_ratio
-from .priors import (Uniform, Normal, LinearExp, Constant, Parameter,
+from .priors import (Uniform, LinearExp, Constant, Parameter,
                      interpret_white_noise_prior)
 from .terms import WhiteTerm, BasisTerm, CommonTerm
 

@@ -15,7 +15,7 @@ import os
 
 import numpy as np
 
-from .core import EnterpriseWarpResult, make_noise_files
+from .core import EnterpriseWarpResult
 
 
 class BilbyWarpResult(EnterpriseWarpResult):

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..models.prior_mixin import PriorMixin
 from ..models.priors import Parameter, Uniform

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import json
 import os
-from functools import partial
 
 import jax
 import jax.numpy as jnp

@@ -17,9 +17,7 @@ import numpy as np
 from .. import constants as const
 from ..io.par import ParFile
 from ..io.pulsar import Pulsar
-from ..io.tim import TimFile
-from ..io import timing
-from ..ops import fourier_design, dm_scaling
+from ..ops import fourier_design
 from ..ops.spectra import df_from_freqs
 
 _FLAG_CONVENTIONS = ("group", "f", "g", "sys", "be", "B")
