@@ -46,6 +46,11 @@ def _bind(lib):
     lib.ewt_table_ncols.restype = ctypes.c_longlong
     lib.ewt_table_fill.argtypes = [ctypes.c_void_p, c_dp]
     lib.ewt_table_free.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "ewt_table_write"):   # absent from pre-writer .so
+        lib.ewt_table_write.argtypes = [ctypes.c_char_p, c_dp,
+                                        ctypes.c_longlong,
+                                        ctypes.c_longlong, ctypes.c_int]
+        lib.ewt_table_write.restype = ctypes.c_longlong
     return lib
 
 
@@ -157,3 +162,30 @@ def read_table_native(path: str):
         return out.reshape(-1, ncols)
     finally:
         lib.ewt_table_free(h)
+
+
+def write_table(path: str, arr, append: bool = True) -> None:
+    """Fast ``%.18e`` table append (chain files) — np.savetxt's default
+    row format via the native core's buffered snprintf loop, with an
+    np.savetxt fallback. The sampler chain writes go through here: their
+    per-block formatting cost counts toward the measured sampling
+    wall-clock."""
+    arr = np.ascontiguousarray(np.atleast_2d(arr), dtype=np.float64)
+    lib = load()
+    if lib is not None and hasattr(lib, "ewt_table_write"):
+        # record the pre-call size: a mid-write failure (ENOSPC, EIO)
+        # can leave some rows + a torn partial line on disk, and the
+        # fallback below must not append the block AGAIN after them
+        pre = os.path.getsize(path) if (append and
+                                        os.path.exists(path)) else 0
+        rc = lib.ewt_table_write(
+            path.encode(),
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            arr.shape[0], arr.shape[1], int(append))
+        if rc == arr.shape[0]:
+            return
+        if rc == -1 and os.path.exists(path) and \
+                os.path.getsize(path) > pre:
+            os.truncate(path, pre)
+    with open(path, "ab" if append else "wb") as fh:
+        np.savetxt(fh, arr)

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..native import write_table
 from ..parallel.distributed import is_primary as _is_primary
 
 
@@ -339,8 +340,7 @@ class HMCSampler:
                 np.full((len(thetas), 1), acc_rate),
                 np.zeros((len(thetas), 1))], axis=1)
             if _is_primary():
-                with open(chain_path, "ab") as fh:
-                    np.savetxt(fh, rows)
+                write_table(chain_path, rows, append=True)
             if collect is not None:
                 collect.append(thetas.reshape(todo, self.W, self.ndim)
                                .astype(np.float32))

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..native import write_table
 from ..parallel.distributed import is_primary as _is_primary
 
 _HISTORY = 1000     # DE history ring length (per walker)
@@ -428,8 +429,7 @@ class PTSampler:
                 np.full((cs.shape[0] * self.nchains, 1), swap_rate),
             ], axis=1)
             if _is_primary():
-                with open(chain_path, "ab") as fh:
-                    np.savetxt(fh, rows)
+                write_table(chain_path, rows, append=True)
             if self.write_hot and _is_primary():
                 # reference PTMCMCSampler behavior (writeHotChains): one
                 # chain file per tempered rung. Row format matches the
@@ -461,8 +461,7 @@ class PTSampler:
                         np.full((nrow, 1), swap_k)], axis=1)
                     hot_path = os.path.join(
                         self.outdir, f"chain_{T_k:.6g}.txt")
-                    with open(hot_path, "ab") as fh:
-                        np.savetxt(fh, rows_k)
+                    write_table(hot_path, rows_k, append=True)
             if collect is not None:
                 collect.append(cs.astype(np.float32))
 

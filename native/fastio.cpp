@@ -21,6 +21,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <clocale>
 #include <map>
 #include <string>
 #include <vector>
@@ -289,5 +290,40 @@ void ewt_table_fill(TableData* td, double* out) {
 }
 
 void ewt_table_free(TableData* td) { delete td; }
+
+// ---- fast float-table writer (chain files) ---------------------------
+// np.savetxt's default '%.18e' row format, written with a buffered
+// snprintf loop: the measurement path appends a (steps x walkers)-row
+// block per sampling block, and np.savetxt's per-element Python
+// formatting is a visible fraction of the convergence wall-clock.
+// 18 significant digits round-trips float64 exactly. Returns rows
+// written, or -1 when the file cannot be opened.
+long long ewt_table_write(const char* path, const double* data,
+                          long long nrow, long long ncol, int append) {
+    // snprintf is LC_NUMERIC-sensitive; np.savetxt (the path this
+    // replaces and the fallback) is not. Refuse under a comma-decimal
+    // locale so the caller falls back instead of writing rows that no
+    // reader parses.
+    if (std::localeconv()->decimal_point[0] != '.') return -2;
+    std::FILE* fh = std::fopen(path, append ? "ab" : "wb");
+    if (!fh) return -1;
+    std::vector<char> buf(1 << 20);
+    std::setvbuf(fh, buf.data(), _IOFBF, buf.size());
+    char tmp[40];
+    for (long long i = 0; i < nrow; ++i) {
+        for (long long j = 0; j < ncol; ++j) {
+            int len = std::snprintf(tmp, sizeof tmp, "%.18e",
+                                    data[i * ncol + j]);
+            if (j) std::fputc(' ', fh);
+            std::fwrite(tmp, 1, (size_t)len, fh);
+        }
+        std::fputc('\n', fh);
+    }
+    long long ok = std::ferror(fh) ? -1 : nrow;
+    // the final flush happens at fclose — an ENOSPC/EIO there is the
+    // common failure for a fully-buffered block, so it must gate success
+    if (std::fclose(fh) != 0) ok = -1;
+    return ok;
+}
 
 }  // extern "C"
