@@ -44,10 +44,16 @@ def chains_from_file(chain_path, nchains, ndim, burn_frac=0.25):
 
 
 def _robust_loadtxt(path):
-    """``np.loadtxt`` tolerating a partial final line (kill mid-append):
+    """Chain-file load tolerating a partial final line (kill mid-append):
     rows that fail float parsing — wrong token count OR a token truncated
     mid-write ('1.2e', '-') — are dropped, wherever they sit. Returns
-    ``(array, dropped_any)``."""
+    ``(array, dropped_any)``. Clean files go through the native fast
+    reader (resume re-parses the whole chain once; on long device runs
+    that is a multi-GB text file)."""
+    from ..native import read_table_native
+    clean = read_table_native(str(path))
+    if clean is not None:
+        return clean, False
     try:
         return np.loadtxt(path, ndmin=2), False
     except ValueError:
