@@ -15,7 +15,8 @@ so the results layer is sampler-agnostic.
 from .ptmcmc import PTSampler, run_ptmcmc
 from .nested import run_nested
 from .hmc import HMCSampler, run_hmc
+from .vi import fit_advi
 from .hypermodel import HyperModelLikelihood
 
 __all__ = ["PTSampler", "run_ptmcmc", "run_nested",
-           "HMCSampler", "run_hmc", "HyperModelLikelihood"]
+           "HMCSampler", "run_hmc", "fit_advi", "HyperModelLikelihood"]

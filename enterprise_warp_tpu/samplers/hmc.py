@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..native import write_table
+from .transform import make_logp_z
 from ..parallel.distributed import is_primary as _is_primary
 
 
@@ -80,19 +81,9 @@ class HMCSampler:
         self.eps_jitter = float(eps_jitter)
         self.seed = seed
 
-        def logp_z(z):
-            u = jax.nn.sigmoid(z)
-            theta = like.from_unit(u)
-            lnl = like.loglike(theta)
-            # d theta/d z Jacobian of the sigmoid leg only: the from_unit
-            # leg's Jacobian is 1/p(theta), which cancels the prior
-            # density — the prior is absorbed by the transform
-            ljac = jnp.sum(jax.nn.log_sigmoid(z) + jax.nn.log_sigmoid(-z))
-            lp = lnl + ljac
-            # a non-finite likelihood (prior-corner solve failure) must
-            # reject, not poison the trajectory
-            lp = jnp.where(jnp.isfinite(lp), lp, -jnp.inf)
-            return lp, lnl
+        # shared z-space target (samplers/transform.py): prior absorbed
+        # by the sigmoid + unit-cube transform, -inf on solve failures
+        logp_z = make_logp_z(like)
 
         def vgrad_fn(z):
             (lp, lnl), g = jax.value_and_grad(logp_z, has_aux=True)(z)

@@ -1,0 +1,31 @@
+"""The shared unconstrained-space target density.
+
+Both gradient-based samplers (HMC, ADVI) work in z-space via
+``theta = from_unit(sigmoid(z))``: the ``from_unit`` leg's Jacobian is
+``1/p(theta)``, cancelling the prior density, so the target reduces to
+``lnL(theta(z)) + sum ln sigmoid'(z)``. One implementation here keeps
+their targets identical by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_logp_z(like):
+    """Return ``logp_z(z) -> (lp, lnl)`` for a PriorMixin-style
+    likelihood: the z-space log-density (non-finite mapped to -inf so a
+    prior-corner solve failure rejects instead of poisoning a
+    trajectory) and the raw log-likelihood as auxiliary output."""
+
+    def logp_z(z):
+        u = jax.nn.sigmoid(z)
+        theta = like.from_unit(u)
+        lnl = like.loglike(theta)
+        ljac = jnp.sum(jax.nn.log_sigmoid(z) + jax.nn.log_sigmoid(-z))
+        lp = lnl + ljac
+        lp = jnp.where(jnp.isfinite(lp), lp, -jnp.inf)
+        return lp, lnl
+
+    return logp_z
