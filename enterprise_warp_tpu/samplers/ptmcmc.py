@@ -82,7 +82,7 @@ class PTSampler:
                  prior_weight=10, cov_update=1000, swap_every=10,
                  tmax=None, init_cov=None, burn=0, adapt_ladder=True,
                  ladder_t0=1000.0, swap_target=0.25,
-                 write_hot_chains=False):
+                 write_hot_chains=False, init_x=None):
         self.like = like
         self.outdir = outdir
         self.ntemps = ntemps
@@ -110,6 +110,11 @@ class PTSampler:
         # always static)
         self.adapt_ladder = adapt_ladder and not self.write_hot
         self.init_cov = init_cov
+        # optional warm start (e.g. ADVI posterior draws): rows are
+        # cycled over the walker ensemble; non-finite starters are
+        # re-drawn from the prior by _fresh_state's existing guard
+        self.init_x = None if init_x is None else np.atleast_2d(
+            np.asarray(init_x, dtype=float))
         self._lnprior_batch = jax.jit(jax.vmap(
             lambda t: like.log_prior(t)))
         self._compiled_block = None
@@ -120,6 +125,9 @@ class PTSampler:
     def _fresh_state(self):
         rng = np.random.default_rng(self.seed)
         x0 = self.like.sample_prior(rng, self.W)
+        if self.init_x is not None:
+            reps = int(np.ceil(self.W / len(self.init_x)))
+            x0 = np.tile(self.init_x, (reps, 1))[:self.W]
         lnl = np.asarray(self.like.loglike_batch(jnp.asarray(x0)))
         # re-draw any walker that landed on a non-finite corner
         for _ in range(20):
@@ -515,6 +523,18 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
         opts["ntemps"] = max(int(ntemps), 1)
         if skw.get("Tmax") is not None:
             opts["tmax"] = float(skw["Tmax"])
+        if getattr(params, "advi_init", skw.get("advi_init", False)) \
+                and not (resume and os.path.exists(
+                    os.path.join(outdir, "state.npz"))):
+            # warm-start walkers from a quick variational fit — cuts
+            # burn-in; the chain itself is unchanged MCMC. Skipped on
+            # resume: a loaded checkpoint ignores init_x entirely
+            from .vi import fit_advi
+            if verbose:
+                print("advi_init: fitting variational warm start")
+            fit = fit_advi(like, steps=int(skw.get("advi_steps", 800)),
+                           mc=8, seed=seed)
+            opts["init_x"] = fit["samples"]
     opts.update(kw)
     sampler = PTSampler(like, outdir, **opts)
     sampler.sample(nsamp, resume=resume, verbose=verbose, thin=thin)

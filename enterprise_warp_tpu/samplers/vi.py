@@ -31,7 +31,8 @@ def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
 
     Parameters
     ----------
-    like : likelihood object (``loglike``, ``from_unit``, ``params``).
+    like : likelihood object with ``loglike``, ``from_unit``, ``params``,
+        ``ndim`` and ``param_names`` (any PriorMixin likelihood).
     steps : Adam iterations.
     mc : Monte Carlo samples per ELBO gradient (one batched call).
     lr : Adam learning rate.
@@ -50,32 +51,35 @@ def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
 
     def logp_z(z):
         lp, _ = _logp(z)
-        # finite stand-in for -inf: the ELBO average must stay a number
-        # the optimizer can push away from
-        return jnp.maximum(lp, -1e30)
+        return lp
 
-    logp_batch = jax.vmap(logp_z)
-
-    def elbo(params, key):
-        mu, log_sig = params
-        eps = jax.random.normal(key, (mc, nd))
-        z = mu + jnp.exp(log_sig) * eps
-        # E_q[logp] + entropy of the diagonal Gaussian
-        return jnp.mean(logp_batch(z)) + jnp.sum(log_sig) \
-            + 0.5 * nd * jnp.log(2 * jnp.pi * jnp.e)
+    # per-SAMPLE values/gradients so one failed-solve draw can be
+    # masked out of the Monte Carlo average instead of NaN-poisoning it
+    # (a zeroed aggregate gradient would silently no-op the whole step)
+    vg = jax.vmap(jax.value_and_grad(logp_z))
+    entropy_const = 0.5 * nd * np.log(2 * np.pi * np.e)
 
     opt = optax.adam(lr)
 
     @jax.jit
     def step(params, opt_state, key):
-        val, g = jax.value_and_grad(
-            lambda p: -elbo(p, key))(params)
-        # a stray non-finite MC gradient (prior-corner solve failure)
-        # must not poison the whole fit
-        g = jax.tree_util.tree_map(
-            lambda x: jnp.where(jnp.isfinite(x), x, 0.0), g)
-        updates, opt_state = opt.update(g, opt_state)
-        return optax.apply_updates(params, updates), opt_state, -val
+        mu, log_sig = params
+        sig = jnp.exp(log_sig)
+        eps = jax.random.normal(key, (mc, nd))
+        z = mu + sig[None, :] * eps
+        lp, g = vg(z)                              # (mc,), (mc, nd)
+        ok = jnp.isfinite(lp) & jnp.all(jnp.isfinite(g), axis=1)
+        n_ok = jnp.maximum(jnp.sum(ok), 1)
+        gm = jnp.where(ok[:, None], g, 0.0)
+        # reparameterization-trick ELBO gradients over the surviving
+        # samples; the diagonal-Gaussian entropy gradient (+1 per
+        # log_sig) is exact
+        g_mu = jnp.sum(gm, axis=0) / n_ok
+        g_ls = jnp.sum(gm * eps * sig[None, :], axis=0) / n_ok + 1.0
+        val = (jnp.sum(jnp.where(ok, lp, 0.0)) / n_ok
+               + jnp.sum(log_sig) + entropy_const)
+        updates, opt_state = opt.update((-g_mu, -g_ls), opt_state)
+        return optax.apply_updates(params, updates), opt_state, val
 
     params = (jnp.zeros(nd), jnp.full(nd, -1.0))
     opt_state = opt.init(params)
