@@ -1,0 +1,50 @@
+#!/bin/bash
+# Unattended device-side measurement chain (referenced by BASELINE.md).
+#
+# Waits for the accelerator to answer a probe (a dead tunnel hangs device
+# calls forever — see tools/north_star.py), then runs, in order:
+#   1. the north-star device leg (resumable; watchdogged internally),
+#   2. the headline benchmark (bench.py),
+#   3. the per-BASELINE-config benchmark (bench.py --configs),
+#   4. the kernel and joint-likelihood profilers.
+# Each stage re-probes first so a tunnel drop between stages aborts
+# cleanly instead of wedging. All output lands in $OUT.
+#
+# Usage: nohup bash tools/device_measurements.sh &   (from the repo root)
+set -u
+OUT=${EWT_MEASURE_OUT:-/tmp/tpu_chain}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 50 python -c "import jax, jax.numpy as jnp; jnp.ones((8,8)).sum().block_until_ready(); print('ok')" >/dev/null 2>&1
+}
+
+echo "$(date +%H:%M:%S) waiting for device" >> "$OUT/log"
+until probe; do sleep 90; done
+echo "$(date +%H:%M:%S) device UP — north-star device leg" >> "$OUT/log"
+
+python tools/north_star.py legs device > "$OUT/north_star.log" 2>&1
+rc=$?
+echo "$(date +%H:%M:%S) north_star device leg rc=$rc" >> "$OUT/log"
+
+probe || { echo "$(date +%H:%M:%S) tunnel lost before bench" >> "$OUT/log"; exit 1; }
+python bench.py > "$OUT/bench_headline.json" 2> "$OUT/bench_headline.err"
+rc=$?
+echo "$(date +%H:%M:%S) bench headline rc=$rc" >> "$OUT/log"
+
+probe || { echo "$(date +%H:%M:%S) tunnel lost before configs" >> "$OUT/log"; exit 1; }
+python bench.py --configs > "$OUT/bench_configs.json" 2> "$OUT/bench_configs.err"
+rc=$?
+echo "$(date +%H:%M:%S) bench configs rc=$rc" >> "$OUT/log"
+
+probe || exit 1
+python tools/profile_kernel.py > "$OUT/profile_kernel.log" 2>&1
+rc=$?
+echo "$(date +%H:%M:%S) profile_kernel rc=$rc" >> "$OUT/log"
+
+probe || exit 1
+python tools/profile_joint.py > "$OUT/profile_joint.log" 2>&1
+rc=$?
+echo "$(date +%H:%M:%S) profile_joint rc=$rc" >> "$OUT/log"
+echo "$(date +%H:%M:%S) CHAIN DONE" >> "$OUT/log"
