@@ -1,0 +1,43 @@
+"""Shared accelerator-tunnel probe.
+
+The TPU in this environment is reached through an experimental PJRT
+plugin over a relay; when the relay dies, device calls block forever on
+a futex inside the PJRT client — no error, no timeout.  Every consumer
+that might touch the device therefore probes it first **in a throwaway
+subprocess with a wall-clock timeout**, converting the hang into a clean
+False.  This module is the single Python implementation of that probe
+(``tools/device_measurements.sh`` keeps an equivalent shell one-liner);
+``bench.py`` and ``tools/north_star.py`` both use it so the recipe
+cannot drift between them.
+"""
+
+import subprocess
+import sys
+
+__all__ = ["probe_device"]
+
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp;"
+    "jnp.ones((8, 8)).sum().block_until_ready();"
+    "{check}print('ok')"
+)
+
+
+def probe_device(timeout=60, env=None, require_accelerator=True):
+    """True iff a trivial jax computation completes within ``timeout``.
+
+    With ``require_accelerator`` (the default) the probe additionally
+    asserts the default backend is not CPU, so a session where the
+    plugin silently fell back to host does not count as "device up".
+    Pass ``env`` to probe the platform a specific subprocess would see
+    (e.g. a forced-CPU leg).
+    """
+    check = ("assert jax.devices()[0].platform != 'cpu';"
+             if require_accelerator else "")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE.format(check=check)],
+            env=env, timeout=timeout, capture_output=True)
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Self-respawning guard around device_measurements.sh (round-3 postmortem:
+# the chain died with the builder's session and never respawned).
+#
+# Keeps relaunching the measurement chain until it drops the $OUT/DONE
+# marker, or until $OUT/STOP exists. Exactly one guard can hold the lock.
+# Launch with:
+#   setsid nohup bash tools/device_guard.sh >/dev/null 2>&1 < /dev/null &
+set -u
+OUT=${EWT_MEASURE_OUT:-/tmp/tpu_chain}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+exec 9>"$OUT/guard.lock"
+flock -n 9 || exit 0            # another guard is already running
+
+# fresh round: clear the previous run's completion marker and rotate its
+# append-only log so stale state can't satisfy this run's exit checks
+rm -f "$OUT/DONE"
+[ -s "$OUT/log" ] && mv "$OUT/log" "$OUT/log.$(date +%s).old"
+
+echo "$(date +%H:%M:%S) guard up (pid $$)" >> "$OUT/log"
+while true; do
+  [ -f "$OUT/STOP" ] && { echo "$(date +%H:%M:%S) guard: STOP file, exiting" >> "$OUT/log"; exit 0; }
+  [ -f "$OUT/DONE" ] && { echo "$(date +%H:%M:%S) guard: chain complete, exiting" >> "$OUT/log"; exit 0; }
+  bash tools/device_measurements.sh
+  rc=$?
+  echo "$(date +%H:%M:%S) guard: chain exited rc=$rc, respawn in 120s" >> "$OUT/log"
+  sleep 120
+done

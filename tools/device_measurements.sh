@@ -16,8 +16,15 @@ OUT=${EWT_MEASURE_OUT:-/tmp/tpu_chain}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
+# one chain at a time: two concurrent chains would clobber each other's
+# artifacts and time the single device simultaneously
+exec 8>"$OUT/chain.lock"
+flock -n 8 || { echo "$(date +%H:%M:%S) another chain holds the lock" >> "$OUT/log"; exit 3; }
+
 probe() {
-  timeout 50 python -c "import jax, jax.numpy as jnp; jnp.ones((8,8)).sum().block_until_ready(); print('ok')" >/dev/null 2>&1
+  # demand a non-CPU backend: a silent jax-CPU fallback must not count
+  # as "device up" (shared recipe: enterprise_warp_tpu/utils/deviceprobe.py)
+  timeout 50 python -c "import jax, jax.numpy as jnp; jnp.ones((8,8)).sum().block_until_ready(); assert jax.devices()[0].platform != 'cpu'; print('ok')" >/dev/null 2>&1
 }
 
 echo "$(date +%H:%M:%S) waiting for device" >> "$OUT/log"
@@ -47,4 +54,10 @@ probe || exit 1
 python tools/profile_joint.py > "$OUT/profile_joint.log" 2>&1
 rc=$?
 echo "$(date +%H:%M:%S) profile_joint rc=$rc" >> "$OUT/log"
+
+probe || exit 1
+python tools/roofline.py > "$OUT/roofline.log" 2>&1
+rc=$?
+echo "$(date +%H:%M:%S) roofline rc=$rc" >> "$OUT/log"
 echo "$(date +%H:%M:%S) CHAIN DONE" >> "$OUT/log"
+touch "$OUT/DONE"               # completion marker for device_guard.sh

@@ -313,19 +313,24 @@ def _stream_with_watchdog(cmd, env, idle_timeout):
         "".join(c for c in err_chunks if c)
 
 
-def _device_reachable(env, timeout=60):
+def _device_reachable(env, timeout=60, require_accelerator=False):
     """Probe the leg's platform with a tiny computation in a throwaway
     subprocess (a dead tunnel hangs the PJRT client forever, so the probe
-    gets a hard timeout)."""
-    code = ("import jax, jax.numpy as jnp;"
-            "jnp.ones((8, 8)).sum().block_until_ready();print('ok')")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], env=env,
-                           capture_output=True, text=True,
-                           timeout=timeout)
-        return r.returncode == 0 and "ok" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    gets a hard timeout). Shared implementation:
+    enterprise_warp_tpu/utils/deviceprobe.py — loaded by file path so
+    this module stays jax-import-free. The DEVICE leg must pass
+    ``require_accelerator=True`` so a fast plugin failure with a silent
+    jax-CPU fallback is not mistaken for "device up" (the convergence
+    leg would then burn days at CPU speed); CPU legs pass a forced-CPU
+    env and must not demand an accelerator."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_deviceprobe", os.path.join(REPO, "enterprise_warp_tpu",
+                                     "utils", "deviceprobe.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.probe_device(timeout=timeout, env=env,
+                            require_accelerator=require_accelerator)
 
 
 def _drive_leg(name, cmd, env):
@@ -346,7 +351,8 @@ def _drive_leg(name, cmd, env):
                                f"{MAX_ATTEMPTS} attempts")
         t0 = time.time()
         while time.time() - t0 < PROBE_WAIT_S:
-            if _device_reachable(env):
+            if _device_reachable(env,
+                                 require_accelerator=(name == "device")):
                 break
             print(f"[{name} leg] device unreachable; retrying probe in "
                   "120s", flush=True)
