@@ -127,6 +127,24 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
             ckpt_step = int(np.load(sampler._ckpt_path)["step"])
             nsteps = min(raw.shape[0] // sampler.nchains, ckpt_step)
             if nsteps > 0:
+                if nsteps < ckpt_step:
+                    # dropped/partial lines left FEWER complete chain
+                    # rows than the checkpointed step — resuming from
+                    # ckpt_step would leave a permanent gap in the file.
+                    # Relabel the checkpoint to nsteps instead: the
+                    # walker state is a valid Markov state wherever the
+                    # step counter points, so continuing it as step
+                    # nsteps keeps the chain file contract (rows ==
+                    # steps*nchains) at the cost of re-counting the
+                    # lost steps.
+                    print(f"  resume: chain file holds {nsteps} complete "
+                          f"steps < checkpoint step {ckpt_step}; "
+                          "rewinding checkpoint counter", flush=True)
+                    z = dict(np.load(sampler._ckpt_path))
+                    z["step"] = nsteps
+                    tmp = sampler._ckpt_path + ".tmp.npz"
+                    np.savez(tmp, **z)
+                    os.replace(tmp, sampler._ckpt_path)
                 truncated = nsteps * sampler.nchains < raw.shape[0]
                 raw = raw[:nsteps * sampler.nchains]
                 # repair the on-disk chain to exactly the rows we keep:
@@ -140,6 +158,21 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
                     tmp = chain_path + ".tmp"
                     np.savetxt(tmp, raw)
                     os.replace(tmp, chain_path)
+                # hot-rung files (writeHotChains) are appended in the
+                # same blocks as the cold file: truncate each to the
+                # same step so a kill between the cold and hot appends
+                # cannot leave them out of sync after resume
+                import glob as _glob
+                for hp in _glob.glob(os.path.join(sampler.outdir,
+                                                  "chain_*.txt")):
+                    if os.path.basename(hp) == "chain_1.txt":
+                        continue
+                    hraw, hdrop = _robust_loadtxt(hp)
+                    keep = nsteps * sampler.nchains
+                    if hdrop or hraw.shape[0] != keep:
+                        tmp = hp + ".tmp"
+                        np.savetxt(tmp, hraw[:keep])
+                        os.replace(tmp, hp)
                 c = raw[:, :sampler.ndim]
                 blocks.append(c.reshape(nsteps, sampler.nchains,
                                         sampler.ndim).astype(np.float32))

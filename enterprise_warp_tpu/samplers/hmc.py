@@ -57,6 +57,8 @@ class HMCState:
     divergences: int
     mu: float = 0.0        # dual-averaging anchor (re-centered when the
     da_iter: int = 0       # mass changes) and iterations since anchor
+    ngrad: int = 0         # cumulative leapfrog gradient evals PER CHAIN
+    #                        (honest ESS-per-gradient accounting)
 
 
 class HMCSampler:
@@ -69,16 +71,30 @@ class HMCSampler:
 
     def __init__(self, like, outdir, nchains=64, seed=0, n_leapfrog=16,
                  target_accept=0.8, warmup=1000, init_eps=0.1,
-                 eps_jitter=0.1):
+                 eps_jitter=0.1, jitter_L=True, mass0=None, z0=None):
+        """``jitter_L``: draw the trajectory length uniformly in
+        [n_leapfrog/2, n_leapfrog] each step (shared across the batch) —
+        breaks periodic orbits like NUTS's dynamic termination does, at
+        ~3/4 the gradient cost of fixed-L, with XLA-static shapes (the
+        loop lowers to a while_loop with a traced trip count).
+
+        ``mass0``/``z0`` — warm start (e.g. from an ADVI fit, see
+        :func:`run_hmc`): initial diagonal mass matrix (z-space
+        precisions) and initial positions (W, ndim) or a single (ndim,)
+        point jittered per chain. A good mass0 removes most of the
+        warmup burn the mass adaptation otherwise spends."""
         self.like = like
         self.outdir = outdir
         self.W = nchains
         self.ndim = like.ndim
         self.n_leapfrog = n_leapfrog
+        self.jitter_L = bool(jitter_L)
         self.target_accept = float(target_accept)
         self.warmup = int(warmup)
         self.init_eps = float(init_eps)
         self.eps_jitter = float(eps_jitter)
+        self.mass0 = None if mass0 is None else np.asarray(mass0, float)
+        self.z0 = None if z0 is None else np.asarray(z0, float)
         self.seed = seed
 
         # shared z-space target (samplers/transform.py): prior absorbed
@@ -103,10 +119,22 @@ class HMCSampler:
     # ---------------- init / checkpoint -------------------------------- #
     def _fresh_state(self):
         rng = np.random.default_rng(self.seed)
-        # start from prior draws, mapped into z space; redraw any chain
-        # that landed on a non-finite corner (mirrors PTSampler)
-        u = np.clip(rng.uniform(size=(self.W, self.ndim)), 1e-6, 1 - 1e-6)
-        z = np.log(u) - np.log1p(-u)
+        if self.z0 is not None:
+            # warm start: ADVI posterior draws (or a mean point jittered
+            # per chain) — already in z space
+            if self.z0.ndim == 2:
+                idx = rng.integers(0, len(self.z0), self.W)
+                z = np.array(self.z0[idx])
+            else:
+                z = self.z0[None, :] + 0.1 * rng.standard_normal(
+                    (self.W, self.ndim))
+        else:
+            # start from prior draws, mapped into z space
+            u = np.clip(rng.uniform(size=(self.W, self.ndim)),
+                        1e-6, 1 - 1e-6)
+            z = np.log(u) - np.log1p(-u)
+        # redraw any chain that landed on a non-finite corner (mirrors
+        # PTSampler)
         for _ in range(20):
             bad = ~np.isfinite(np.asarray(self._logp_batch(
                 jnp.asarray(z))))
@@ -115,12 +143,14 @@ class HMCSampler:
             u = np.clip(rng.uniform(size=(int(bad.sum()), self.ndim)),
                         1e-6, 1 - 1e-6)
             z[bad] = np.log(u) - np.log1p(-u)
+        mass = (np.ones(self.ndim) if self.mass0 is None
+                else self.mass0.copy())
         return HMCState(z=z,
                         key=np.asarray(jax.random.PRNGKey(self.seed)),
                         log_eps=float(np.log(self.init_eps)),
                         log_eps_bar=float(np.log(self.init_eps)),
                         h_bar=0.0,
-                        mass=np.ones(self.ndim), step=0,
+                        mass=mass, step=0,
                         accepted=np.zeros(self.W), divergences=0,
                         mu=float(np.log(10.0 * self.init_eps)),
                         da_iter=0)
@@ -137,7 +167,7 @@ class HMCSampler:
                  log_eps_bar=st.log_eps_bar, h_bar=st.h_bar,
                  mass=st.mass, step=st.step, accepted=st.accepted,
                  divergences=st.divergences, mu=st.mu,
-                 da_iter=st.da_iter)
+                 da_iter=st.da_iter, ngrad=st.ngrad)
         os.replace(tmp, self._ckpt_path)
 
     def _load_state(self):
@@ -148,7 +178,9 @@ class HMCSampler:
                         h_bar=float(z["h_bar"]), mass=z["mass"],
                         step=int(z["step"]), accepted=z["accepted"],
                         divergences=int(z["divergences"]),
-                        mu=float(z["mu"]), da_iter=int(z["da_iter"]))
+                        mu=float(z["mu"]), da_iter=int(z["da_iter"]),
+                        ngrad=int(z["ngrad"]) if "ngrad" in z.files
+                        else 0)
 
     # ---------------- jitted block ------------------------------------- #
     def _make_block(self, nsteps, adapt):
@@ -164,10 +196,13 @@ class HMCSampler:
         target = self.target_accept
         gamma, t0, kappa = 0.05, 10.0, 0.75
 
+        jitter_L = self.jitter_L
+        l_min = max(1, n_leap // 2)
+
         def one_step(carry, t_glob):
             (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar, mass, acc,
-             ndiv, mu) = carry
-            key, kp, ke, ka = jax.random.split(key, 4)
+             ndiv, mu, ngrad) = carry
+            key, kp, ke, ka, kl = jax.random.split(key, 5)
 
             eps = jnp.exp(log_eps)
             sqm = jnp.sqrt(mass)
@@ -175,6 +210,15 @@ class HMCSampler:
             # per-chain step-size jitter de-synchronizes periodic orbits
             eps_c = eps * (1.0 + jit_frac * (
                 2.0 * jax.random.uniform(ke, (W, 1)) - 1.0))
+            # jittered trajectory LENGTH (shared across the batch this
+            # step): kills the resonances fixed-L HMC falls into — the
+            # XLA-static stand-in for NUTS's dynamic termination — and
+            # averages ~3/4 of the fixed-L gradient cost. The traced
+            # trip count lowers to a while_loop.
+            if jitter_L:
+                L_t = jax.random.randint(kl, (), l_min, n_leap + 1)
+            else:
+                L_t = n_leap
 
             def leap(i, s):
                 zz, pp, gg, _, _ = s
@@ -185,7 +229,8 @@ class HMCSampler:
                 return zz, pp, gg, lpv, lnlv
 
             z1, p1, g1, lp1, lnl1 = jax.lax.fori_loop(
-                0, n_leap, leap, (z, p0, g, lp, lnl))
+                0, L_t, leap, (z, p0, g, lp, lnl))
+            ngrad = ngrad + L_t
 
             ke0 = 0.5 * jnp.sum(p0 * p0 / mass[None, :], axis=1)
             ke1 = 0.5 * jnp.sum(p1 * p1 / mass[None, :], axis=1)
@@ -196,8 +241,12 @@ class HMCSampler:
             log_ratio = jnp.where(jnp.isnan(log_ratio), -jnp.inf,
                                   log_ratio)
             log_ratio = jnp.where(jnp.isfinite(lp1), log_ratio, -jnp.inf)
-            # divergence: energy error blown far beyond stochastic scale
-            ndiv = ndiv + jnp.sum(log_ratio < -50.0)
+            # divergence: energy error blown far beyond stochastic scale.
+            # Only count trajectories that ended at a FINITE lp — an
+            # -inf endpoint is an ordinary prior-corner/solve-failure
+            # rejection, not an integrator energy blow-up.
+            ndiv = ndiv + jnp.sum((log_ratio < -50.0)
+                                  & jnp.isfinite(lp1))
             p_acc = jnp.minimum(1.0, jnp.exp(log_ratio))
             accept = jnp.log(jax.random.uniform(ka, (W,))) < log_ratio
 
@@ -217,20 +266,21 @@ class HMCSampler:
                 log_eps_bar = w * log_eps + (1.0 - w) * log_eps_bar
 
             return (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar,
-                    mass, acc, ndiv, mu), (z, lnl, p_acc)
+                    mass, acc, ndiv, mu, ngrad), (z, lnl, p_acc)
 
         @jax.jit
         def block(z, key, log_eps, log_eps_bar, h_bar, mass, acc, ndiv,
-                  iter0, mu):
+                  iter0, mu, ngrad):
             (lp, lnl), g = vgrad(z)
+            ngrad = ngrad + 1          # the block-entry gradient
             carry = (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar,
-                     mass, acc, ndiv, mu)
+                     mass, acc, ndiv, mu, ngrad)
             carry, (zs, lnls, p_accs) = jax.lax.scan(
                 one_step, carry, iter0 + jnp.arange(nsteps))
             (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar, mass, acc,
-             ndiv, mu) = carry
+             ndiv, mu, ngrad) = carry
             return (z, key, log_eps, log_eps_bar, h_bar, acc, ndiv, zs,
-                    lnls, jnp.mean(p_accs))
+                    lnls, jnp.mean(p_accs), ngrad)
 
         return block
 
@@ -281,11 +331,11 @@ class HMCSampler:
             if bkey not in blocks:
                 blocks[bkey] = self._make_block(todo, adapt)
             (z, key, log_eps, log_eps_bar, h_bar, acc, ndiv, zs, lnls,
-             mean_acc) = blocks[bkey](
+             mean_acc, ngrad) = blocks[bkey](
                 jnp.asarray(st.z), jnp.asarray(st.key), st.log_eps,
                 st.log_eps_bar, st.h_bar, jnp.asarray(st.mass),
                 jnp.asarray(st.accepted), st.divergences, st.da_iter,
-                st.mu)
+                st.mu, st.ngrad)
             st.z = np.asarray(z)
             st.key = np.asarray(key)
             st.log_eps = float(log_eps)
@@ -293,6 +343,7 @@ class HMCSampler:
             st.h_bar = float(h_bar)
             st.accepted = np.asarray(acc)
             st.divergences = int(ndiv)
+            st.ngrad = int(ngrad)
             st.step += todo
             if adapt:
                 st.da_iter += todo
@@ -347,8 +398,16 @@ class HMCSampler:
 
 
 def run_hmc(like, outdir, nsamp, params=None, resume=True, seed=0,
-            verbose=True, **kw):
-    """Convenience entry honoring paramfile sampler kwargs."""
+            verbose=True, advi_init=True, **kw):
+    """Convenience entry honoring paramfile sampler kwargs.
+
+    ``advi_init`` (default on; paramfile key ``advi_init: 0`` disables):
+    fit a mean-field ADVI posterior first (a few thousand batched evals)
+    and warm-start HMC from it — initial positions are ADVI draws and
+    the initial diagonal mass matrix is the ADVI precision, so the
+    sampler starts in the typical set with a near-correct metric and the
+    warmup can be much shorter (variance-based mass adaptation still
+    refines it)."""
     opts = dict(seed=seed)
     if params is not None:
         skw = getattr(params, "sampler_kwargs", {})
@@ -357,7 +416,33 @@ def run_hmc(like, outdir, nsamp, params=None, resume=True, seed=0,
             n_leapfrog=int(skw.get("n_leapfrog", 16)),
             warmup=int(skw.get("warmup", 1000)),
             target_accept=float(skw.get("target_accept", 0.8)))
+        if "advi_init" in skw:
+            advi_init = bool(int(skw["advi_init"]))
+        if "jitter_L" in skw:
+            opts["jitter_L"] = bool(int(skw["jitter_L"]))
     opts.update(kw)
+    if advi_init and "mass0" not in opts and \
+            not (resume and os.path.exists(
+                os.path.join(outdir, "state.npz"))):
+        from .vi import fit_advi
+        fit = fit_advi(like, steps=1500, mc=16, seed=seed,
+                       verbose=verbose)
+        sig2 = np.exp(2.0 * np.asarray(fit["z_log_sig"]))
+        opts["mass0"] = 1.0 / np.maximum(sig2, 1e-12)
+        mu = np.asarray(fit["z_mu"])
+        sig = np.sqrt(sig2)
+        rng = np.random.default_rng(seed)
+        W = opts.get("nchains", 64)
+        opts["z0"] = mu[None, :] + sig[None, :] * rng.standard_normal(
+            (W, len(mu)))
+        # metric is near-correct from the start: a short warmup only
+        # needs to settle the step size — unless the caller explicitly
+        # chose a warmup (paramfile key or kwarg)
+        explicit = "warmup" in kw or (
+            params is not None
+            and "warmup" in getattr(params, "sampler_kwargs", {}))
+        if not explicit:
+            opts["warmup"] = max(200, min(400, nsamp // 10))
     sampler = HMCSampler(like, outdir, **opts)
     sampler.sample(nsamp, resume=resume, verbose=verbose)
     return sampler

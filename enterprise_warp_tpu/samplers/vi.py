@@ -78,6 +78,12 @@ def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
         g_ls = jnp.sum(gm * eps * sig[None, :], axis=0) / n_ok + 1.0
         val = (jnp.sum(jnp.where(ok, lp, 0.0)) / n_ok
                + jnp.sum(log_sig) + entropy_const)
+        # if EVERY draw failed there is no likelihood signal this step —
+        # applying the bare entropy gradient (+1 per log_sig) would just
+        # widen sigma into the failing region; skip the update instead
+        any_ok = jnp.sum(ok) > 0
+        g_mu = jnp.where(any_ok, g_mu, 0.0)
+        g_ls = jnp.where(any_ok, g_ls, 0.0)
         updates, opt_state = opt.update((-g_mu, -g_ls), opt_state)
         return optax.apply_updates(params, updates), opt_state, val
 
