@@ -10,7 +10,8 @@ directory), so chains from any backend round-trip.
 """
 
 from .core import (EnterpriseWarpResult, estimate_from_distribution,  # noqa: F401
-                   make_noise_files, parse_commandline)
+                   make_noise_files, parse_commandline,
+                   suitable_estimator)
 from .bilbylike import BilbyWarpResult  # noqa: F401
 from .optstat import OptimalStatisticResult, OptimalStatisticWarp  # noqa: F401
 from .reconstruct import (NoiseReconstructor,  # noqa: F401

@@ -72,7 +72,21 @@ def parse_commandline(argv=None):
                    default=1000)
     p.add_argument("-M", "--custom_models_py", type=str, default=None)
     p.add_argument("-U", "--custom_models", type=str, default=None)
+    p.add_argument("--errorbars_cdf", type=str, default="16,84",
+                   help="lo,hi CDF percentiles for credible intervals "
+                        "(reference errorbars_cdf, default 16,84)")
     return p.parse_args(argv)
+
+
+def _opt_errorbars_cdf(opts):
+    """(lo, hi) percentiles from the CLI option; tolerates an opts
+    namespace without the attribute (older drivers/tests)."""
+    raw = getattr(opts, "errorbars_cdf", None) or "16,84"
+    if isinstance(raw, (tuple, list)):
+        lo, hi = raw
+    else:
+        lo, hi = (float(t) for t in str(raw).split(","))
+    return float(lo), float(hi)
 
 
 def _read_table(path):
@@ -90,10 +104,12 @@ def check_if_psr_dir(folder_name: str) -> bool:
     return bool(_PSR_DIR_RE.match(folder_name))
 
 
-def estimate_from_distribution(values, method="mode"):
+def estimate_from_distribution(values, method="mode",
+                               errorbars_cdf=(16.0, 84.0)):
     """Point estimate from posterior samples (reference
     ``results.py:169-198``): 'mode' via a Gaussian KDE argmax on a grid,
-    'median', or credible bounds."""
+    'median', or credible bounds at configurable CDF percentiles
+    (reference ``errorbars_cdf``, default [16, 84])."""
     values = np.asarray(values, dtype=np.float64)
     if method == "median":
         return float(np.median(values))
@@ -105,10 +121,37 @@ def estimate_from_distribution(values, method="mode"):
         grid = np.linspace(values.min(), values.max(), 512)
         return float(grid[np.argmax(kde(grid))])
     if method == "credlvl":
-        lo16, med, hi84 = np.percentile(values, [15.87, 50.0, 84.13])
-        return dict(median=float(med), minus=float(med - lo16),
-                    plus=float(hi84 - med))
+        lo_p, hi_p = float(errorbars_cdf[0]), float(errorbars_cdf[1])
+        lo, med, hi = np.percentile(values, [lo_p, 50.0, hi_p])
+        # 'maximum' via the reference's cheap histogram-argmax
+        # (results.py:139-155 dist_mode_position), not the KDE — O(n)
+        # per parameter and no degenerate-sample crash mode
+        if np.ptp(values) == 0:
+            mx = float(values[0])
+        else:
+            counts, edges = np.histogram(values, bins=50)
+            mx = float(edges[np.argmax(counts)])
+        # reference key layout (results.py:189-198) + the minus/plus
+        # half-widths the posterior table prints
+        return {"median": float(med), "maximum": float(mx),
+                "50": float(med),
+                str(int(lo_p) if lo_p == int(lo_p) else lo_p): float(lo),
+                str(int(hi_p) if hi_p == int(hi_p) else hi_p): float(hi),
+                "minus": float(med - lo), "plus": float(hi - med),
+                "errorbars_cdf": [lo_p, hi_p]}
     raise ValueError(f"unknown estimate method '{method}'")
+
+
+def suitable_estimator(levels, errorbars_cdf=(16.0, 84.0)):
+    """Maximum-posterior (mode) value if it lies inside the credible
+    interval, else the median — the reference's maximum-vs-median
+    fallback (``results.py:157-167``). Returns ``(value, which)``."""
+    lo_p, hi_p = float(errorbars_cdf[0]), float(errorbars_cdf[1])
+    lo_k = str(int(lo_p) if lo_p == int(lo_p) else lo_p)
+    hi_k = str(int(hi_p) if hi_p == int(hi_p) else hi_p)
+    if levels[lo_k] < levels["maximum"] < levels[hi_k]:
+        return levels["maximum"], "maximum"
+    return levels["50"], "50"
 
 
 def make_noise_files(psrname, chain, pars, outdir, method="mode"):
@@ -314,8 +357,15 @@ class EnterpriseWarpResult:
 
     # ------------------------ products -------------------------------- #
     def _make_credlevels(self, psrname, chain, pars):
-        rows = {p: estimate_from_distribution(chain[:, i], "credlvl")
-                for i, p in enumerate(pars)}
+        cdf = _opt_errorbars_cdf(self.opts)
+        rows = {}
+        for i, p in enumerate(pars):
+            lv = estimate_from_distribution(chain[:, i], "credlvl",
+                                            errorbars_cdf=cdf)
+            # the reference's maximum-vs-median fallback picks the point
+            # estimate downstream consumers should use
+            lv["best"], lv["best_which"] = suitable_estimator(lv, cdf)
+            rows[p] = lv
         outdir = os.path.join(self.outdir_all, "credlevels")
         os.makedirs(outdir, exist_ok=True)
         path = os.path.join(outdir, f"{psrname}_credlvl.json")
@@ -405,10 +455,12 @@ class EnterpriseWarpResult:
         if self.opts.corner == 2:
             tab = os.path.join(self.outdir_all, psr_dir,
                                "posterior_table.txt")
+            cdf = _opt_errorbars_cdf(self.opts)
             with open(tab, "w") as fh:
                 for i, p in enumerate(pars):
                     cl = estimate_from_distribution(chain[:, i],
-                                                    "credlvl")
+                                                    "credlvl",
+                                                    errorbars_cdf=cdf)
                     fh.write(f"{p} {cl['median']:.6g} "
                              f"-{cl['minus']:.3g} +{cl['plus']:.3g}\n")
 

@@ -71,6 +71,32 @@ class TestCore:
         cl = estimate_from_distribution(x, "credlvl")
         assert abs(cl["minus"] - 0.5) < 0.1
         assert abs(cl["plus"] - 0.5) < 0.1
+        # reference key layout (results.py:189-198)
+        assert set(("median", "maximum", "50", "16", "84")) <= set(cl)
+
+    def test_errorbars_cdf_configurable(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(0.0, 1.0, 20000)
+        cl = estimate_from_distribution(x, "credlvl",
+                                        errorbars_cdf=(2.5, 97.5))
+        assert "2.5" in cl and "97.5" in cl
+        # ~2-sigma interval on a unit normal
+        assert abs(cl["minus"] - 1.96) < 0.1
+        assert abs(cl["plus"] - 1.96) < 0.1
+
+    def test_suitable_estimator_fallback(self):
+        from enterprise_warp_tpu.results import suitable_estimator
+        rng = np.random.default_rng(3)
+        x = rng.normal(1.0, 0.3, 8000)
+        lv = estimate_from_distribution(x, "credlvl")
+        val, which = suitable_estimator(lv)
+        assert which == "maximum" and abs(val - 1.0) < 0.2
+        # mode pushed outside the interval -> median fallback
+        # (reference results.py:157-167)
+        lv2 = dict(lv)
+        lv2["maximum"] = lv["84"] + 1.0
+        val2, which2 = suitable_estimator(lv2)
+        assert which2 == "50" and val2 == lv["50"]
 
     def test_pipeline_products(self, tmp_path):
         out = str(tmp_path)
