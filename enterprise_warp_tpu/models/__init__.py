@@ -12,12 +12,14 @@ as in the reference plugin contract (``examples/custom_models.py``).
 """
 
 from .priors import Uniform, Normal, LinearExp, Constant, Parameter
-from .terms import WhiteTerm, BasisTerm, CommonTerm, TermList
+from .terms import (WhiteTerm, BasisTerm, CommonTerm, DeterministicTerm,
+                    TermList)
 from .standard import StandardModels
 from .build import build_pulsar_likelihood, PulsarLikelihood
 
 __all__ = [
     "Uniform", "Normal", "LinearExp", "Constant", "Parameter",
-    "WhiteTerm", "BasisTerm", "CommonTerm", "TermList",
-    "StandardModels", "build_pulsar_likelihood", "PulsarLikelihood",
+    "WhiteTerm", "BasisTerm", "CommonTerm", "DeterministicTerm",
+    "TermList", "StandardModels", "build_pulsar_likelihood",
+    "PulsarLikelihood",
 ]

@@ -120,12 +120,15 @@ def init_model_likelihoods(params, gram_mode="split", write_pars=True):
     """``init_pta`` equivalent: ``{model_id: compiled likelihood}``."""
     likes = {}
     for ii, pm in params.models.items():
-        if getattr(pm, "tm", "default") not in ("default", None):
+        tm_opt = getattr(pm, "tm", "default") or "default"
+        if tm_opt not in ("default", "sampled"):
             raise NotImplementedError(
-                f"tm: {pm.tm} — only the marginalized linear timing model "
-                "('default') is implemented (the reference's "
-                "'ridge_regression' option is broken upstream, "
-                "enterprise_warp.py:453-459)")
+                f"tm: {pm.tm} — 'default' (marginalized linear timing "
+                "model) and 'sampled' (per-column tmparams offsets, the "
+                "reference expansion at bilby_warp.py:85-91) are "
+                "implemented; the reference's 'ridge_regression' option "
+                "is broken upstream (enterprise_warp.py:453-459)")
+        tm_mode = "sampled" if tm_opt == "sampled" else "marginalized"
         nfreqs_logs = []
         termlists = build_terms_for_model(pm, params.psrs,
                                           params.noise_model_obj,
@@ -134,10 +137,17 @@ def init_model_likelihoods(params, gram_mode="split", write_pars=True):
         if getattr(pm, "noisefiles", None):
             fixed = get_noise_dict([p.name for p in params.psrs],
                                    params._resolve(pm.noisefiles))
+        if tm_mode == "sampled" and len(params.psrs) > 1 and \
+                has_correlated_common(termlists):
+            raise NotImplementedError(
+                "tm: sampled is per-pulsar; combine it with the "
+                "correlated joint fit by sampling single pulsars first "
+                "(the reference has no sampled-TM joint fit either)")
         if len(params.psrs) == 1:
             like = build_pulsar_likelihood(params.psrs[0], termlists[0],
                                            fixed_values=fixed,
-                                           gram_mode=gram_mode)
+                                           gram_mode=gram_mode,
+                                           tm=tm_mode)
         elif has_correlated_common(termlists):
             from ..parallel import build_pta_likelihood
             like = build_pta_likelihood(params.psrs, termlists,
@@ -146,7 +156,7 @@ def init_model_likelihoods(params, gram_mode="split", write_pars=True):
         else:
             like = MultiPulsarLikelihood([
                 build_pulsar_likelihood(p, tl, fixed_values=fixed,
-                                        gram_mode=gram_mode)
+                                        gram_mode=gram_mode, tm=tm_mode)
                 for p, tl in zip(params.psrs, termlists)])
         likes[ii] = like
 

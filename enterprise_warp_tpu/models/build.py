@@ -27,8 +27,9 @@ from ..ops.kernel import marginalized_loglike, whiten_inputs
 from ..ops.spectra import (broken_powerlaw_psd, df_from_freqs,
                            free_spectrum_psd, powerlaw_psd)
 from .prior_mixin import PriorMixin
-from .priors import Constant, Parameter
-from .terms import BasisTerm, CommonTerm, TermList, WhiteTerm
+from .priors import Constant, Parameter, Uniform
+from .terms import (BasisTerm, CommonTerm, DeterministicTerm, TermList,
+                    WhiteTerm)
 
 _PSD_FNS = {
     "powerlaw": powerlaw_psd,
@@ -108,13 +109,20 @@ def _resolve_params(all_params, fixed_values):
     return sampled, mapping
 
 
-def lower_terms(psr, terms, ecorr_dt=10.0, common_grid=None):
+def lower_terms(psr, terms, ecorr_dt=10.0, common_grid=None,
+                det_out=None):
     """Lower a TermList into white/basis blocks + the stacked basis matrix.
 
     ``common_grid`` — optional ``(t0, Tspan)`` pair: when given, CommonTerms
     are lowered on this *shared* PTA-wide Fourier grid (the joint-likelihood
     case, matching Enterprise's common-Tspan FourierBasisCommonGP); when
     None they fall back to the pulsar's own span (single-pulsar analysis).
+
+    ``det_out`` — optional list collecting :class:`DeterministicTerm`
+    specs (sampled-coefficient delays, e.g. ``bayes_ephem: sampled``).
+    Callers that cannot subtract parametrized delays (joint PTA, OS,
+    reconstruction) leave it None and get a clear error instead of a
+    silently-dropped term.
 
     Returns ``(white_blocks, basis_blocks, T_all)`` where basis blocks of
     spatially-correlated common terms carry ``orf`` set.
@@ -162,6 +170,14 @@ def lower_terms(psr, terms, ecorr_dt=10.0, common_grid=None):
                 col_slice=slice(col_cursor, col_cursor + F.shape[1]),
                 orf=t.orf))
             col_cursor += F.shape[1]
+        elif isinstance(t, DeterministicTerm):
+            if det_out is None:
+                raise NotImplementedError(
+                    f"deterministic term '{t.name}' (sampled "
+                    "coefficients) is supported in single-pulsar "
+                    "likelihood builds only; use the marginalized "
+                    "variant here")
+            det_out.append(t)
         elif isinstance(t, BasisTerm):
             F = t.F
             if t.row_scale is not None:
@@ -289,12 +305,25 @@ def eval_phi_T(theta, bb_static, T_w_j, cs2_j):
 
 def build_pulsar_likelihood(psr, terms, fixed_values=None,
                             gram_mode="split", ecorr_dt=10.0,
-                            mesh=None, toa_axis="toa"):
+                            mesh=None, toa_axis="toa",
+                            tm="marginalized", tm_range=10.0):
     """Compile a TermList for one pulsar into a :class:`PulsarLikelihood`.
 
     ``fixed_values`` maps parameter names to values for Constant-prior
     parameters (the reference's PAL2-noisefile fixing,
     ``enterprise_warp.py:504-508``).
+
+    ``tm`` — timing-model treatment. ``'marginalized'`` (default): the
+    design matrix is integrated out analytically in the improper-prior
+    limit. ``'sampled'``: one sampled offset per design-matrix column
+    (the reference capability surfaced through the per-element prior
+    expansion at ``bilby_warp.py:85-91`` — ``tmparams`` re-packed into
+    the Enterprise dict at ``bilby_warp.py:24-33``); the TM delay
+    ``M @ dp`` is subtracted from the residuals inside the kernel and the
+    analytic Schur stage is skipped. Offsets are in units of the whitened,
+    unit-normalized design columns (the same conditioning-driven scaling
+    the reference's libstempo/Enterprise path applies to its ``normed``
+    design matrix), with ``Uniform(-tm_range, tm_range)`` priors.
 
     ``mesh`` — optional ``jax.sharding.Mesh`` with axis ``toa_axis``: the
     whitened row arrays (``r_w``/``M_w``/``T_w``, white-noise selection
@@ -308,13 +337,47 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     ntoa = len(psr)
     sigma = psr.toaerrs
 
+    det_terms = []
     white_blocks, basis_blocks, T_all = lower_terms(psr, terms,
-                                                    ecorr_dt=ecorr_dt)
+                                                    ecorr_dt=ecorr_dt,
+                                                    det_out=det_terms)
     r_w, M_w, T_w, col_scale2, _ = whiten_inputs(
         psr.residuals, sigma, psr.Mmat, T_all)
 
     sampled, mapping = _resolve_params(
         collect_params(white_blocks, basis_blocks), fixed_values)
+
+    det_refs = None
+    D_all = None
+    if det_terms:
+        # whitened PHYSICAL delay columns (rows / sigma, no column
+        # normalization — the sampled coefficients carry physical priors)
+        D_all = np.concatenate(
+            [np.asarray(t.D, dtype=np.float64) for t in det_terms],
+            axis=1) / np.asarray(sigma, dtype=np.float64)[:, None]
+        det_params = [p for t in det_terms for p in t.params]
+        det_refs = []
+        for p in det_params:
+            if p.name not in mapping:
+                mapping[p.name] = ("theta", len(sampled))
+                sampled.append(p)
+            det_refs.append(mapping[p.name])
+
+    tm_refs = None
+    if tm == "sampled":
+        # one sampled offset per TM design column, appended after the
+        # noise parameters (pars.txt order: noise then tmparams)
+        ntm_cols = psr.Mmat.shape[1]
+        tm_refs = []
+        for i in range(ntm_cols):
+            p = Parameter(f"{psr.name}_tmparams_{i}",
+                          Uniform(-float(tm_range), float(tm_range)))
+            mapping[p.name] = ("theta", len(sampled))
+            tm_refs.append(("theta", len(sampled)))
+            sampled.append(p)
+    elif tm != "marginalized":
+        raise ValueError(f"unknown tm mode '{tm}' "
+                         "(use 'marginalized' or 'sampled')")
 
     # --- TOA-axis padding/sharding over the mesh -----------------------
     from ..ops.kernel import _CHUNK
@@ -332,6 +395,8 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
         M_w = np.pad(M_w, pad_rows)
         T_w = np.pad(T_w, pad_rows)
         sigma = np.pad(sigma, (0, n_pad), constant_values=1.0)
+        if D_all is not None:
+            D_all = np.pad(D_all, pad_rows)
 
     # --- static device arrays ------------------------------------------
     sigma2_j = jnp.asarray(sigma ** 2)
@@ -359,11 +424,42 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
              refs)
             for kind, mm, refs in wb_static]
 
+    D_all_j = None if D_all is None else jnp.asarray(D_all)
+    if D_all_j is not None and mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        D_all_j = jax.device_put(
+            D_all_j, NamedSharding(mesh, PartitionSpec(toa_axis, None)))
+
+    # Gram-as-matmul fast path (see ops.kernel.build_pair_program):
+    # eligible when nothing walker-dependent touches the basis or the
+    # residuals — no sampled TM, no deterministic delays, no sampled
+    # chromatic index — and the TOA axis is unsharded (the per-walker
+    # path handles the sharded Gram psum)
+    import os as _os
+    pair_prog = None
+    if (gram_mode == "split" and mesh is None and tm != "sampled"
+            and not det_terms
+            and all(bb["dyn"] is None for bb in bb_static)
+            and _os.environ.get("EWT_PAIR_PROGRAM", "1") != "0"):
+        from ..ops.kernel import build_pair_program
+        pair_prog = build_pair_program(r_w, M_w, T_w)
+
     def loglike(theta):
         nw = eval_nw(theta, wb_static, ntoa_tot, sigma2_j)
         phi, T_mat = eval_phi_T(theta, bb_static, T_w_j, cs2_j)
-        lnl = marginalized_loglike(nw, phi, r_w_j, M_w_j, T_mat,
-                                   mask=mask_j, gram_mode=gram_mode)
+        r_eff = r_w_j
+        if det_refs is not None:
+            c = jnp.stack([param_value(theta, rf) for rf in det_refs])
+            r_eff = r_eff - D_all_j @ c
+        if tm_refs is None:
+            lnl = marginalized_loglike(nw, phi, r_eff, M_w_j, T_mat,
+                                       mask=mask_j, gram_mode=gram_mode,
+                                       pair_program=pair_prog)
+        else:
+            dp = jnp.stack([param_value(theta, rf) for rf in tm_refs])
+            r_eff = r_eff - M_w_j @ dp
+            lnl = marginalized_loglike(nw, phi, r_eff, None, T_mat,
+                                       mask=mask_j, gram_mode=gram_mode)
         # a numerically non-PD Sigma (extreme prior corners) yields NaN;
         # the reference stack maps Cholesky failure to -inf likewise
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)

@@ -32,7 +32,7 @@ from ..ops.spectra import df_from_freqs
 from ..ops.fourier import log_freq_ratio
 from .priors import (Uniform, LinearExp, Constant, Parameter,
                      interpret_white_noise_prior)
-from .terms import WhiteTerm, BasisTerm, CommonTerm
+from .terms import WhiteTerm, BasisTerm, CommonTerm, DeterministicTerm
 
 _SELECTION_FLAGS = {
     "by_backend": None,        # psr.backend_flags ('-f' convention)
@@ -334,36 +334,40 @@ class StandardModels:
         return out
 
     # -------------------- deterministic systematics -------------------- #
-    def bayes_ephem(self, option="default"):
-        """Solar-system-ephemeris error model (reference
-        ``enterprise_models.py:427-432``).
+    def _ephem_columns(self):
+        """Physical ephemeris-derivative columns + their prior specs.
 
-        Basis columns are analytic derivatives of the Roemer delay w.r.t.
-        frame rotation (3), giant-planet masses (4) and Jupiter orbital
-        perturbations (6); coefficients are marginalized under
-        (Gaussianized) physical priors rather than sampled.
+        Columns are analytic derivatives of the Roemer delay w.r.t. frame
+        rotation (3), giant-planet masses (4) and Jupiter orbital
+        perturbations (6). Returns ``(F, specs)`` with specs
+        ``(name, kind, a, b)``: ``('u', lo, hi)`` uniform or
+        ``('n', 0, sigma)`` normal — the reference's physical priors
+        (``jup_orb_elements`` U(-0.05, 0.05) at ``bilby_warp.py:80-84``;
+        mass sigmas from the IAU mass-measurement uncertainties).
         """
         psr = self.psr
         mjd = psr.toas / const.day
         earth = bary.earth_ssb_position(mjd)          # (n, 3) AU
         n_hat = np.asarray(psr.pos)
 
-        cols, sig2 = [], []
+        cols, specs = [], []
         # frame rotation about each equatorial axis: delta r = omega x r,
         # linear drift amplitude prior ~ uniform(+-1e-9) rad/yr
         t_yr = (mjd - mjd.mean()) * const.day / const.yr
-        for ax in np.eye(3):
+        for i, ax in enumerate(np.eye(3)):
             dr = np.cross(ax, earth) * t_yr[:, None]
             cols.append(dr @ n_hat * const.AU_light_s)
-            sig2.append((2e-9) ** 2 / 12.0 * 4)       # var of U(-1e-9,1e-9)
+            specs.append((f"frame_drift_{'xyz'[i]}", "u", -1e-9, 1e-9))
         # giant planet mass perturbations: delta(Sun barycenter offset)
         mass_sigma = {0: 1.55e-11, 1: 8.17e-12, 2: 5.8e-11, 3: 7.9e-11}
+        mass_name = ("jupiter", "saturn", "uranus", "neptune")
         t_cy = (mjd - const.MJD_J2000) / 36525.0
         for k, elem in enumerate(bary._GIANTS):
             px, py, pz = bary._planet_helio_eq(elem, t_cy)
             planet = np.stack([px, py, pz], axis=-1)
             cols.append(-(planet @ n_hat) * const.AU_light_s)
-            sig2.append(mass_sigma[k] ** 2)
+            specs.append((f"d_{mass_name[k]}_mass", "n", 0.0,
+                          mass_sigma[k]))
         # Jupiter orbital element perturbations: numerical partials of the
         # Jupiter-induced Sun offset w.r.t. its six Kepler elements
         jup = bary._GIANTS[0]
@@ -376,9 +380,40 @@ class StandardModels:
             d = (np.stack([px1 - px0, py1 - py0, pz1 - pz0], axis=-1)
                  / eps / jup[-1])
             cols.append(-(d @ n_hat) * const.AU_light_s)
-            sig2.append(0.05 ** 2 / 3.0)              # ~U(-0.05, 0.05)
-        F = np.stack(cols, axis=1)
-        # normalize columns; fold scale into the prior variances
+            specs.append((f"jup_orb_elements_{j}", "u", -0.05, 0.05))
+        return np.stack(cols, axis=1), specs
+
+    def bayes_ephem(self, option="default"):
+        """Solar-system-ephemeris error model (reference
+        ``enterprise_models.py:427-432``).
+
+        ``option='default'``: coefficients are marginalized analytically
+        under Gaussianized physical priors (TPU-fast; no extra sampled
+        dimensions). ``option='sampled'``: coefficients are SAMPLED with
+        the exact physical priors — hard-bounded uniforms for the frame
+        drift and ``jup_orb_elements`` (U(-0.05, 0.05) per element,
+        reference expansion ``bilby_warp.py:80-84``), normals for the
+        giant-planet masses — recovering ephemeris-parameter posteriors
+        at the cost of 13 extra dimensions.
+        """
+        F, specs = self._ephem_columns()
+        if option == "sampled":
+            from .priors import Normal as _Normal
+            params = [Parameter(n, Uniform(a, b) if kind == "u"
+                                else _Normal(a, b))
+                      for n, kind, a, b in specs]
+            return DeterministicTerm("bayes_ephem", F, params)
+        # marginalized: normalize columns; fold scale into the
+        # Gaussianized prior variances (frame-drift uniforms widened 4x
+        # for conservatism; jup elements at the exact uniform variance)
+        sig2 = []
+        for name, kind, a, b in specs:
+            if kind == "n":
+                sig2.append(b ** 2)
+            elif name.startswith("frame_drift"):
+                sig2.append((b - a) ** 2 / 12.0 * 4)
+            else:
+                sig2.append((b - a) ** 2 / 12.0)
         norms = np.linalg.norm(F, axis=0)
         norms = np.where(norms > 0, norms, 1.0)
         return BasisTerm("bayes_ephem", F / norms,

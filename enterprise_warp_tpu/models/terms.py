@@ -56,6 +56,20 @@ class BasisTerm:
 
 
 @dataclass
+class DeterministicTerm:
+    """A parametrized deterministic delay ``D @ c`` with sampled
+    coefficients (no marginalization): the sampled BayesEphem variant —
+    the reference samples ``jup_orb_elements``/frame/mass parameters
+    through the vector-prior expansion at ``bilby_warp.py:80-84``.
+    ``D`` holds PHYSICAL (unnormalized) columns so the priors keep their
+    physical meaning; rows are whitened at build time. The delay is
+    subtracted from the residuals inside the kernel."""
+    name: str
+    D: np.ndarray                  # (ntoa, k) physical columns
+    params: list                   # [Parameter] aligned with columns
+
+
+@dataclass
 class CommonTerm:
     """A spatially-correlated common signal (GWB / CPL).
 

@@ -115,6 +115,94 @@ def _gram_pair(S, B, mode):
             + _chunked_f32_gram(Sl, Bh))
 
 
+def build_pair_program(r_w, M_w, T_w):
+    """Static pair-product matrix for the Gram-as-matmul fast path.
+
+    Every Gram entry the kernel needs is LINEAR in the per-walker weight
+    vector ``w = 1/nw``:
+
+        G[k,l] = sum_i w_i T_ik T_il,   H, P, X, q, rwr  likewise
+
+    over the stacked columns ``S = [T_w | M_w | r_w]`` (ntoa, m). So the
+    whole batched Gram stage collapses into ONE ``(batch, ntoa) @
+    (ntoa, m^2)`` matmul against the static products
+    ``Q[i, a*m+b] = S_ia S_ib`` — a single large MXU-shaped contraction
+    instead of ``batch`` separate (ntoa, m) Grams, and no per-walker
+    ``Ts = T_w * sqrt(w)`` intermediates (the dominant HBM traffic of
+    the per-walker path: batch x ntoa x m hi/lo copies per call).
+
+    Accuracy matches split mode: ``w`` and ``Q`` are hi/lo double-float
+    split, the three cross products run f32 on the MXU, and per-chunk
+    partials accumulate in f64 (same _CHUNK blocking as
+    ``_chunked_f32_gram``).
+
+    Only valid when the basis is static per walker — the caller must NOT
+    use it with sampled-TM / deterministic-delay residuals (r changes
+    per walker) or a sampled chromatic index (T rows change per walker).
+
+    Precision layout mirrors the per-walker split path exactly: the big
+    (T, T) block runs split-f32 on the MXU (Sigma tolerates it — the
+    mixed solve refines against the computed Sigma), while every product
+    touching ``M`` or ``r`` stays GENUINE f64 (they feed
+    ``A = P - H^T Sigma^-1 H``, whose cancellation amplifies Gram error
+    by up to ~1e8 — see the split-path comment in
+    :func:`marginalized_loglike`).
+
+    Returns a dict of device-ready constants for
+    :func:`pair_program_grams`.
+    """
+    T = np.asarray(T_w, np.float64)
+    U = np.concatenate([np.asarray(M_w, np.float64),
+                        np.asarray(r_w, np.float64)[:, None]], axis=1)
+    ntoa, nb = T.shape
+    nu = U.shape[1]
+    # (T,T) pairs: chunked hi/lo for the split MXU matmul
+    Qtt = (T[:, :, None] * T[:, None, :]).reshape(ntoa, nb * nb)
+    n_pad = (-ntoa) % _CHUNK
+    if n_pad:
+        Qtt = np.pad(Qtt, ((0, n_pad), (0, 0)))
+    nc = Qtt.shape[0] // _CHUNK
+    Qtt = Qtt.reshape(nc, _CHUNK, nb * nb)
+    Qtt_h = Qtt.astype(np.float32)
+    Qtt_l = (Qtt - Qtt_h.astype(np.float64)).astype(np.float32)
+    # (T,U) and (U,U) pairs: f64 (skinny — nu = ntm+1 columns)
+    Qtu = (T[:, :, None] * U[:, None, :]).reshape(ntoa, nb * nu)
+    Quu = (U[:, :, None] * U[:, None, :]).reshape(ntoa, nu * nu)
+    return dict(Qtt_h=jnp.asarray(Qtt_h), Qtt_l=jnp.asarray(Qtt_l),
+                Qtu=jnp.asarray(Qtu), Quu=jnp.asarray(Quu),
+                nb=nb, ntm=nu - 1, nu=nu, ntoa=ntoa, n_pad=n_pad)
+
+
+def pair_program_grams(w, prog):
+    """All Gram blocks at weight vector ``w`` (f64, ntoa) via the pair
+    program: returns ``(G, H, P, X, q, rwr)`` with the same values and
+    precision classes as the per-walker split-mode Grams.
+
+    Every size is derived from ARRAY SHAPES (static under jit tracing);
+    the int entries of ``prog`` would be tracers when the program dict
+    is passed as a jitted-function argument."""
+    nc = prog["Qtt_h"].shape[0]
+    nu = int(round(prog["Quu"].shape[1] ** 0.5))
+    nb = prog["Qtu"].shape[1] // nu
+    ntm = nu - 1
+    wp = _pad_to_chunk(w, nc * _CHUNK - w.shape[0])
+    wc = wp.reshape(nc, _CHUNK)
+    wh = wc.astype(jnp.float32)
+    wl = (wc - wh.astype(w.dtype)).astype(jnp.float32)
+    parts = (
+        jnp.einsum("ci,cik->ck", wh, prog["Qtt_h"], precision=_HIGH)
+        + jnp.einsum("ci,cik->ck", wh, prog["Qtt_l"], precision=_HIGH)
+        + jnp.einsum("ci,cik->ck", wl, prog["Qtt_h"], precision=_HIGH))
+    G = jnp.sum(parts.astype(jnp.float64), axis=0).reshape(nb, nb)
+    # genuine-f64 skinny side: broadcast-multiply + sum fuses into one
+    # reduction (no per-walker basis materialization)
+    HX = jnp.sum(w[:, None] * prog["Qtu"], axis=0).reshape(nb, nu)
+    Pq = jnp.sum(w[:, None] * prog["Quu"], axis=0).reshape(nu, nu)
+    H, X = HX[:, :ntm], HX[:, ntm]
+    P, q, rwr = Pq[:ntm, :ntm], Pq[:ntm, ntm], Pq[ntm, ntm]
+    return G, H, P, X, q, rwr
+
+
 def _chunked_f32_gram(x, y):
     """x^T y of two f32 (row-padded) matrices on the MXU, with per-chunk
     partials accumulated in f64. The building block of split mode; also
@@ -253,8 +341,8 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
 
     def psolve(R):
-        x = Linv @ R.astype(jnp.float32)
-        return (Linv.T @ x).astype(f64)
+        x = jnp.matmul(Linv, R.astype(jnp.float32), precision=_HIGH)
+        return jnp.matmul(Linv.T, x, precision=_HIGH).astype(f64)
 
     # f64 matmuls lower ~7x faster on TPU as broadcast-multiply +
     # tree-sum than as emulated-f64 dots (same accuracy: genuine f64
@@ -307,8 +395,10 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     else:
         LLt = mm64(L.astype(f64), L.astype(f64).T)
     Delta = (Sn - LLt).astype(jnp.float32)
-    K = Linv @ Delta
-    E = (Linv @ K.T).astype(f64)
+    # full f32 precision: default matmul would lower these to bf16
+    # passes, and the Delta products feed the logdet trace correction
+    K = jnp.matmul(Linv, Delta, precision=_HIGH)
+    E = jnp.matmul(Linv, K.T, precision=_HIGH).astype(f64)
     E32 = E.astype(jnp.float32)
     E2 = E32 @ E32
     corr = (jnp.trace(E) - jnp.sum(E * E.T) / 2.0
@@ -323,7 +413,8 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
 
 
 @partial(jax.jit, static_argnames=("gram_mode",))
-def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split"):
+def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
+                         pair_program=None):
     """Marginalized GP log-likelihood for one pulsar at one parameter point.
 
     Parameters
@@ -348,35 +439,53 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split"):
         w = w * mask
     sqw = jnp.sqrt(w)
 
-    # row-scale by sqrt(w) once; every Gram then needs no weight insertion
-    Ts = T_w * sqw[:, None]
-    Ms = M_w * sqw[:, None]
-    rs = r_w * sqw
-
-    # G is the FLOPs hog — O(ntoa * nbasis^2) — and tolerates split-f32
-    # (error ~1e-4 in lnL at ntoa=1e3). The M-side products feed
-    # A = P - H^T Sigma^-1 H, a small difference of large matrices whose
-    # cancellation amplifies Gram error by up to ~1e8 when the noise
-    # covariance nearly contains the timing-model directions (strong red
-    # noise vs polynomial columns), so they stay genuine f64. They are
-    # O(ntm) skinny; on TPU a broadcast-multiply + tree-sum reduction
-    # lowers ~7x faster than the emulated-f64 dot (8 vs 59 ms on the
-    # flagship batch) at the same accuracy, so the split path fuses them
-    # as [H|X] = Ts^T [Ms|rs] and [[P,q],[q^T,rwr]] = [Ms|rs]^T [Ms|rs].
-    ntm = M_w.shape[1]
-    G = _gram_pair(Ts, Ts, gram_mode)
-    if gram_mode == "split":
-        U = jnp.concatenate([Ms, rs[:, None]], axis=1)
-        HX = jnp.sum(Ts[:, :, None] * U[:, None, :], axis=0)
-        Pq = jnp.sum(U[:, :, None] * U[:, None, :], axis=0)
-        H, X = HX[:, :ntm], HX[:, ntm]
-        P, q, rwr = Pq[:ntm, :ntm], Pq[:ntm, ntm], Pq[ntm, ntm]
+    ntm = 0 if M_w is None else M_w.shape[1]
+    if pair_program is not None:
+        # Gram-as-matmul fast path: every Gram entry is linear in w, so
+        # the batched Gram stage is one (batch, ntoa) x (ntoa, nb^2)
+        # MXU matmul against static pair products — see
+        # build_pair_program for the precision layout (split (T,T),
+        # genuine-f64 M/r side).
+        G, H, P, X, q, rwr = pair_program_grams(w, pair_program)
     else:
-        H = _gram_pair(Ts, Ms, gram_mode)
-        P = _gram_pair(Ms, Ms, gram_mode)
-        X = _gram_pair(Ts, rs[:, None], gram_mode)[:, 0]
-        q = _gram_pair(Ms, rs[:, None], gram_mode)[:, 0]
-        rwr = jnp.sum(rs * rs)
+        # row-scale by sqrt(w) once; every Gram then needs no weight
+        # insertion (M_w=None: sampled-TM likelihood — the TM delay was
+        # subtracted from r_w by the caller and the analytic Schur stage
+        # is skipped)
+        Ts = T_w * sqw[:, None]
+        Ms = None if M_w is None else M_w * sqw[:, None]
+        rs = r_w * sqw
+
+        # G is the FLOPs hog — O(ntoa * nbasis^2) — and tolerates
+        # split-f32 (error ~1e-4 in lnL at ntoa=1e3). The M-side
+        # products feed A = P - H^T Sigma^-1 H, a small difference of
+        # large matrices whose cancellation amplifies Gram error by up
+        # to ~1e8 when the noise covariance nearly contains the
+        # timing-model directions (strong red noise vs polynomial
+        # columns), so they stay genuine f64. They are O(ntm) skinny;
+        # on TPU a broadcast-multiply + tree-sum reduction lowers ~7x
+        # faster than the emulated-f64 dot (8 vs 59 ms on the flagship
+        # batch) at the same accuracy, so the split path fuses them as
+        # [H|X] = Ts^T [Ms|rs] and [[P,q],[q^T,rwr]] = [Ms|rs]^T [Ms|rs].
+        G = _gram_pair(Ts, Ts, gram_mode)
+        if gram_mode == "split":
+            U = (rs[:, None] if Ms is None
+                 else jnp.concatenate([Ms, rs[:, None]], axis=1))
+            HX = jnp.sum(Ts[:, :, None] * U[:, None, :], axis=0)
+            Pq = jnp.sum(U[:, :, None] * U[:, None, :], axis=0)
+            H, X = HX[:, :ntm], HX[:, ntm]
+            P, q, rwr = Pq[:ntm, :ntm], Pq[:ntm, ntm], Pq[ntm, ntm]
+        else:
+            X = _gram_pair(Ts, rs[:, None], gram_mode)[:, 0]
+            rwr = jnp.sum(rs * rs)
+            if Ms is None:
+                H = jnp.zeros((Ts.shape[1], 0), dtype=f64)
+                P = jnp.zeros((0, 0), dtype=f64)
+                q = jnp.zeros((0,), dtype=f64)
+            else:
+                H = _gram_pair(Ts, Ms, gram_mode)
+                P = _gram_pair(Ms, Ms, gram_mode)
+                q = _gram_pair(Ms, rs[:, None], gram_mode)[:, 0]
 
     G = G.astype(f64)
     H = H.astype(f64)
@@ -386,6 +495,22 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split"):
     b = b.astype(f64)
 
     Sigma = G + jnp.diag(1.0 / b)
+    if M_w is None:
+        # no-TM path: C_n-only quadratic form and determinant
+        if gram_mode == "f64":
+            L, sS, logdet_sigma = equilibrated_cholesky(Sigma, 0.0)
+            u = jax.scipy.linalg.solve_triangular(L, sS * X, lower=True)
+            quad = rwr - u @ u
+        else:
+            jitter = CHOL_JITTER[gram_mode]
+            zx, logdet_sigma = _mixed_psd_solve_logdet(
+                Sigma, X[:, None], jitter, refine=3, delta_mode="split")
+            quad = rwr - X @ zx[:, 0]
+        logdet_n = jnp.sum(jnp.log(nw) * (mask if mask is not None
+                                          else 1.0))
+        logdet_b = jnp.sum(jnp.log(b))
+        return -0.5 * (quad + logdet_n + logdet_b + logdet_sigma)
+
     if gram_mode == "f64":
         # oracle-grade pure-f64 path (CPU tests / reference comparisons)
         L, sS, logdet_sigma = equilibrated_cholesky(Sigma, 0.0)

@@ -151,3 +151,38 @@ class TestEndToEnd:
         assert likes[0].ndim == 4
         # model 1 (examp_2): spin turnover adds fc -> 5
         assert likes[1].ndim == 5
+
+
+class TestSampledTM:
+    def test_tm_sampled_paramfile_end_to_end(self, in_tmp, tmp_path):
+        """``tm: sampled`` expands per-column tmparams (the reference
+        expansion at ``bilby_warp.py:85-91``) through the full
+        paramfile -> likelihood path."""
+        src = open(f"{PARAMS}/default_model_dynesty.dat").read()
+        src = src.replace("datadir: data/", f"datadir: {EXAMPLES}/data/")
+        src = src.replace("noise_model_file: ",
+                          f"noise_model_file: {EXAMPLES}/")
+        pf = tmp_path / "tm_sampled.dat"
+        pf.write_text(src.replace("{0}", "tm: sampled\n{0}"))
+        p = Params(str(pf), opts=make_opts(num=0))
+        likes = init_model_likelihoods(p)
+        like = likes[0]
+        ntm = p.psrs[0].Mmat.shape[1]
+        assert like.ndim == 12 + ntm
+        assert sum("tmparams" in n for n in like.param_names) == ntm
+        import jax.numpy as jnp
+        th = np.concatenate([
+            [1.0, 1.1, 0.9, 1.2, -7.0, -6.5, -7.5, -6.8,
+             -13.5, 3.0, -13.0, 2.5], np.zeros(ntm)])
+        assert np.isfinite(float(like.loglike(jnp.asarray(th))))
+
+    def test_tm_ridge_regression_still_rejected(self, in_tmp, tmp_path):
+        src = open(f"{PARAMS}/default_model_dynesty.dat").read()
+        src = src.replace("datadir: data/", f"datadir: {EXAMPLES}/data/")
+        src = src.replace("noise_model_file: ",
+                          f"noise_model_file: {EXAMPLES}/")
+        pf = tmp_path / "tm_ridge.dat"
+        pf.write_text(src.replace("{0}", "tm: ridge_regression\n{0}"))
+        p = Params(str(pf), opts=make_opts(num=0))
+        with pytest.raises(NotImplementedError):
+            init_model_likelihoods(p)

@@ -195,3 +195,148 @@ class TestVocabulary:
             j1832, TermList(j1832, [m.efac("by_group"), term]))
         assert "J1832-0836_my_powerlaw_log10_A" in like.param_names
         assert "my_lgA:" in m.get_label_attr_map()
+
+
+class TestSampledTimingModel:
+    """``tm: sampled`` — per-column TM offsets (the reference capability
+    surfaced through the prior expansion at ``bilby_warp.py:85-91`` and
+    the dict re-packing at ``bilby_warp.py:24-33``)."""
+
+    def _likes(self, fake_psr):
+        m = StandardModels(psr=fake_psr)
+        terms = TermList(fake_psr, [m.efac("by_backend"),
+                                    m.spin_noise("powerlaw")])
+        lm = build_pulsar_likelihood(fake_psr, terms, gram_mode="f64")
+        ls = build_pulsar_likelihood(fake_psr, terms, gram_mode="f64",
+                                     tm="sampled")
+        return lm, ls
+
+    def test_param_expansion(self, fake_psr):
+        lm, ls = self._likes(fake_psr)
+        ntm = fake_psr.Mmat.shape[1]
+        assert ls.ndim == lm.ndim + ntm
+        tm_names = [n for n in ls.param_names if "tmparams" in n]
+        assert tm_names == [f"{fake_psr.name}_tmparams_{i}"
+                            for i in range(ntm)]
+        # noise first, tmparams appended (pars.txt order)
+        assert ls.param_names[:lm.ndim] == lm.param_names
+
+    def test_marginalized_equals_laplace_of_sampled(self, fake_psr):
+        """The analytic TM marginalization must equal the (exact, since
+        the sampled likelihood is quadratic in dp) Gaussian integral of
+        the sampled likelihood over the offsets, up to one
+        theta-independent constant."""
+        import jax
+        lm, ls = self._likes(fake_psr)
+        ntm = fake_psr.Mmat.shape[1]
+        rng = np.random.default_rng(11)
+
+        def integrated(theta_noise):
+            th0 = np.concatenate([theta_noise, np.zeros(ntm)])
+            fn = lambda dp: ls.loglike(  # noqa: E731
+                jnp.concatenate([jnp.asarray(theta_noise), dp]))
+            g = jax.grad(fn)(jnp.zeros(ntm))
+            H = jax.hessian(fn)(jnp.zeros(ntm))
+            dp_hat = -np.linalg.solve(np.asarray(H), np.asarray(g))
+            lmax = float(ls.loglike(jnp.concatenate(
+                [jnp.asarray(theta_noise), jnp.asarray(dp_hat)])))
+            sign, logdet = np.linalg.slogdet(-np.asarray(H))
+            assert sign > 0
+            return lmax + 0.5 * ntm * np.log(2 * np.pi) - 0.5 * logdet
+
+        consts = []
+        for _ in range(4):
+            thn = lm.sample_prior(rng, 1)[0]
+            diff = float(lm.loglike(jnp.asarray(thn))) - integrated(thn)
+            if np.isfinite(diff):
+                consts.append(diff)
+        consts = np.asarray(consts)
+        assert len(consts) >= 3
+        assert np.ptp(consts) < 1e-5, consts
+
+    def test_posterior_curvature_matches_gls(self, fake_psr):
+        """Laplace posterior over the offsets: mean at the GLS solution,
+        covariance (M^T C^-1 M)^-1 — with pure white noise and the GP
+        amplitude pinned tiny, computable in closed form."""
+        import jax
+        m = StandardModels(psr=fake_psr)
+        terms = TermList(fake_psr, [m.efac("by_backend")])
+        ls = build_pulsar_likelihood(fake_psr, terms, gram_mode="f64",
+                                     tm="sampled")
+        nefac = ls.ndim - fake_psr.Mmat.shape[1]
+        th_n = np.ones(nefac)                     # efac = 1
+        fn = lambda dp: ls.loglike(  # noqa: E731
+            jnp.concatenate([jnp.asarray(th_n), dp]))
+        ntm = fake_psr.Mmat.shape[1]
+        g = np.asarray(jax.grad(fn)(jnp.zeros(ntm)))
+        H = np.asarray(jax.hessian(fn)(jnp.zeros(ntm)))
+        dp_hat = -np.linalg.solve(H, g)
+        # closed form in whitened, column-normalized units
+        sigma = fake_psr.toaerrs
+        Mw = fake_psr.Mmat / sigma[:, None]
+        Mw = Mw / np.linalg.norm(Mw, axis=0)
+        rw = fake_psr.residuals / sigma
+        A = Mw.T @ Mw
+        expect = np.linalg.solve(A, Mw.T @ rw)
+        np.testing.assert_allclose(dp_hat, expect, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(-H, A, rtol=1e-6, atol=1e-8)
+
+
+class TestSampledBayesEphem:
+    """``bayes_ephem: sampled`` — physical-prior sampled coefficients
+    (reference expansion ``bilby_warp.py:80-84``: ``jup_orb_elements``
+    U(-0.05, 0.05) per element)."""
+
+    def test_sampled_params_and_priors(self, j1832):
+        from enterprise_warp_tpu.models.priors import Normal
+        m = StandardModels(psr=j1832)
+        term = m.bayes_ephem("sampled")
+        names = [p.name for p in term.params]
+        assert sum("jup_orb_elements" in n for n in names) == 6
+        assert sum(n.startswith("frame_drift") for n in names) == 3
+        assert sum(n.endswith("_mass") for n in names) == 4
+        for p in term.params:
+            if "jup_orb_elements" in p.name:
+                assert isinstance(p.prior, Uniform)
+                assert p.prior.lo == -0.05 and p.prior.hi == 0.05
+            if p.name.endswith("_mass"):
+                assert isinstance(p.prior, Normal)
+
+    def test_zero_coefficients_recover_base_model(self, j1832):
+        m = StandardModels(psr=j1832)
+        base = TermList(j1832, [m.efac("by_group"),
+                                m.spin_noise("powerlaw")])
+        with_eph = TermList(j1832, list(base) + [m.bayes_ephem("sampled")])
+        lb = build_pulsar_likelihood(j1832, base, gram_mode="f64")
+        le = build_pulsar_likelihood(j1832, with_eph, gram_mode="f64")
+        rng = np.random.default_rng(5)
+        thn = lb.sample_prior(rng, 1)[0]
+        th_full = np.concatenate([thn, np.zeros(13)])
+        assert np.isclose(float(lb.loglike(jnp.asarray(thn))),
+                          float(le.loglike(jnp.asarray(th_full))),
+                          rtol=0, atol=1e-8)
+
+    def test_delay_subtraction_matches_manual(self, j1832):
+        """lnL at coefficients c must equal the base likelihood evaluated
+        on residuals with the physical delay D @ c removed."""
+        import copy
+        m = StandardModels(psr=j1832)
+        D, _ = m._ephem_columns()
+        base_terms = [m.efac("by_group"), m.spin_noise("powerlaw")]
+        le = build_pulsar_likelihood(
+            j1832, TermList(j1832, base_terms + [m.bayes_ephem("sampled")]),
+            gram_mode="f64")
+        rng = np.random.default_rng(6)
+        c = rng.uniform(-1, 1, 13) * np.concatenate(
+            [np.full(3, 1e-9), np.full(4, 1e-11), np.full(6, 0.01)])
+        psr2 = copy.copy(j1832)
+        psr2.residuals = j1832.residuals - D @ c
+        m2 = StandardModels(psr=psr2)
+        lb = build_pulsar_likelihood(
+            psr2, TermList(psr2, [m2.efac("by_group"),
+                                  m2.spin_noise("powerlaw")]),
+            gram_mode="f64")
+        thn = lb.sample_prior(rng, 1)[0]
+        v1 = float(le.loglike(jnp.asarray(np.concatenate([thn, c]))))
+        v2 = float(lb.loglike(jnp.asarray(thn)))
+        assert np.isclose(v1, v2, rtol=0, atol=1e-6), (v1, v2)

@@ -46,8 +46,16 @@ def main():
 
     print("device:", jax.devices()[0].platform, "batch:", BATCH)
 
-    # full kernel, current default
-    timeit("full loglike_batch (split)", like.loglike_batch, thetas)
+    # full kernel, current default (pair-program Gram-as-matmul when
+    # eligible) vs the per-walker Gram path
+    timeit("full loglike_batch (default)", like.loglike_batch, thetas)
+    os.environ["EWT_PAIR_PROGRAM"] = "0"
+    try:
+        like_pw = build_pulsar_likelihood(psr, terms)
+    finally:
+        del os.environ["EWT_PAIR_PROGRAM"]
+    timeit("full loglike_batch (per-walker grams)",
+           like_pw.loglike_batch, thetas)
 
     # pieces ------------------------------------------------------------
     T = np.concatenate([b.F if b.row_scale is None
@@ -145,8 +153,17 @@ def main():
         return jax.vmap(lambda Li, Hi: jax.scipy.linalg.solve_triangular(
             Li, Hi, lower=True))(L, H)
 
+    from enterprise_warp_tpu.ops.kernel import (build_pair_program,
+                                                pair_program_grams)
+    prog = build_pair_program(r_w, M_w, T_w)
+
+    @jax.jit
+    def gram_pair_prog(w):
+        return jax.vmap(lambda wi: pair_program_grams(wi, prog))(w)
+
     timeit("gram G split (f32 hi/lo + f64 acc)", gram_split, w)
     timeit("gram G pure f32", gram_f32, w)
+    timeit("gram ALL blocks (pair-program matmul)", gram_pair_prog, w)
     timeit("side grams H,P,X,q f64", sides_f64, w)
     timeit("side grams H,P,X,q split", sides_split, w)
     timeit("cholesky f64 + jitter refactor", chol_f64, G64)

@@ -1,0 +1,175 @@
+"""Roofline analysis of the flagship likelihood kernel (VERDICT r3 #3).
+
+Answers, with numbers instead of adjectives: which resource binds each
+phase of the batched marginalized-likelihood kernel on the attached
+accelerator — MXU FLOPs, HBM bandwidth, or serialized small-op latency —
+and how much headroom remains.
+
+Method: time (a) the full kernel, (b) the Gram stage alone (both the
+per-walker path and the pair-program matmul path), (c) the
+solve/logdet stage alone on precomputed Grams. For each, compare the
+achieved rate against two ceilings computed from an explicit work model:
+
+  t_flops >= useful_flops / PEAK          (compute ceiling)
+  t_bw    >= bytes_moved  / HBM_BW        (bandwidth ceiling)
+
+A phase running near max(t_flops, t_bw) is roofline-bound; a phase far
+above BOTH ceilings is latency/dispatch-bound (many small serialized ops
+— on TPU typically the batched Cholesky's sequential column sweep).
+
+Writes ROOFLINE.json at the repo root and a human-readable summary to
+stdout. Run on the device (the measurement chain does); on CPU it still
+runs but the ceilings are meaningless — the record is flagged.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+
+from enterprise_warp_tpu.models import build_pulsar_likelihood  # noqa: E402
+from enterprise_warp_tpu.ops.kernel import (  # noqa: E402
+    _CHUNK, _mixed_psd_solve_logdet, build_pair_program,
+    pair_program_grams, whiten_inputs)
+
+import __graft_entry__ as g                                 # noqa: E402
+
+BATCH = int(os.environ.get("EWT_ROOFLINE_BATCH", 1024))
+REPS = 10
+
+# nominal single-chip ceilings (v5e-class): dense f32 matmul peak and
+# HBM bandwidth. The conclusions are ratios; 20% spec error does not
+# change which resource binds.
+PEAK_F32 = 49e12
+PEAK_BF16 = 197e12
+HBM_BW = 819e9
+
+
+def timeit(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / REPS
+
+
+def main():
+    platform = jax.devices()[0].platform
+    psr, terms = g._flagship_single_pulsar()
+    like = build_pulsar_likelihood(psr, terms)           # pair-program
+    os.environ["EWT_PAIR_PROGRAM"] = "0"
+    try:
+        like_pw = build_pulsar_likelihood(psr, terms)    # per-walker
+    finally:
+        del os.environ["EWT_PAIR_PROGRAM"]
+
+    rng = np.random.default_rng(1)
+    thetas = like.sample_prior(rng, BATCH)
+
+    T = np.concatenate([b.F if b.row_scale is None
+                        else b.F * b.row_scale[:, None]
+                        for b in terms if hasattr(b, "F")], axis=1)
+    r_w, M_w, T_w, cs2, _ = whiten_inputs(
+        psr.residuals, psr.toaerrs, psr.Mmat, T)
+    ntoa, nb = T_w.shape
+    ntm = M_w.shape[1]
+    nu = ntm + 1
+    ntoa_pad = ntoa + ((-ntoa) % _CHUNK)
+    B = BATCH
+
+    prog = build_pair_program(r_w, M_w, T_w)
+    key = jax.random.PRNGKey(0)
+    w = jnp.exp(0.1 * jax.random.normal(key, (B, ntoa),
+                                        dtype=jnp.float64))
+
+    gram_pp = jax.jit(jax.vmap(lambda wi: pair_program_grams(wi, prog)))
+
+    Gs = gram_pp(w)[0] + 3.0 * jnp.eye(nb, dtype=jnp.float64)
+    RHS = jax.random.normal(key, (B, nb, nu), dtype=jnp.float64)
+    solve = jax.jit(jax.vmap(lambda S, R: _mixed_psd_solve_logdet(
+        S, R, 3e-6, refine=3, delta_mode="split")))
+
+    t_full = timeit(like.loglike_batch, thetas)
+    t_full_pw = timeit(like_pw.loglike_batch, thetas)
+    t_gram = timeit(gram_pp, w)
+    t_solve = timeit(solve, Gs, RHS)
+
+    # ---- work models --------------------------------------------------
+    # Gram (pair program): three f32 (B, ntoa_pad) x (ntoa_pad, nb^2)
+    # matmuls + f64 skinny side (emulated f64 ~ 10x f32 cost-equivalent)
+    gram_flops = 3 * 2.0 * B * ntoa_pad * nb * nb
+    gram_f64_equiv = 10 * 2.0 * B * ntoa * (nb * nu + nu * nu)
+    #   bytes: Qtt hi+lo and Qtu/Quu streamed once (MXU reuse across B),
+    #   w in, all blocks out
+    gram_bytes = (2 * ntoa_pad * nb * nb * 4            # Qtt hi/lo f32
+                  + ntoa * (nb * nu + nu * nu) * 8      # Qtu/Quu f64
+                  + B * ntoa * 8                        # w
+                  + B * (nb * nb + nb * nu + nu * nu) * 8)   # outputs
+    t_gram_flops = (gram_flops + gram_f64_equiv) / PEAK_F32
+    t_gram_bw = gram_bytes / HBM_BW
+
+    # Solve: f32 Cholesky (nb^3/3) + refine=3 passes of (nb^2 * nu)
+    # products (f32 via Linv) + f64 residual corrections (~10x) +
+    # logdet trace correction (nb^3 f32-class)
+    solve_flops = B * (nb ** 3 / 3.0                     # f32 chol
+                       + 2 * nb * nb * nb               # Linv + LLt + E
+                       + 3 * 2 * 2 * nb * nb * nu       # refine passes
+                       + 10 * 3 * 2 * nb * nb * nu)     # f64 residuals
+    solve_bytes = B * (nb * nb * (4 + 4 + 8)            # G f64+f32+L
+                       + nb * nu * 8 * 4)               # RHS + iterates
+    t_solve_flops = solve_flops / PEAK_F32
+    t_solve_bw = solve_bytes / HBM_BW
+
+    def verdict(t, tf, tb):
+        roof = max(tf, tb)
+        if t < 2.0 * roof:
+            which = "flops" if tf > tb else "bandwidth"
+            return which, round(roof / t, 3)
+        return "latency/dispatch", round(roof / t, 3)
+
+    g_which, g_eff = verdict(t_gram, t_gram_flops, t_gram_bw)
+    s_which, s_eff = verdict(t_solve, t_solve_flops, t_solve_bw)
+
+    rec = {
+        "platform": platform,
+        "cpu_record_meaningless": platform == "cpu",
+        "batch": B, "ntoa": ntoa, "nbasis": nb, "ntm": ntm,
+        "full_kernel_ms": round(t_full * 1e3, 3),
+        "full_kernel_perwalker_ms": round(t_full_pw * 1e3, 3),
+        "pair_program_speedup": round(t_full_pw / t_full, 2),
+        "evals_per_s": round(B / t_full, 1),
+        "gram": {
+            "measured_ms": round(t_gram * 1e3, 3),
+            "flops_ceiling_ms": round(t_gram_flops * 1e3, 3),
+            "bandwidth_ceiling_ms": round(t_gram_bw * 1e3, 3),
+            "binding_resource": g_which,
+            "roofline_fraction": g_eff,
+        },
+        "solve": {
+            "measured_ms": round(t_solve * 1e3, 3),
+            "flops_ceiling_ms": round(t_solve_flops * 1e3, 3),
+            "bandwidth_ceiling_ms": round(t_solve_bw * 1e3, 3),
+            "binding_resource": s_which,
+            "roofline_fraction": s_eff,
+        },
+        "residual_ms_outside_gram_plus_solve": round(
+            (t_full - t_gram - t_solve) * 1e3, 3),
+        "ceilings": {"peak_f32_flops": PEAK_F32, "hbm_bw": HBM_BW},
+    }
+    with open(os.path.join(REPO, "ROOFLINE.json"), "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps(rec, indent=1))
+
+
+if __name__ == "__main__":
+    main()
