@@ -18,6 +18,7 @@ from enterprise_warp_tpu.sim import (add_noise, inject_basis_process,
 
 
 class TestRoundTrip:
+    @pytest.mark.slow
     def test_white_and_red_recovery(self, tmp_path):
         psr = make_fake_pulsar(ntoa=300, backends=("RX1", "RX2"),
                                toaerr_us=1.0, seed=11)
@@ -65,6 +66,7 @@ class TestRoundTrip:
 
 
 class TestRunCLI:
+    @pytest.mark.slow
     def test_ptmcmc_run_and_resume(self, tmp_path, monkeypatch):
         monkeypatch.chdir(tmp_path)
         prfile = tmp_path / "run.dat"

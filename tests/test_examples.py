@@ -55,6 +55,7 @@ def test_example_paramfiles_build(prfile, num, nmodels, tmp_path,
         assert np.all(np.isfinite(lnl))
 
 
+@pytest.mark.slow
 def test_fixed_white_noise_example(tmp_path, monkeypatch):
     """efac: -1 + noisefiles fixes the white noise: no efac/equad in the
     sampled parameters, red/DM/system hyperparameters remain."""
@@ -86,6 +87,7 @@ def test_custom_models_example(tmp_path, monkeypatch):
     assert np.isfinite(float(np.asarray(likes[1].loglike_batch(t1))[0]))
 
 
+@pytest.mark.slow
 def test_truth_recovery_on_fake_psr(tmp_path, monkeypatch):
     """Short PT-MCMC on the shipped fake_psr_0 (spin-noise model, num=1)
     recovers the generator's injected red noise within broad bounds

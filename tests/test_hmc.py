@@ -29,6 +29,7 @@ class TestHMC:
                                    chain[:, like.ndim + 1] + lnpri,
                                    atol=1e-6)
 
+    @pytest.mark.slow
     def test_correlated_gaussian_mixing(self, tmp_path):
         # strongly correlated target: gradients should carry chains
         # through the narrow ridge
@@ -79,6 +80,7 @@ class TestHMC:
                 / (2 * h)
             assert g[i] == pytest.approx(fd, rel=2e-4, abs=1e-5)
 
+    @pytest.mark.slow
     def test_pulsar_sampling_and_resume(self, tmp_path, fake_psr):
         import copy
 

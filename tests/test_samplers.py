@@ -191,6 +191,7 @@ class TestConvergence:
             np.testing.assert_allclose(lnpost, lnpri + lnl / T,
                                        atol=1e-6)
 
+    @pytest.mark.slow
     def test_convergence_warm_start(self, tmp_path):
         """A killed convergence run resumes from the outdir: the second
         driver call picks up chain + checkpoint instead of restarting
@@ -246,6 +247,7 @@ class TestNested:
 
 
 class TestNestedResume:
+    @pytest.mark.slow
     def test_kill_and_resume_reproduces_lnz(self, tmp_path):
         like = GaussianLike([0.5, -1.0], [0.4, 0.8])
         # uninterrupted reference run

@@ -2,6 +2,7 @@
 approximate posterior on the marginalized pulsar likelihood."""
 
 import numpy as np
+import pytest
 
 from enterprise_warp_tpu.samplers import fit_advi
 
@@ -19,6 +20,7 @@ def test_gaussian_mean_and_width():
     assert fit["samples"].shape == (4096, 3)
 
 
+@pytest.mark.slow
 def test_advi_warm_start_cuts_burn_in(tmp_path):
     """PTSampler(init_x=ADVI samples) starts walkers at the posterior
     instead of the prior: the very first chain rows already sit near the
@@ -37,6 +39,7 @@ def test_advi_warm_start_cuts_burn_in(tmp_path):
     assert np.all(np.abs(first - [2.0, -3.0]) < 1.5)
 
 
+@pytest.mark.slow
 def test_pulsar_likelihood_advi(fake_psr):
     import copy
 
