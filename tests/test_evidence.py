@@ -59,9 +59,6 @@ def test_nested_lnz_16dim_analytic():
     """Analytic-lnZ benchmark at 16 dims (round-3 verdict: the previous
     evidence checks were toy-scale). Anisotropic Gaussian in a uniform
     box: lnZ = -16 ln(20) exactly."""
-    import sys
-    sys.path.insert(0, str(__import__("pathlib").Path(
-        __file__).resolve().parent))
     from test_samplers import GaussianLike
 
     rng = np.random.default_rng(0)

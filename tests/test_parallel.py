@@ -470,17 +470,14 @@ class TestToaSharding:
         return build_pulsar_likelihood(psr, terms, gram_mode=gram_mode,
                                        mesh=mesh)
 
-    def test_sharded_matches_unsharded(self):
+    def test_sharded_matches_unsharded(self, monkeypatch):
         # isolate SHARDING: the unsharded build would otherwise take the
         # pair-program fast path, whose different (equally valid)
         # summation order adds split-class noise to the comparison
-        import os
         from enterprise_warp_tpu.parallel import make_toa_mesh
-        os.environ["EWT_PAIR_PROGRAM"] = "0"
-        try:
-            base = self._like(None)
-        finally:
-            del os.environ["EWT_PAIR_PROGRAM"]
+        monkeypatch.setenv("EWT_PAIR_PROGRAM", "0")
+        base = self._like(None)
+        monkeypatch.undo()
         sharded = self._like(make_toa_mesh())
         assert sharded.param_names == base.param_names
         rng = np.random.default_rng(0)
@@ -634,5 +631,7 @@ class TestConfig3Scale:
             record.setdefault("corner_lnl", []).append(
                 v if np.isfinite(v) else "-inf")
 
-        with open("/root/repo/CONFIG3_SCALE.json", "w") as fh:
+        import pathlib
+        repo = pathlib.Path(__file__).resolve().parents[1]
+        with open(repo / "CONFIG3_SCALE.json", "w") as fh:
             json.dump(record, fh, indent=1)

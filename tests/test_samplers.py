@@ -221,6 +221,65 @@ class TestConvergence:
         flat = rep2.chains.reshape(-1, like.ndim)
         np.testing.assert_allclose(flat.mean(0), [0.5, -1.0], atol=0.15)
 
+    def test_resume_rewinds_checkpoint_when_chain_short(self, tmp_path):
+        """Dropped/partial chain lines can leave FEWER complete steps on
+        disk than the checkpoint counter. Resume must rewind the
+        checkpoint to the file (the walker state is a valid Markov state
+        at any step label) so the chain-file contract — rows ==
+        steps * nchains — survives (round-3 advisory)."""
+        from enterprise_warp_tpu.samplers.convergence import \
+            sample_to_convergence
+        like = GaussianLike([0.0, 1.0], [0.5, 0.5])
+        s = PTSampler(like, str(tmp_path), ntemps=2, nchains=4, seed=3,
+                      cov_update=500)
+        sample_to_convergence(s, target_ess=1e9, rhat_max=0.0,
+                              check_every=500, max_steps=1000,
+                              verbose=False, resume=True)
+        chain_path = tmp_path / "chain_1.txt"
+        rows = chain_path.read_text().splitlines()
+        assert len(rows) == 1000 * 4
+        # drop the last 6 complete rows (not a multiple of nchains) plus
+        # leave a truncated partial line — a mid-write kill
+        chain_path.write_text("\n".join(rows[:-6] + [rows[-6][:20]])
+                              + "\n")
+        s2 = PTSampler(like, str(tmp_path), ntemps=2, nchains=4, seed=3,
+                       cov_update=500)
+        rep = sample_to_convergence(s2, target_ess=1e9, rhat_max=0.0,
+                                    check_every=500, max_steps=1500,
+                                    verbose=False, resume=True)
+        chain = np.loadtxt(chain_path)
+        assert len(chain) == rep.steps * 4      # contract restored
+        assert np.load(tmp_path / "state.npz")["step"] == rep.steps
+
+    def test_resume_truncates_hot_chains(self, tmp_path):
+        """Hot-rung files are appended in the same blocks as the cold
+        file; a kill between the two appends must not leave them out of
+        sync after resume (round-3 advisory)."""
+        from enterprise_warp_tpu.samplers.convergence import \
+            sample_to_convergence
+        like = GaussianLike([0.0, 1.0], [0.5, 0.5])
+        s = PTSampler(like, str(tmp_path), ntemps=3, nchains=4, seed=4,
+                      write_hot_chains=True)
+        sample_to_convergence(s, target_ess=1e9, rhat_max=0.0,
+                              check_every=400, max_steps=400,
+                              verbose=False, resume=True)
+        hot = sorted(p for p in tmp_path.glob("chain_*.txt")
+                     if p.name != "chain_1.txt")
+        assert len(hot) == 2
+        # simulate extra post-checkpoint hot appends from a killed block
+        with open(hot[0], "a") as fh:
+            for _ in range(8):
+                fh.write(" ".join(["0.1"] * (like.ndim + 4)) + "\n")
+        s2 = PTSampler(like, str(tmp_path), ntemps=3, nchains=4, seed=4,
+                       write_hot_chains=True)
+        rep = sample_to_convergence(s2, target_ess=1e9, rhat_max=0.0,
+                                    check_every=400, max_steps=800,
+                                    verbose=False, resume=True)
+        cold = np.loadtxt(tmp_path / "chain_1.txt")
+        assert len(cold) == rep.steps * 4
+        for hp in hot:
+            assert len(np.loadtxt(hp)) == len(cold)
+
 
 class TestNested:
     def test_evidence_and_posterior(self, tmp_path):
