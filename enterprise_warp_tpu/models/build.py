@@ -443,6 +443,10 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
             and _os.environ.get("EWT_PAIR_PROGRAM", "1") != "0"):
         from ..ops.kernel import build_pair_program
         pair_prog = build_pair_program(r_w, M_w, T_w)
+    # factorization choice is resolved at BUILD time (same convention as
+    # EWT_PAIR_PROGRAM): reading env inside the traced function would be
+    # frozen into the jit cache and silently ignore later toggles
+    use_blocked_chol = _os.environ.get("EWT_BLOCKED_CHOL", "0") == "1"
 
     def loglike(theta):
         nw = eval_nw(theta, wb_static, ntoa_tot, sigma2_j)
@@ -454,12 +458,14 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
         if tm_refs is None:
             lnl = marginalized_loglike(nw, phi, r_eff, M_w_j, T_mat,
                                        mask=mask_j, gram_mode=gram_mode,
-                                       pair_program=pair_prog)
+                                       pair_program=pair_prog,
+                                       blocked_chol=use_blocked_chol)
         else:
             dp = jnp.stack([param_value(theta, rf) for rf in tm_refs])
             r_eff = r_eff - M_w_j @ dp
             lnl = marginalized_loglike(nw, phi, r_eff, None, T_mat,
-                                       mask=mask_j, gram_mode=gram_mode)
+                                       mask=mask_j, gram_mode=gram_mode,
+                                       blocked_chol=use_blocked_chol)
         # a numerically non-PD Sigma (extreme prior corners) yields NaN;
         # the reference stack maps Cholesky failure to -inf likewise
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)

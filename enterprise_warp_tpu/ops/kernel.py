@@ -230,6 +230,51 @@ def _chunked_f32_gram(x, y):
 CHOL_JITTER = {"split": 3.0e-6, "f32": 1.0e-5, "f64": 0.0}
 
 
+def blocked_cholesky(S, block=16):
+    """Left-looking blocked Cholesky with a static block loop.
+
+    XLA lowers ``jnp.linalg.cholesky`` on TPU as a sequential column
+    sweep — n serialized small steps per matrix, a pure latency cost for
+    the batched (walkers, n, n) factorizations of the mixed solve. This
+    variant restructures the factorization into n/block sequential
+    steps, each made of MXU-shaped batched matmuls (panel updates), a
+    small native Cholesky of the diagonal block, and one skinny
+    triangular solve: sequential depth drops ~block-fold at identical
+    FLOPs. NaNs from an indefinite diagonal block propagate into every
+    later panel, so the caller's ``isfinite``-gated jitter retry works
+    unchanged.
+
+    Operates on a SINGLE (n, n) matrix — batch by calling under
+    ``vmap`` (the panel updates then lower to batched MXU matmuls).
+
+    Off by default (``EWT_BLOCKED_CHOL=1`` at likelihood BUILD time
+    enables it in the mixed solve) until the device roofline shows the
+    column sweep binding — ``tools/profile_kernel.py`` times both.
+    """
+    n = S.shape[-1]
+    n_pad = (-n) % block
+    m = n + n_pad
+    if n_pad:
+        S = jnp.pad(S, ((0, n_pad), (0, n_pad)))
+        pad_idx = jnp.arange(n, m)
+        S = S.at[pad_idx, pad_idx].set(1.0)   # unit pivots on padding
+    L = jnp.zeros((m, m), dtype=S.dtype)
+    for k in range(0, m, block):
+        kb = slice(k, k + block)
+        panel = L[kb, :k]
+        Akk = S[kb, kb] - jnp.matmul(panel, panel.T, precision=_HIGH)
+        Lkk = jnp.linalg.cholesky(Akk)
+        L = L.at[kb, kb].set(Lkk)
+        if k + block < m:
+            rb = slice(k + block, m)
+            Ark = S[rb, kb] - jnp.matmul(L[rb, :k], panel.T,
+                                         precision=_HIGH)
+            Lrk = jax.scipy.linalg.solve_triangular(Lkk, Ark.T,
+                                                    lower=True).T
+            L = L.at[rb, kb].set(Lrk)
+    return L[:n, :n]
+
+
 def equilibrated_cholesky(S, jitter):
     """Cholesky of a symmetric PD matrix via unit-diagonal equilibration,
     with an on-failure jitter fallback.
@@ -259,7 +304,7 @@ def equilibrated_cholesky(S, jitter):
 
 
 def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
-                            delta_mode="tree"):
+                            delta_mode="tree", blocked=False):
     """Solve ``S Z = B`` and compute ``log|S|`` for symmetric PD ``S`` in
     mixed precision (TPU-fast: no emulated-f64 factorization).
 
@@ -317,9 +362,10 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
         Sn, jnp.where(null, 1.0, jnp.diagonal(Sn)), inplace=False)
     Sn32 = Sn.astype(jnp.float32)
     eye = jnp.eye(n, dtype=jnp.float32)
-    L = jnp.linalg.cholesky(Sn32 + jnp.float32(jitter) * eye)
+    _chol = blocked_cholesky if blocked else jnp.linalg.cholesky
+    L = _chol(Sn32 + jnp.float32(jitter) * eye)
     bad = ~jnp.all(jnp.isfinite(L))
-    L = jnp.where(bad, jnp.linalg.cholesky(Sn32 + jnp.float32(jitter2) * eye),
+    L = jnp.where(bad, _chol(Sn32 + jnp.float32(jitter2) * eye),
                   L)
     # last-resort Jacobi preconditioner: when the equilibrated cast is so
     # far from PSD that both jittered factorizations fail (numerically
@@ -412,9 +458,9 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     return s[:, None] * Z, logdet
 
 
-@partial(jax.jit, static_argnames=("gram_mode",))
+@partial(jax.jit, static_argnames=("gram_mode", "blocked_chol"))
 def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
-                         pair_program=None):
+                         pair_program=None, blocked_chol=False):
     """Marginalized GP log-likelihood for one pulsar at one parameter point.
 
     Parameters
@@ -504,7 +550,8 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
         else:
             jitter = CHOL_JITTER[gram_mode]
             zx, logdet_sigma = _mixed_psd_solve_logdet(
-                Sigma, X[:, None], jitter, refine=3, delta_mode="split")
+                Sigma, X[:, None], jitter, refine=3, delta_mode="split",
+                blocked=blocked_chol)
             quad = rwr - X @ zx[:, 0]
         logdet_n = jnp.sum(jnp.log(nw) * (mask if mask is not None
                                           else 1.0))
@@ -542,7 +589,7 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
         # cost (CPU: 83 -> 18 ms/16-batch)
         ZXH, logdet_sigma = _mixed_psd_solve_logdet(
             Sigma, jnp.concatenate([X[:, None], H], axis=1), jitter,
-            refine=3, delta_mode="split")
+            refine=3, delta_mode="split", blocked=blocked_chol)
         zx, ZH = ZXH[:, 0], ZXH[:, 1:]
         A = P - H.T @ ZH
         y = q - ZH.T @ X

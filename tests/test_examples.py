@@ -43,6 +43,7 @@ def _build(prfile, num=0, custom=None, tmp=None):
     ("system_noise.dat", 0, 1),
     ("gwb_array.dat", 0, 1),
     ("hmc_single_psr.dat", 1, 1),
+    ("sampled_timing_model.dat", 1, 1),
 ])
 def test_example_paramfiles_build(prfile, num, nmodels, tmp_path,
                                   monkeypatch):

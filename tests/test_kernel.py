@@ -313,3 +313,41 @@ class TestPairProgram:
         th3 = lk3.sample_prior(np.random.default_rng(3), 2)
         assert np.isfinite(
             np.asarray(lk3.loglike_batch(jnp.asarray(th3)))).all()
+
+
+class TestBlockedCholesky:
+    def test_matches_native_cholesky(self):
+        from enterprise_warp_tpu.ops.kernel import blocked_cholesky
+        rng = np.random.default_rng(5)
+        for n in (7, 16, 80, 93):
+            A = rng.standard_normal((n, n + 8))
+            S = (A @ A.T + n * np.eye(n)).astype(np.float32)
+            L = np.asarray(blocked_cholesky(jnp.asarray(S)))
+            Lref = np.linalg.cholesky(S.astype(np.float64))
+            np.testing.assert_allclose(L, Lref, rtol=2e-4, atol=2e-4)
+            assert np.allclose(np.triu(L, 1), 0.0)
+
+    def test_indefinite_propagates_nan(self):
+        from enterprise_warp_tpu.ops.kernel import blocked_cholesky
+        S = jnp.asarray(np.diag([1.0, -1.0] + [1.0] * 30)
+                        .astype(np.float32))
+        L = np.asarray(blocked_cholesky(S))
+        assert np.isnan(L).any()
+
+    def test_mixed_solve_with_blocked_chol(self, monkeypatch):
+        """EWT_BLOCKED_CHOL=1 must reproduce the mixed solve (the
+        refinement targets the computed Sigma, so preconditioner
+        factorization order cannot change the answer class)."""
+        from enterprise_warp_tpu.ops.kernel import _mixed_psd_solve_logdet
+        rng = np.random.default_rng(6)
+        A = rng.standard_normal((80, 120))
+        S = jnp.asarray(A @ A.T + 5.0 * np.eye(80))
+        B = jnp.asarray(rng.standard_normal((80, 3)))
+        Z0, ld0 = _mixed_psd_solve_logdet(S, B, 3e-6, refine=3,
+                                          delta_mode="split")
+        monkeypatch.setenv("EWT_BLOCKED_CHOL", "1")
+        Z1, ld1 = _mixed_psd_solve_logdet(S, B, 3e-6, refine=3,
+                                          delta_mode="split")
+        np.testing.assert_allclose(np.asarray(Z1), np.asarray(Z0),
+                                   rtol=1e-7, atol=1e-9)
+        assert np.isclose(float(ld1), float(ld0), rtol=1e-8, atol=1e-5)

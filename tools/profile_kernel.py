@@ -166,9 +166,17 @@ def main():
     timeit("gram ALL blocks (pair-program matmul)", gram_pair_prog, w)
     timeit("side grams H,P,X,q f64", sides_f64, w)
     timeit("side grams H,P,X,q split", sides_split, w)
+    from enterprise_warp_tpu.ops.kernel import blocked_cholesky
+
+    @jax.jit
+    def chol_f32_blocked(G):
+        Gf = G.astype(jnp.float32)
+        return jax.vmap(lambda S: blocked_cholesky(S))(Gf)
+
     timeit("cholesky f64 + jitter refactor", chol_f64, G64)
     timeit("cholesky f64 single", chol_f64_nojit, G64)
     timeit("cholesky f32 single", chol_f32, G64)
+    timeit("cholesky f32 blocked(16)", chol_f32_blocked, G64)
     timeit("trisolve f64 (nb x nb) vec", trisolve_f64, L64, X)
     timeit("trisolve f32 (nb x nb) vec", trisolve_f32, L64, X)
     timeit("trisolve f64 (nb x nb) x ntm", trisolve_mat_f64, L64, Hb)
