@@ -204,6 +204,37 @@ def lower_terms(psr, terms, ecorr_dt=10.0, common_grid=None,
     return white_blocks, basis_blocks, T_all
 
 
+def lower_det_terms(det_terms, sigma, sampled, mapping):
+    """Lower sampled-coefficient deterministic terms (bayes_ephem:
+    sampled) into shared structures — used by both the likelihood build
+    and the reconstructor so their parameter ordering (pars.txt order)
+    cannot diverge.
+
+    Appends each term's parameters to ``sampled``/``mapping`` in term
+    order and returns ``(D_phys, D_w, det_refs, names, slices)``:
+    physical delay columns (ntoa, k), their whitened rows, theta refs
+    aligned with the columns, per-term names, and per-term column
+    slices. Returns all-None/empty when ``det_terms`` is empty.
+    """
+    if not det_terms:
+        return None, None, None, [], []
+    D_phys = np.concatenate(
+        [np.asarray(t.D, dtype=np.float64) for t in det_terms], axis=1)
+    D_w = D_phys / np.asarray(sigma, dtype=np.float64)[:, None]
+    names, slices, det_refs = [], [], []
+    c0 = 0
+    for t in det_terms:
+        names.append(t.name)
+        slices.append(slice(c0, c0 + t.D.shape[1]))
+        c0 += t.D.shape[1]
+        for p in t.params:
+            if p.name not in mapping:
+                mapping[p.name] = ("theta", len(sampled))
+                sampled.append(p)
+            det_refs.append(mapping[p.name])
+    return D_phys, D_w, det_refs, names, slices
+
+
 def collect_params(white_blocks, basis_blocks):
     """All model parameters in canonical (pars.txt) order."""
     all_params = []
@@ -347,21 +378,10 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     sampled, mapping = _resolve_params(
         collect_params(white_blocks, basis_blocks), fixed_values)
 
-    det_refs = None
-    D_all = None
-    if det_terms:
-        # whitened PHYSICAL delay columns (rows / sigma, no column
-        # normalization — the sampled coefficients carry physical priors)
-        D_all = np.concatenate(
-            [np.asarray(t.D, dtype=np.float64) for t in det_terms],
-            axis=1) / np.asarray(sigma, dtype=np.float64)[:, None]
-        det_params = [p for t in det_terms for p in t.params]
-        det_refs = []
-        for p in det_params:
-            if p.name not in mapping:
-                mapping[p.name] = ("theta", len(sampled))
-                sampled.append(p)
-            det_refs.append(mapping[p.name])
+    # whitened PHYSICAL delay columns (rows / sigma, no column
+    # normalization — the sampled coefficients carry physical priors)
+    _, D_all, det_refs, _, _ = lower_det_terms(det_terms, sigma,
+                                               sampled, mapping)
 
     tm_refs = None
     if tm == "sampled":

@@ -26,7 +26,8 @@ import numpy as np
 
 from .. import constants as const
 from ..models.build import (_resolve_params, basis_static, collect_params,
-                            eval_nw, eval_phi_T, lower_terms, white_static)
+                            eval_nw, eval_phi_T, lower_det_terms,
+                            lower_terms, white_static)
 from ..ops.kernel import equilibrated_cholesky, whiten_inputs
 from ..parallel.pta import _TM_PHI
 
@@ -44,13 +45,21 @@ class NoiseReconstructor:
         ntoa = len(psr)
         sigma = psr.toaerrs
 
+        det_terms = []
         white_blocks, basis_blocks, T_all = lower_terms(
-            psr, terms, ecorr_dt=ecorr_dt)
+            psr, terms, ecorr_dt=ecorr_dt, det_out=det_terms)
         r_w, M_w, T_w, cs2, _ = whiten_inputs(
             psr.residuals, sigma, psr.Mmat, T_all)
 
         self.params, mapping = _resolve_params(
             collect_params(white_blocks, basis_blocks), fixed_values)
+
+        # sampled-coefficient deterministic delays (bayes_ephem: sampled):
+        # the realization is just D @ c, and the GP conditions on the
+        # delay-subtracted residuals. Shared lowering with the likelihood
+        # build keeps the parameter ordering identical to pars.txt.
+        D_phys, D_w, det_refs, det_names, self._det_slices = \
+            lower_det_terms(det_terms, sigma, self.params, mapping)
         self.param_names = [p.name for p in self.params]
         self.block_names = [bb.name for bb in basis_blocks]
         self._slices = [bb.col_slice for bb in basis_blocks]
@@ -66,28 +75,41 @@ class NoiseReconstructor:
         ntm = M_w.shape[1]
         nb = T_w.shape[1]
 
+        from ..models.build import param_value
+        D_w_j = None if D_w is None else jnp.asarray(D_w)
+        D_phys_j = None if D_phys is None else jnp.asarray(D_phys)
+
         def coefficients(theta):
             nw = eval_nw(theta, wb_static, ntoa, sigma2_j)
             phi, T_mat = eval_phi_T(theta, bb_static, T_w_j, cs2_j)
+            r_eff = r_w_j
+            c = None
+            if det_refs is not None:
+                c = jnp.stack([param_value(theta, rf)
+                               for rf in det_refs])
+                r_eff = r_eff - D_w_j @ c
             T_full = jnp.concatenate([T_mat, M_w_j], axis=1)
             b = jnp.concatenate([phi, _TM_PHI * jnp.ones(ntm)])
             w = 1.0 / nw
             Ts = T_full * jnp.sqrt(w)[:, None]
-            rs = r_w_j * jnp.sqrt(w)
+            rs = r_eff * jnp.sqrt(w)
             Sigma = Ts.T @ Ts + jnp.diag(1.0 / b)
             L, s, _ = equilibrated_cholesky(Sigma, 0.0)
             rhs = s * (Ts.T @ rs)
             u = jax.scipy.linalg.solve_triangular(L, rhs, lower=True)
             a_hat = s * jax.scipy.linalg.solve_triangular(
                 L.T, u, lower=False)
-            return a_hat, T_mat
+            return a_hat, T_mat, c
 
         def realize(theta):
-            a_hat, T_mat = coefficients(theta)
+            a_hat, T_mat, c = coefficients(theta)
             out = {}
             for name, sl in zip(self.block_names, self._slices):
                 out[name] = sigma_j * (T_mat[:, sl] @ a_hat[sl])
             out["tm"] = sigma_j * (M_w_j @ a_hat[nb:])
+            if c is not None:
+                for name, sl in zip(det_names, self._det_slices):
+                    out[name] = D_phys_j[:, sl] @ c[sl]
             return out
 
         self._realize = jax.jit(realize)

@@ -334,10 +334,10 @@ class TestBlockedCholesky:
         L = np.asarray(blocked_cholesky(S))
         assert np.isnan(L).any()
 
-    def test_mixed_solve_with_blocked_chol(self, monkeypatch):
-        """EWT_BLOCKED_CHOL=1 must reproduce the mixed solve (the
-        refinement targets the computed Sigma, so preconditioner
-        factorization order cannot change the answer class)."""
+    def test_mixed_solve_with_blocked_chol(self):
+        """blocked=True must reproduce the mixed solve (the refinement
+        targets the computed Sigma, so preconditioner factorization
+        order cannot change the answer class)."""
         from enterprise_warp_tpu.ops.kernel import _mixed_psd_solve_logdet
         rng = np.random.default_rng(6)
         A = rng.standard_normal((80, 120))
@@ -345,9 +345,31 @@ class TestBlockedCholesky:
         B = jnp.asarray(rng.standard_normal((80, 3)))
         Z0, ld0 = _mixed_psd_solve_logdet(S, B, 3e-6, refine=3,
                                           delta_mode="split")
-        monkeypatch.setenv("EWT_BLOCKED_CHOL", "1")
         Z1, ld1 = _mixed_psd_solve_logdet(S, B, 3e-6, refine=3,
-                                          delta_mode="split")
+                                          delta_mode="split",
+                                          blocked=True)
         np.testing.assert_allclose(np.asarray(Z1), np.asarray(Z0),
                                    rtol=1e-7, atol=1e-9)
         assert np.isclose(float(ld1), float(ld0), rtol=1e-8, atol=1e-5)
+
+    def test_build_env_selects_blocked_chol(self, monkeypatch):
+        """EWT_BLOCKED_CHOL=1 at build time routes the likelihood
+        through the blocked factorization and reproduces the default
+        build within the mixed-solve noise class."""
+        from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                                build_pulsar_likelihood)
+        from enterprise_warp_tpu.sim.noise import make_fake_pulsar
+        psr = make_fake_pulsar(name="Q", ntoa=120, backends=("A",),
+                               freqs_mhz=(1400.0,), seed=9)
+        psr.residuals = psr.toaerrs * np.random.default_rng(
+            9).standard_normal(120)
+        m = StandardModels(psr=psr)
+        terms = TermList(psr, [m.efac("by_backend"),
+                               m.spin_noise("powerlaw_8_nfreqs")])
+        base = build_pulsar_likelihood(psr, terms)
+        monkeypatch.setenv("EWT_BLOCKED_CHOL", "1")
+        blocked = build_pulsar_likelihood(psr, terms)
+        th = base.sample_prior(np.random.default_rng(10), 4)
+        v0 = np.asarray(base.loglike_batch(jnp.asarray(th)))
+        v1 = np.asarray(blocked.loglike_batch(jnp.asarray(th)))
+        np.testing.assert_allclose(v1, v0, rtol=1e-9, atol=5e-3)

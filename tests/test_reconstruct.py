@@ -110,3 +110,51 @@ def test_partial_noisefile_defaults(tmp_path, injected):
     cols, _ = get_tempo2_prediction(parfile, timfile,
                                     {f"{psr.name}_SIMA_efac": 1.0})
     assert np.all(np.isfinite(cols))
+
+
+def test_sampled_ephemeris_delay_realization(injected):
+    """A sampled-coefficient deterministic term reconstructs as exactly
+    D @ c, and the GP conditions on the delay-subtracted residuals."""
+    psr, red, dm = injected
+    m = StandardModels(psr=psr)
+    eph = m.bayes_ephem("sampled")
+    rec = NoiseReconstructor(
+        psr, TermList(psr, [m.efac("by_backend"),
+                            m.spin_noise("powerlaw_30_nfreqs"),
+                            m.dm_noise("powerlaw_30_nfreqs"),
+                            eph]))
+    assert sum("jup_orb_elements" in n for n in rec.param_names) == 6
+    rng = np.random.default_rng(12)
+    c = rng.uniform(-1, 1, 13) * np.concatenate(
+        [np.full(3, 1e-9), np.full(4, 1e-11), np.full(6, 0.01)])
+    theta = {}
+    for n in rec.param_names:
+        if n.endswith("efac"):
+            theta[n] = 1.0
+        elif "dm_gp" in n:
+            theta[n] = -13.1 if n.endswith("log10_A") else 3.0
+        elif n.endswith("log10_A"):
+            theta[n] = LG_A
+        elif n.endswith("gamma"):
+            theta[n] = GAMMA
+        else:
+            theta[n] = 0.0
+    for p, v in zip([n for n in rec.param_names
+                     if "efac" not in n and "log10_A" not in n
+                     and "gamma" not in n], c):
+        theta[p] = float(v)
+    out = rec.realizations(theta)
+    D, _ = m._ephem_columns()
+    np.testing.assert_allclose(out["bayes_ephem"], D @ c,
+                               rtol=1e-10, atol=1e-15)
+    # at c=0 (the truth: no ephemeris error was injected) the GP
+    # conditions on the unmodified residuals and recovers the injection
+    theta0 = dict(theta)
+    for n in rec.param_names:
+        if ("frame_drift" in n or "_mass" in n
+                or "jup_orb_elements" in n):
+            theta0[n] = 0.0
+    out0 = rec.realizations(theta0)
+    np.testing.assert_allclose(out0["bayes_ephem"], 0.0, atol=1e-20)
+    rho = np.corrcoef(out0["red_noise"], red)[0, 1]
+    assert rho > 0.95
