@@ -73,15 +73,25 @@ class PulsarLikelihood(PriorMixin):
     loglike_batch : jit'd batched version over (nbatch, ndim)
     """
 
-    def __init__(self, psr, sampled, loglike_fn, gram_mode):
+    def __init__(self, psr, sampled, loglike_fn, gram_mode,
+                 loglike=None, loglike_batch=None):
         self.psr = psr
         self.params = sampled
         self.param_names = [p.name for p in sampled]
         self.ndim = len(sampled)
         self._fn = loglike_fn
         self.gram_mode = gram_mode
-        self.loglike = jax.jit(loglike_fn)
-        self.loglike_batch = jax.jit(jax.vmap(loglike_fn))
+        if loglike is not None:
+            # prebuilt callables: the sharded (possibly multi-process)
+            # build passes its device arrays as jit ARGUMENTS — jit may
+            # not close over arrays spanning non-addressable devices
+            assert loglike_batch is not None, \
+                "prebuilt loglike requires prebuilt loglike_batch"
+            self.loglike = loglike
+            self.loglike_batch = loglike_batch
+        else:
+            self.loglike = jax.jit(loglike_fn)
+            self.loglike_batch = jax.jit(jax.vmap(loglike_fn))
 
 
 def _resolve_params(all_params, fixed_values):
@@ -468,26 +478,47 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     # frozen into the jit cache and silently ignore later toggles
     use_blocked_chol = _os.environ.get("EWT_BLOCKED_CHOL", "0") == "1"
 
-    def loglike(theta):
-        nw = eval_nw(theta, wb_static, ntoa_tot, sigma2_j)
-        phi, T_mat = eval_phi_T(theta, bb_static, T_w_j, cs2_j)
-        r_eff = r_w_j
+    def loglike_inner(theta, sh):
+        wb = [(kind, mm, refs) for (kind, _, refs), mm
+              in zip(wb_static, sh["wmm"])]
+        nw = eval_nw(theta, wb, ntoa_tot, sh["s2"])
+        phi, T_mat = eval_phi_T(theta, bb_static, sh["T"], cs2_j)
+        r_eff = sh["r"]
         if det_refs is not None:
             c = jnp.stack([param_value(theta, rf) for rf in det_refs])
-            r_eff = r_eff - D_all_j @ c
+            r_eff = r_eff - sh["D"] @ c
         if tm_refs is None:
-            lnl = marginalized_loglike(nw, phi, r_eff, M_w_j, T_mat,
-                                       mask=mask_j, gram_mode=gram_mode,
+            lnl = marginalized_loglike(nw, phi, r_eff, sh["M"], T_mat,
+                                       mask=sh["mask"],
+                                       gram_mode=gram_mode,
                                        pair_program=pair_prog,
                                        blocked_chol=use_blocked_chol)
         else:
             dp = jnp.stack([param_value(theta, rf) for rf in tm_refs])
-            r_eff = r_eff - M_w_j @ dp
+            r_eff = r_eff - sh["M"] @ dp
             lnl = marginalized_loglike(nw, phi, r_eff, None, T_mat,
-                                       mask=mask_j, gram_mode=gram_mode,
+                                       mask=sh["mask"],
+                                       gram_mode=gram_mode,
                                        blocked_chol=use_blocked_chol)
         # a numerically non-PD Sigma (extreme prior corners) yields NaN;
         # the reference stack maps Cholesky failure to -inf likewise
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
 
-    return PulsarLikelihood(psr, sampled, loglike, gram_mode)
+    sharded = dict(r=r_w_j, M=M_w_j, T=T_w_j, s2=sigma2_j, mask=mask_j,
+                   D=D_all_j, wmm=[mm for _, mm, _ in wb_static])
+
+    def loglike(theta):
+        return loglike_inner(theta, sharded)
+
+    if mesh is None:
+        return PulsarLikelihood(psr, sampled, loglike, gram_mode)
+
+    # sharded build: the device arrays may span processes (multi-host
+    # mesh), and jit may not CLOSE OVER non-addressable arrays — pass
+    # them as arguments instead
+    jit_single = jax.jit(loglike_inner)
+    jit_batch = jax.jit(jax.vmap(loglike_inner, in_axes=(0, None)))
+    return PulsarLikelihood(
+        psr, sampled, loglike, gram_mode,
+        loglike=lambda theta: jit_single(theta, sharded),
+        loglike_batch=lambda thetas: jit_batch(thetas, sharded))

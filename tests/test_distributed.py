@@ -13,6 +13,9 @@ import pytest
 
 from enterprise_warp_tpu.parallel import distributed
 
+import pathlib
+REPO_ROOT_FOR_SUBPROC = pathlib.Path(__file__).resolve().parents[1]
+
 
 @pytest.fixture
 def as_secondary(monkeypatch):
@@ -105,3 +108,85 @@ class TestSingleWriter:
             write_nfreqs_files(str(tmp_path),
                                {"J0000+0000": [("-be", "X", 30)]})
         assert list(tmp_path.iterdir()) == []
+
+
+_TWO_PROC_SCRIPT = r'''
+import sys, os
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+sys.path.insert(0, sys.argv[3])
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+os.environ["EWT_COORDINATOR"] = "127.0.0.1:" + sys.argv[2]
+os.environ["EWT_NUM_PROCESSES"] = "2"
+os.environ["EWT_PROCESS_ID"] = sys.argv[1]
+from enterprise_warp_tpu.parallel.distributed import (init_distributed,
+                                                      is_primary)
+pi, pc = init_distributed()
+assert pc == 2
+import numpy as np, jax.numpy as jnp
+from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                        build_pulsar_likelihood)
+from enterprise_warp_tpu.sim.noise import make_fake_pulsar
+from jax.sharding import Mesh
+psr = make_fake_pulsar(name="D", ntoa=300, backends=("A",),
+                       freqs_mhz=(1400.0,), seed=3)
+psr.residuals = psr.toaerrs * np.random.default_rng(
+    3).standard_normal(300)
+m = StandardModels(psr=psr)
+terms = TermList(psr, [m.efac("by_backend"),
+                       m.spin_noise("powerlaw_6_nfreqs")])
+like0 = build_pulsar_likelihood(psr, terms)            # local oracle
+mesh = Mesh(np.array(jax.devices()), ("toa",))         # SPANS PROCESSES
+like = build_pulsar_likelihood(psr, terms, mesh=mesh)
+th = like.sample_prior(np.random.default_rng(0), 2)
+v = np.asarray(like.loglike_batch(jnp.asarray(th)))
+v0 = np.asarray(like0.loglike_batch(jnp.asarray(th)))
+assert np.allclose(v, v0, rtol=1e-9, atol=1e-5), (v, v0)
+assert is_primary() == (pi == 0)
+print("OK", pi, v[0])
+'''
+
+
+@pytest.mark.slow
+def test_real_two_process_sharded_likelihood():
+    """REAL multi-process execution over localhost (not a mock): two
+    jax.distributed processes join through the EWT env contract, build
+    the TOA-sharded likelihood on a mesh that SPANS the processes
+    (4 global devices = 2 procs x 2 local), and the cross-process
+    Gram-psum value must equal the single-process oracle on both ranks.
+    This exercises the actual collective path the multi-host/DCN design
+    relies on — the transport is Gloo-over-TCP instead of DCN, the
+    program is identical."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    repo = str(REPO_ROOT_FOR_SUBPROC)
+    procs = [subprocess.Popen(
+        [_sys.executable, "-c", _TWO_PROC_SCRIPT, str(i), str(port),
+         repo], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("two-process run timed out")
+        outs.append((p.returncode, out))
+    for rc, out in outs:
+        assert rc == 0, out[-1500:]
+        assert "OK" in out, out[-1500:]
+    # both ranks computed the identical sharded value
+    vals = [line.split()[-1] for rc, out in outs
+            for line in out.splitlines() if line.startswith("OK")]
+    assert len(vals) == 2 and vals[0] == vals[1]
