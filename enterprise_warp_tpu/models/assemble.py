@@ -55,8 +55,32 @@ class MultiPulsarLikelihood(PriorMixin):
             return out
 
         self._fn = loglike
-        self.loglike = jax.jit(loglike)
-        self.loglike_batch = jax.jit(jax.vmap(loglike))
+
+        # sampler evaluation protocol (samplers/evalproto.py): member
+        # consts stacked as a tuple so sampler jit blocks can take every
+        # device array as an argument (multi-process meshes). The public
+        # loglike/loglike_batch are built the same way — a jit CLOSING
+        # over a member's sharded arrays would fail on a process-spanning
+        # mesh before any sampler block ran.
+        from ..samplers.evalproto import eval_protocol
+        member_protos = [eval_protocol(pl) for pl in pulsar_likes]
+        self.consts = tuple(pr[2] for pr in member_protos)
+        index_maps = self._index_maps
+
+        def _eval(theta, consts):
+            out = 0.0
+            for (_, single, _), cc, idx in zip(member_protos, consts,
+                                               index_maps):
+                out = out + single(theta[idx], cc)
+            return out
+
+        self._eval = _eval
+        self._eval_batch = jax.vmap(_eval, in_axes=(0, None))
+        _jit_single = jax.jit(_eval)
+        _jit_batch = jax.jit(self._eval_batch)
+        self.loglike = lambda theta: _jit_single(theta, self.consts)
+        self.loglike_batch = lambda thetas: _jit_batch(thetas,
+                                                       self.consts)
 
 
 

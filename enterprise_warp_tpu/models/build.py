@@ -511,14 +511,21 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
         return loglike_inner(theta, sharded)
 
     if mesh is None:
-        return PulsarLikelihood(psr, sampled, loglike, gram_mode)
-
-    # sharded build: the device arrays may span processes (multi-host
-    # mesh), and jit may not CLOSE OVER non-addressable arrays — pass
-    # them as arguments instead
-    jit_single = jax.jit(loglike_inner)
-    jit_batch = jax.jit(jax.vmap(loglike_inner, in_axes=(0, None)))
-    return PulsarLikelihood(
-        psr, sampled, loglike, gram_mode,
-        loglike=lambda theta: jit_single(theta, sharded),
-        loglike_batch=lambda thetas: jit_batch(thetas, sharded))
+        like = PulsarLikelihood(psr, sampled, loglike, gram_mode)
+    else:
+        # sharded build: the device arrays may span processes
+        # (multi-host mesh), and jit may not CLOSE OVER non-addressable
+        # arrays — pass them as arguments instead
+        jit_single = jax.jit(loglike_inner)
+        jit_batch = jax.jit(jax.vmap(loglike_inner, in_axes=(0, None)))
+        like = PulsarLikelihood(
+            psr, sampled, loglike, gram_mode,
+            loglike=lambda theta: jit_single(theta, sharded),
+            loglike_batch=lambda thetas: jit_batch(thetas, sharded))
+    # sampler evaluation protocol (samplers/evalproto.py): pure functions
+    # + the device-array pytree, so sampler jit blocks can take the
+    # arrays as arguments (required on a process-spanning mesh)
+    like.consts = sharded
+    like._eval = loglike_inner
+    like._eval_batch = jax.vmap(loglike_inner, in_axes=(0, None))
+    return like

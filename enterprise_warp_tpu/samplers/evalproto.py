@@ -1,0 +1,33 @@
+"""The likelihood evaluation protocol shared by every sampler.
+
+JAX forbids a jitted function from CLOSING OVER arrays that span
+non-addressable devices (a multi-process mesh). Sampler loops jit big
+blocks that evaluate the likelihood inside, so on a process-spanning
+mesh the likelihood's device arrays must flow into those blocks as
+ARGUMENTS, not closure constants.
+
+Protocol: a likelihood that supports this exposes
+
+    like.consts            pytree of device arrays (jit-argument safe)
+    like._eval(theta, consts)        -> lnl        (pure, no closure)
+    like._eval_batch(thetas, consts) -> (n,) lnl   (pure, no closure)
+
+``eval_protocol(like)`` returns ``(batch_fn, single_fn, consts)`` in
+that contract, falling back — for plain likelihood objects (analytic
+test targets, the joint PTA kernel) — to wrappers that close over
+``like.loglike``/``loglike_batch`` with an empty consts pytree, which
+reproduces the pre-protocol behavior exactly (valid whenever all arrays
+are process-local).
+"""
+
+from __future__ import annotations
+
+
+def eval_protocol(like):
+    """``(batch_fn(thetas, consts), single_fn(theta, consts), consts)``
+    for any likelihood object; see module docstring."""
+    if hasattr(like, "_eval") and hasattr(like, "consts"):
+        return like._eval_batch, like._eval, like.consts
+    return ((lambda thetas, consts: like.loglike_batch(thetas)),
+            (lambda theta, consts: like.loglike(theta)),
+            ())

@@ -100,17 +100,21 @@ class HMCSampler:
         # shared z-space target (samplers/transform.py): prior absorbed
         # by the sigmoid + unit-cube transform, -inf on solve failures
         logp_z = make_logp_z(like)
+        from .evalproto import eval_protocol
+        self._consts = eval_protocol(like)[2]
 
-        def vgrad_fn(z):
-            (lp, lnl), g = jax.value_and_grad(logp_z, has_aux=True)(z)
+        def vgrad_fn(z, consts):
+            (lp, lnl), g = jax.value_and_grad(
+                logp_z, has_aux=True)(z, consts)
             # a -inf/NaN point has a NaN gradient; zero it so the
             # trajectory still moves (momentum only) and the chain can
             # ESCAPE a bad start instead of freezing on NaN forever
             g = jnp.where(jnp.isfinite(g), g, 0.0)
             return (lp, lnl), g
 
-        self._vgrad = jax.jit(jax.vmap(vgrad_fn))
-        self._logp_batch = jax.jit(jax.vmap(lambda z: logp_z(z)[0]))
+        self._vgrad_pure = jax.vmap(vgrad_fn, in_axes=(0, None))
+        self._logp_batch = jax.jit(jax.vmap(
+            lambda z, consts: logp_z(z, consts)[0], in_axes=(0, None)))
         self._lnprior_batch = jax.jit(jax.vmap(like.log_prior))
         self._from_unit_batch = jax.jit(
             lambda z: like.from_unit(jax.nn.sigmoid(z)))
@@ -137,7 +141,7 @@ class HMCSampler:
         # PTSampler)
         for _ in range(20):
             bad = ~np.isfinite(np.asarray(self._logp_batch(
-                jnp.asarray(z))))
+                jnp.asarray(z), self._consts)))
             if not bad.any():
                 break
             u = np.clip(rng.uniform(size=(int(bad.sum()), self.ndim)),
@@ -191,7 +195,7 @@ class HMCSampler:
         granularity (observed: eps overshooting 10x then collapsing)."""
         W, nd = self.W, self.ndim
         n_leap = self.n_leapfrog
-        vgrad = self._vgrad
+        vgrad = self._vgrad_pure
         jit_frac = self.eps_jitter
         target = self.target_accept
         gamma, t0, kappa = 0.05, 10.0, 0.75
@@ -201,7 +205,7 @@ class HMCSampler:
 
         def one_step(carry, t_glob):
             (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar, mass, acc,
-             ndiv, mu, ngrad) = carry
+             ndiv, mu, ngrad, consts) = carry
             key, kp, ke, ka, kl = jax.random.split(key, 5)
 
             eps = jnp.exp(log_eps)
@@ -224,7 +228,7 @@ class HMCSampler:
                 zz, pp, gg, _, _ = s
                 pp = pp + 0.5 * eps_c * gg
                 zz = zz + eps_c * pp / mass[None, :]
-                (lpv, lnlv), gg = vgrad(zz)
+                (lpv, lnlv), gg = vgrad(zz, consts)
                 pp = pp + 0.5 * eps_c * gg
                 return zz, pp, gg, lpv, lnlv
 
@@ -266,19 +270,19 @@ class HMCSampler:
                 log_eps_bar = w * log_eps + (1.0 - w) * log_eps_bar
 
             return (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar,
-                    mass, acc, ndiv, mu, ngrad), (z, lnl, p_acc)
+                    mass, acc, ndiv, mu, ngrad, consts), (z, lnl, p_acc)
 
         @jax.jit
         def block(z, key, log_eps, log_eps_bar, h_bar, mass, acc, ndiv,
-                  iter0, mu, ngrad):
-            (lp, lnl), g = vgrad(z)
+                  iter0, mu, ngrad, consts):
+            (lp, lnl), g = vgrad(z, consts)
             ngrad = ngrad + 1          # the block-entry gradient
             carry = (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar,
-                     mass, acc, ndiv, mu, ngrad)
+                     mass, acc, ndiv, mu, ngrad, consts)
             carry, (zs, lnls, p_accs) = jax.lax.scan(
                 one_step, carry, iter0 + jnp.arange(nsteps))
             (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar, mass, acc,
-             ndiv, mu, ngrad) = carry
+             ndiv, mu, ngrad, consts) = carry
             return (z, key, log_eps, log_eps_bar, h_bar, acc, ndiv, zs,
                     lnls, jnp.mean(p_accs), ngrad)
 
@@ -335,7 +339,7 @@ class HMCSampler:
                 jnp.asarray(st.z), jnp.asarray(st.key), st.log_eps,
                 st.log_eps_bar, st.h_bar, jnp.asarray(st.mass),
                 jnp.asarray(st.accepted), st.divergences, st.da_iter,
-                st.mu, st.ngrad)
+                st.mu, st.ngrad, self._consts)
             st.z = np.asarray(z)
             st.key = np.asarray(key)
             st.log_eps = float(log_eps)

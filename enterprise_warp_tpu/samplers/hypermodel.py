@@ -60,6 +60,30 @@ class HyperModelLikelihood(PriorMixin):
             return jax.lax.switch(k, branches, theta[:-1])
 
         self._fn = loglike
-        self.loglike = jax.jit(loglike)
-        self.loglike_batch = jax.jit(jax.vmap(loglike))
+
+        # sampler evaluation protocol (samplers/evalproto.py); the
+        # public loglike/loglike_batch are protocol-built too so no jit
+        # closes over a member's (possibly process-spanning) arrays
+        from .evalproto import eval_protocol
+        member_protos = [eval_protocol(like)
+                         for like in self.likes.values()]
+        self.consts = tuple(pr[2] for pr in member_protos)
+
+        def _eval(theta, consts):
+            k = jnp.clip(jnp.round(theta[-1]).astype(jnp.int32), 0,
+                         nmodels - 1)
+            ebranches = [
+                (lambda single, cc, idx:
+                 lambda th: single(th[idx], cc))(pr[1], cc, idx)
+                for pr, cc, idx in zip(member_protos, consts,
+                                       index_maps)]
+            return jax.lax.switch(k, ebranches, theta[:-1])
+
+        self._eval = _eval
+        self._eval_batch = jax.vmap(_eval, in_axes=(0, None))
+        _jit_single = jax.jit(_eval)
+        _jit_batch = jax.jit(self._eval_batch)
+        self.loglike = lambda theta: _jit_single(theta, self.consts)
+        self.loglike_batch = lambda thetas: _jit_batch(thetas,
+                                                       self.consts)
 

@@ -29,10 +29,13 @@ import numpy as np
 
 def _make_refill(like, nlive, kbatch, nsteps):
     """One jitted NS iteration: delete the K worst, refill by constrained
-    random walks from random survivors."""
+    random walks from random survivors. Likelihood device arrays flow in
+    as the ``consts`` argument (samplers/evalproto.py)."""
+    from .evalproto import eval_protocol
+    batch_eval, _, _ = eval_protocol(like)
 
     @jax.jit
-    def iteration(u, lnl, key, scale):
+    def iteration(u, lnl, key, scale, consts):
         order = jnp.argsort(lnl)
         u = u[order]
         lnl = lnl[order]
@@ -57,7 +60,7 @@ def _make_refill(like, nlive, kbatch, nsteps):
             prop = jnp.abs(prop)
             prop = 1.0 - jnp.abs(1.0 - prop)
             prop = jnp.clip(prop, 1e-12, 1.0 - 1e-12)
-            lnl_p = like.loglike_batch(like.from_unit(prop))
+            lnl_p = batch_eval(like.from_unit(prop), consts)
             ok = lnl_p > lstar
             walk_u = jnp.where(ok[:, None], prop, walk_u)
             walk_lnl = jnp.where(ok, lnl_p, walk_lnl)
@@ -106,6 +109,8 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
         ckpt_path = os.path.join(outdir, f"{label}_nested_ckpt.npz")
 
     iteration = _make_refill(like, nlive, kbatch, nsteps)
+    from .evalproto import eval_protocol
+    _consts = eval_protocol(like)[2]
 
     # a batch of K deletions == K sequential deletions at live counts
     # N, N-1, ..., N-K+1: per-deletion shrinkage 1/count, per-deletion
@@ -191,8 +196,8 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
 
     converged = False
     while it < max_iter:
-        u, lnl, rng_key, du, dl, acc = iteration(u, lnl, rng_key,
-                                                 jnp.float64(scale))
+        u, lnl, rng_key, du, dl, acc = iteration(
+            u, lnl, rng_key, jnp.float64(scale), _consts)
         dl_np = np.asarray(dl)
         dead_u.append(np.asarray(du))
         dead_lnl.append(dl_np)

@@ -209,6 +209,8 @@ class PTSampler:
 
     def _make_block(self, nsteps):
         like = self.like
+        from .evalproto import eval_protocol
+        batch_eval, _, self._consts = eval_protocol(like)
         log_prior_dims = self._log_prior_dims
         jump_p = jnp.asarray(self.jump_probs)
         W, nd = self.W, self.ndim
@@ -218,7 +220,7 @@ class PTSampler:
 
         def one_step(carry, step_idx):
             x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop, \
-                eigvecs, eigvals, chol, temps = carry
+                eigvecs, eigvals, chol, temps, consts = carry
             key, k1, k2, k3, k4, k5, k6, k7, k8 = jax.random.split(key, 9)
 
             # --- proposals (all four families, select per walker) -----
@@ -251,7 +253,7 @@ class PTSampler:
 
             key, ka = jax.random.split(key)
             lnp_new = like.log_prior(prop)
-            lnl_new = like.loglike_batch(prop)
+            lnl_new = batch_eval(prop, consts)
             lnl_new = jnp.where(jnp.isneginf(lnp_new), -jnp.inf, lnl_new)
             # prior-draw proposal asymmetry: q(x'|x) is the prior density
             # of the redrawn dimension, so the MH correction is
@@ -317,13 +319,13 @@ class PTSampler:
             else:
                 ys = (x[:nchains], lnl[:nchains], lnp[:nchains])
             return ((x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                     eigvecs, eigvals, chol, temps), ys)
+                     eigvecs, eigvals, chol, temps, consts), ys)
 
         @partial(jax.jit, static_argnames=())
         def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                  eigvecs, eigvals, chol, temps):
+                  eigvecs, eigvals, chol, temps, consts):
             carry = (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                     eigvecs, eigvals, chol, temps)
+                     eigvecs, eigvals, chol, temps, consts)
             carry, ys = jax.lax.scan(
                 one_step, carry, jnp.arange(nsteps))
             return (carry,) + tuple(ys)
@@ -388,7 +390,7 @@ class PTSampler:
                 jnp.asarray(st.accepted), jnp.asarray(st.swaps_accepted),
                 jnp.asarray(st.swaps_proposed), jnp.asarray(eigvecs),
                 jnp.asarray(eigvals), jnp.asarray(chol),
-                jnp.asarray(temps))
+                jnp.asarray(temps), self._consts)
             (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
              *_unused) = carry
             st.x = np.asarray(x)

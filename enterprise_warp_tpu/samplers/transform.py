@@ -14,15 +14,22 @@ import jax.numpy as jnp
 
 
 def make_logp_z(like):
-    """Return ``logp_z(z) -> (lp, lnl)`` for a PriorMixin-style
+    """Return ``logp_z(z, consts) -> (lp, lnl)`` for a PriorMixin-style
     likelihood: the z-space log-density (non-finite mapped to -inf so a
     prior-corner solve failure rejects instead of poisoning a
-    trajectory) and the raw log-likelihood as auxiliary output."""
+    trajectory) and the raw log-likelihood as auxiliary output.
 
-    def logp_z(z):
+    ``consts`` is the likelihood's device-array pytree
+    (``samplers/evalproto.py``) so outer jits can take the arrays as
+    arguments — required on a process-spanning mesh; pass the value from
+    ``eval_protocol(like)[2]``."""
+    from .evalproto import eval_protocol
+    _, single_eval, _ = eval_protocol(like)
+
+    def logp_z(z, consts):
         u = jax.nn.sigmoid(z)
         theta = like.from_unit(u)
-        lnl = like.loglike(theta)
+        lnl = single_eval(theta, consts)
         ljac = jnp.sum(jax.nn.log_sigmoid(z) + jax.nn.log_sigmoid(-z))
         lp = lnl + ljac
         lp = jnp.where(jnp.isfinite(lp), lp, -jnp.inf)

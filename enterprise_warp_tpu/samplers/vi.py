@@ -48,26 +48,28 @@ def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
 
     nd = like.ndim
     _logp = make_logp_z(like)     # shared z-space target (same as HMC)
+    from .evalproto import eval_protocol
+    _consts = eval_protocol(like)[2]
 
-    def logp_z(z):
-        lp, _ = _logp(z)
+    def logp_z(z, consts):
+        lp, _ = _logp(z, consts)
         return lp
 
     # per-SAMPLE values/gradients so one failed-solve draw can be
     # masked out of the Monte Carlo average instead of NaN-poisoning it
     # (a zeroed aggregate gradient would silently no-op the whole step)
-    vg = jax.vmap(jax.value_and_grad(logp_z))
+    vg = jax.vmap(jax.value_and_grad(logp_z), in_axes=(0, None))
     entropy_const = 0.5 * nd * np.log(2 * np.pi * np.e)
 
     opt = optax.adam(lr)
 
     @jax.jit
-    def step(params, opt_state, key):
+    def step(params, opt_state, key, consts):
         mu, log_sig = params
         sig = jnp.exp(log_sig)
         eps = jax.random.normal(key, (mc, nd))
         z = mu + sig[None, :] * eps
-        lp, g = vg(z)                              # (mc,), (mc, nd)
+        lp, g = vg(z, consts)                      # (mc,), (mc, nd)
         ok = jnp.isfinite(lp) & jnp.all(jnp.isfinite(g), axis=1)
         n_ok = jnp.maximum(jnp.sum(ok), 1)
         gm = jnp.where(ok[:, None], g, 0.0)
@@ -95,7 +97,7 @@ def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
     vals = []
     for i in range(steps):
         key, k = jax.random.split(key)
-        params, opt_state, val = step(params, opt_state, k)
+        params, opt_state, val = step(params, opt_state, k, _consts)
         vals.append(val)
         if verbose and (i + 1) % max(steps // 10, 1) == 0:
             print(f"  advi step {i + 1}/{steps} elbo={float(val):.2f}")

@@ -190,3 +190,88 @@ def test_real_two_process_sharded_likelihood():
     vals = [line.split()[-1] for rc, out in outs
             for line in out.splitlines() if line.startswith("OK")]
     assert len(vals) == 2 and vals[0] == vals[1]
+
+
+_TWO_PROC_SAMPLING_SCRIPT = r'''
+import sys, os
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+sys.path.insert(0, sys.argv[3])
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+os.environ["EWT_COORDINATOR"] = "127.0.0.1:" + sys.argv[2]
+os.environ["EWT_NUM_PROCESSES"] = "2"
+os.environ["EWT_PROCESS_ID"] = sys.argv[1]
+from enterprise_warp_tpu.parallel.distributed import (init_distributed,
+                                                      is_primary)
+pi, pc = init_distributed()
+import numpy as np, jax.numpy as jnp
+from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                        build_pulsar_likelihood)
+from enterprise_warp_tpu.samplers import PTSampler
+from enterprise_warp_tpu.sim.noise import make_fake_pulsar
+from jax.sharding import Mesh
+psr = make_fake_pulsar(name="D", ntoa=300, backends=("A",),
+                       freqs_mhz=(1400.0,), seed=3)
+psr.residuals = psr.toaerrs * np.random.default_rng(
+    3).standard_normal(300)
+m = StandardModels(psr=psr)
+terms = TermList(psr, [m.efac("by_backend"),
+                       m.spin_noise("powerlaw_6_nfreqs")])
+mesh = Mesh(np.array(jax.devices()), ("toa",))         # SPANS PROCESSES
+like = build_pulsar_likelihood(psr, terms, mesh=mesh)
+outdir = sys.argv[4]
+s = PTSampler(like, outdir, ntemps=2, nchains=4, seed=0)
+st = s.sample(40, resume=False, verbose=False, block_size=20)
+assert np.all(np.isfinite(st.lnl)), st.lnl
+print("SAMPLED", pi, float(np.sum(st.lnl)),
+      "wrote" if os.path.exists(os.path.join(outdir, "chain_1.txt"))
+      and is_primary() else "nowrite")
+'''
+
+
+@pytest.mark.slow
+def test_real_two_process_pt_sampling(tmp_path):
+    """END-TO-END multi-process sampling: the PT sampler's jitted block
+    receives the likelihood's device arrays as arguments
+    (samplers/evalproto.py), so it runs on a process-spanning mesh.
+    Both ranks execute the identical step stream (same seeds) and must
+    land on the identical walker state; only rank 0 writes the chain."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    repo = str(REPO_ROOT_FOR_SUBPROC)
+    dirs = [str(tmp_path / f"rank{i}") for i in range(2)]
+    procs = [subprocess.Popen(
+        [_sys.executable, "-c", _TWO_PROC_SAMPLING_SCRIPT, str(i),
+         str(port), repo, dirs[i]], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("two-process sampling run timed out")
+        outs.append((p.returncode, out))
+    for rc, out in outs:
+        assert rc == 0, out[-2000:]
+    lines = {int(line.split()[1]): line.split()
+             for rc, out in outs for line in out.splitlines()
+             if line.startswith("SAMPLED")}
+    assert set(lines) == {0, 1}
+    # identical walker state on both ranks (same seeds, same collectives)
+    assert lines[0][2] == lines[1][2]
+    # single-writer convention
+    assert lines[0][3] == "wrote" and lines[1][3] == "nowrite"
+    assert os.path.exists(os.path.join(dirs[0], "chain_1.txt"))
+    assert not os.path.exists(os.path.join(dirs[1], "chain_1.txt"))
