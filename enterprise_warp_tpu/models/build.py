@@ -510,22 +510,14 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     def loglike(theta):
         return loglike_inner(theta, sharded)
 
-    if mesh is None:
-        like = PulsarLikelihood(psr, sampled, loglike, gram_mode)
-    else:
-        # sharded build: the device arrays may span processes
-        # (multi-host mesh), and jit may not CLOSE OVER non-addressable
-        # arrays — pass them as arguments instead
-        jit_single = jax.jit(loglike_inner)
-        jit_batch = jax.jit(jax.vmap(loglike_inner, in_axes=(0, None)))
-        like = PulsarLikelihood(
-            psr, sampled, loglike, gram_mode,
-            loglike=lambda theta: jit_single(theta, sharded),
-            loglike_batch=lambda thetas: jit_batch(thetas, sharded))
-    # sampler evaluation protocol (samplers/evalproto.py): pure functions
-    # + the device-array pytree, so sampler jit blocks can take the
-    # arrays as arguments (required on a process-spanning mesh)
-    like.consts = sharded
-    like._eval = loglike_inner
-    like._eval_batch = jax.vmap(loglike_inner, in_axes=(0, None))
+    like = PulsarLikelihood(psr, sampled, loglike, gram_mode)
+    # sampler evaluation protocol (samplers/evalproto.py): pure function
+    # + the device-array pytree, so every jit can take the arrays as
+    # arguments. For sharded builds (arrays may span processes) the
+    # public loglike/loglike_batch are protocol-built too; unsharded
+    # builds keep the closure-jitted ones (identical numerics, and the
+    # composition path through _fn stays valid).
+    from ..samplers.evalproto import install_protocol
+    install_protocol(like, loglike_inner, sharded,
+                     public=mesh is not None)
     return like

@@ -108,16 +108,22 @@ class PTALikelihood(PriorMixin):
     unchanged on top of it.
     """
 
-    def __init__(self, psrs, sampled, loglike_fn, gram_mode, mesh=None):
+    def __init__(self, psrs, sampled, loglike_fn, gram_mode, mesh=None,
+                 consts=None):
+        """``loglike_fn(theta, consts)`` — pure; ``consts`` is the
+        device-array pytree (mesh-shardable arrays), threaded into every
+        jit as an ARGUMENT per the sampler evaluation protocol
+        (``samplers/evalproto.py``) so a process-spanning psr mesh works."""
         self.psrs = psrs
         self.params = sampled
         self.param_names = [p.name for p in sampled]
         self.ndim = len(sampled)
-        self._fn = loglike_fn
         self.gram_mode = gram_mode
         self.mesh = mesh
-        self.loglike = jax.jit(loglike_fn)
-        self.loglike_batch = jax.jit(jax.vmap(loglike_fn))
+        from ..samplers.evalproto import install_protocol
+        install_protocol(self, loglike_fn,
+                         consts if consts is not None else {})
+        self._fn = lambda theta: loglike_fn(theta, self.consts)
 
 
 # --------------------------------------------------------------------- #
@@ -564,7 +570,12 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
             logdet_b = logdet_b + ld
         return out, logdet_b
 
-    def _common(theta):
+    # device arrays that may be mesh-sharded (possibly across
+    # processes): flow into the jitted functions as ARGUMENTS via the
+    # sampler evaluation protocol (samplers/evalproto.py)
+    _sh = dict(R=R_j, T=T_j, mask=mask_j)
+
+    def _common(theta, sh):
         """Shared front end: nw/phi evaluation, dynamic basis rescale,
         whitened Grams. Returns (G, X, rwr, logdet_n, logphi, invphi_N)."""
         nw = eval_white(theta, sigma2_j)                 # (npsr, ntoa_max)
@@ -572,26 +583,26 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         invphi_N = 1.0 / phi_N
         logphi = jnp.sum(jnp.log(phi_N))                 # pads: log 1 = 0
 
-        T_use = T_j
+        T_use = sh["T"]
         for db in dyn_blocks:
             idx = param_value(theta, db["ref"])
             scale = jnp.exp(idx * jnp.asarray(db["lognu"]))
             sl = slice(db["off"], db["off"] + db["ncols"])
             T_use = T_use.at[db["psr"], :, sl].set(
-                T_j[db["psr"], :, sl] * scale[:, None])
+                sh["T"][db["psr"], :, sl] * scale[:, None])
 
-        w = mask_j / nw
+        w = sh["mask"] / nw
         sqw = jnp.sqrt(w)
         Ts = T_use * sqw[:, :, None]
-        rs = R_j * sqw
+        rs = sh["R"] * sqw
         G = _gram_batched(Ts, Ts, gram_mode).astype(jnp.float64)
         X = jnp.einsum("pik,pi->pk", Ts, rs, precision=_HIGH)
         rwr = jnp.sum(rs * rs)
-        logdet_n = jnp.sum(jnp.log(nw) * mask_j)
+        logdet_n = jnp.sum(jnp.log(nw) * sh["mask"])
         return G, X, rwr, logdet_n, logphi, invphi_N
 
-    def loglike_schur(theta):
-        G, X, rwr, logdet_n, logphi, invphi_N = _common(theta)
+    def loglike_schur(theta, sh):
+        G, X, rwr, logdet_n, logphi, invphi_N = _common(theta, sh)
 
         Gnn = G[:, :NW, :NW] + jax.vmap(jnp.diag)(invphi_N)
         H = G[:, :NW, NW:NW + MW]
@@ -666,8 +677,8 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
                       + jnp.sum(ld_nn) + jnp.sum(ld_tm) + ld_S + tm_const)
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
 
-    def loglike_dense(theta):
-        G, X, rwr, logdet_n, logphi, invphi_N = _common(theta)
+    def loglike_dense(theta, sh):
+        G, X, rwr, logdet_n, logphi, invphi_N = _common(theta, sh)
         # full diagonal prior inverse in the permuted layout: region M gets
         # the big-phi stand-in (1 on padded slots), region G none (its
         # prior lives in the coupling blocks)
@@ -692,8 +703,9 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         lnl = -0.5 * (quad + logdet_n + logphi + logdet_b + logdet_sigma)
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
 
-    fn = loglike_schur if joint_mode == "schur" else loglike_dense
-    like = PTALikelihood(psrs, sampled, fn, gram_mode, mesh=mesh)
+    inner = loglike_schur if joint_mode == "schur" else loglike_dense
+    like = PTALikelihood(psrs, sampled, inner, gram_mode, mesh=mesh,
+                         consts=_sh)
     # introspection hook for tools/ (stage profiling, corner debugging)
     like._stages = dict(common=_common, coupling=_coupling_blocks,
                         NW=NW, MW=MW, n_g=n_g, npsr=npsr,

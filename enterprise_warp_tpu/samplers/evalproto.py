@@ -31,3 +31,24 @@ def eval_protocol(like):
     return ((lambda thetas, consts: like.loglike_batch(thetas)),
             (lambda theta, consts: like.loglike(theta)),
             ())
+
+
+def install_protocol(like, eval_fn, consts, public=True):
+    """Install the protocol attributes on ``like`` from a pure
+    ``eval_fn(theta, consts)``: sets ``consts``/``_eval``/``_eval_batch``
+    and, with ``public`` (default), protocol-built ``loglike``/
+    ``loglike_batch`` whose jits take the arrays as arguments. The one
+    place the contract's plumbing lives — every likelihood class calls
+    this instead of repeating it."""
+    import jax
+
+    like.consts = consts
+    like._eval = eval_fn
+    like._eval_batch = jax.vmap(eval_fn, in_axes=(0, None))
+    if public:
+        jit_single = jax.jit(eval_fn)
+        jit_batch = jax.jit(like._eval_batch)
+        like.loglike = lambda theta: jit_single(theta, like.consts)
+        like.loglike_batch = lambda thetas: jit_batch(thetas,
+                                                      like.consts)
+    return like

@@ -79,11 +79,6 @@ class HyperModelLikelihood(PriorMixin):
                                        index_maps)]
             return jax.lax.switch(k, ebranches, theta[:-1])
 
-        self._eval = _eval
-        self._eval_batch = jax.vmap(_eval, in_axes=(0, None))
-        _jit_single = jax.jit(_eval)
-        _jit_batch = jax.jit(self._eval_batch)
-        self.loglike = lambda theta: _jit_single(theta, self.consts)
-        self.loglike_batch = lambda thetas: _jit_batch(thetas,
-                                                       self.consts)
+        from .evalproto import install_protocol
+        install_protocol(self, _eval, self.consts)
 

@@ -86,11 +86,11 @@ def main():
           f"ndim={like.ndim}")
     tb = moderate_batch(like, batch)
 
-    full = jax.jit(jax.vmap(like._fn))
-    dt_full = timeit("FULL loglike", full, tb)
+    dt_full = timeit("FULL loglike", like.loglike_batch, tb)
 
-    common = jax.jit(jax.vmap(st["common"]))
-    dt_common = timeit("frontend (nw/phi/gram/X)", common, tb)
+    common = jax.jit(jax.vmap(st["common"], in_axes=(0, None)))
+    dt_common = timeit("frontend (nw/phi/gram/X)",
+                       lambda t: common(t, like.consts), tb)
 
     # time the FULL coupling output (Binv blocks + logdet) — timing the
     # logdet alone would let XLA dead-code-eliminate the Binv einsums
@@ -98,7 +98,8 @@ def main():
     dt_coup = timeit("coupling Binv blocks", coupling, tb)
 
     # stage 1+2 in isolation on realistic inputs from the front end
-    G, X, *_rest, invphi_N = jax.vmap(st["common"])(tb)
+    G, X, *_rest, invphi_N = jax.vmap(
+        st["common"], in_axes=(0, None))(tb, like.consts)
     Gnn = G[:, :, :NW, :NW] + jax.vmap(jax.vmap(jnp.diag))(invphi_N)
     RHS = jnp.concatenate(
         [X[:, :, :NW, None], G[:, :, :NW, NW:]], axis=3)
