@@ -73,25 +73,15 @@ class PulsarLikelihood(PriorMixin):
     loglike_batch : jit'd batched version over (nbatch, ndim)
     """
 
-    def __init__(self, psr, sampled, loglike_fn, gram_mode,
-                 loglike=None, loglike_batch=None):
+    def __init__(self, psr, sampled, loglike_fn, gram_mode):
         self.psr = psr
         self.params = sampled
         self.param_names = [p.name for p in sampled]
         self.ndim = len(sampled)
         self._fn = loglike_fn
         self.gram_mode = gram_mode
-        if loglike is not None:
-            # prebuilt callables: the sharded (possibly multi-process)
-            # build passes its device arrays as jit ARGUMENTS — jit may
-            # not close over arrays spanning non-addressable devices
-            assert loglike_batch is not None, \
-                "prebuilt loglike requires prebuilt loglike_batch"
-            self.loglike = loglike
-            self.loglike_batch = loglike_batch
-        else:
-            self.loglike = jax.jit(loglike_fn)
-            self.loglike_batch = jax.jit(jax.vmap(loglike_fn))
+        self.loglike = jax.jit(loglike_fn)
+        self.loglike_batch = jax.jit(jax.vmap(loglike_fn))
 
 
 def _resolve_params(all_params, fixed_values):

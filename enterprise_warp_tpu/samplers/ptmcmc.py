@@ -82,7 +82,8 @@ class PTSampler:
                  prior_weight=10, cov_update=1000, swap_every=10,
                  tmax=None, init_cov=None, burn=0, adapt_ladder=True,
                  ladder_t0=1000.0, swap_target=0.25,
-                 write_hot_chains=False, init_x=None):
+                 write_hot_chains=False, init_x=None,
+                 ind_weight=0, ind_inflate=1.4):
         self.like = like
         self.outdir = outdir
         self.ntemps = ntemps
@@ -90,8 +91,16 @@ class PTSampler:
         self.W = ntemps * nchains
         self.ndim = like.ndim
         weights = np.array([scam_weight, am_weight, de_weight,
-                            prior_weight], float)
+                            prior_weight, ind_weight], float)
         self.jump_probs = weights / weights.sum()
+        # ensemble-fitted independence proposals: N(mean, inflate^2 * cov)
+        # refit to the cold-walker ensemble every block. With a large
+        # walker batch near equilibrium the proposal approximates the
+        # posterior itself, so acceptance is O(1) and the chain
+        # decorrelates in a handful of steps — the batch dimension
+        # bought with device parallelism converted into shorter chains
+        # (exact MH correction applied; see ``qcorr`` in the block)
+        self.ind_inflate = float(ind_inflate)
         self.cov_update = cov_update
         self.swap_every = swap_every
         self.burn = burn     # steps before covariance adaptation engages
@@ -217,11 +226,14 @@ class PTSampler:
         ntemps, nchains = self.ntemps, self.nchains
         swap_every = self.swap_every
         emit_hot = self.write_hot
+        use_ind = bool(self.jump_probs[4] > 0)
 
         def one_step(carry, step_idx):
             x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop, \
-                eigvecs, eigvals, chol, temps, consts = carry
-            key, k1, k2, k3, k4, k5, k6, k7, k8 = jax.random.split(key, 9)
+                eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL, \
+                temps, consts = carry
+            key, k1, k2, k3, k4, k5, k6, k7, k8, k9 = \
+                jax.random.split(key, 10)
 
             # --- proposals (all four families, select per walker) -----
             z = jax.random.normal(k1, (W, nd))
@@ -243,13 +255,20 @@ class PTSampler:
             onehot = jax.nn.one_hot(jp, nd, dtype=x.dtype)
             draws = like.from_unit(jax.random.uniform(k8, (W, nd)))
             pd = x * (1.0 - onehot) + draws * onehot
-
             u = jax.random.uniform(k6, (W,))
             choice = jnp.searchsorted(jnp.cumsum(jump_p), u)
-            prop = jnp.where((choice == 0)[:, None], scam,
-                             jnp.where((choice == 1)[:, None], am,
-                                       jnp.where((choice == 2)[:, None],
-                                                 de, pd)))
+            prop = jnp.where(
+                (choice == 0)[:, None], scam,
+                jnp.where((choice == 1)[:, None], am,
+                          jnp.where((choice == 2)[:, None], de, pd)))
+            if use_ind:
+                # independence: draw from the block's ensemble-fitted
+                # Gaussian, ignoring the current position entirely
+                # (compiled out when ind_weight=0 — choice==4 would be
+                # unreachable but XLA cannot prove it)
+                ind = ind_mean[None, :] + \
+                    jax.random.normal(k9, (W, nd)) @ ind_L.T
+                prop = jnp.where((choice == 4)[:, None], ind, prop)
 
             key, ka = jax.random.split(key)
             lnp_new = like.log_prior(prop)
@@ -261,6 +280,17 @@ class PTSampler:
             lpd_old = jnp.sum(log_prior_dims(x) * onehot, axis=-1)
             lpd_new = jnp.sum(log_prior_dims(prop) * onehot, axis=-1)
             qcorr = jnp.where(choice == 3, lpd_old - lpd_new, 0.0)
+            if use_ind:
+                # independence-proposal asymmetry: q is the SAME
+                # Gaussian both directions, so the correction is
+                # q(x) - q(x') with the shared log-det cancelling;
+                # density via the precomputed inverse Cholesky factor
+                # (matmul, no triangular solve)
+                dx_old = (x - ind_mean[None, :]) @ ind_iL.T
+                dx_new = (prop - ind_mean[None, :]) @ ind_iL.T
+                q_ind = 0.5 * (jnp.sum(dx_new ** 2, axis=-1)
+                               - jnp.sum(dx_old ** 2, axis=-1))
+                qcorr = jnp.where(choice == 4, q_ind, qcorr)
             log_ratio = (lnp_new - lnp) + (lnl_new - lnl) / temps + qcorr
             accept = jnp.log(jax.random.uniform(ka, (W,))) < log_ratio
             x = jnp.where(accept[:, None], prop, x)
@@ -319,13 +349,16 @@ class PTSampler:
             else:
                 ys = (x[:nchains], lnl[:nchains], lnp[:nchains])
             return ((x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                     eigvecs, eigvals, chol, temps, consts), ys)
+                     eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
+                     temps, consts), ys)
 
         @partial(jax.jit, static_argnames=())
         def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                  eigvecs, eigvals, chol, temps, consts):
+                  eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
+                  temps, consts):
             carry = (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                     eigvecs, eigvals, chol, temps, consts)
+                     eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
+                     temps, consts)
             carry, ys = jax.lax.scan(
                 one_step, carry, jnp.arange(nsteps))
             return (carry,) + tuple(ys)
@@ -380,6 +413,27 @@ class PTSampler:
             eigvals = np.maximum(eigvals, 1e-16)
             chol = np.linalg.cholesky(cov)
 
+            # independence proposal: refit N(mean, inflate^2 cov) to the
+            # instantaneous cold-walker cloud (at equilibrium the cloud
+            # IS a posterior sample; inflation over-covers the tails).
+            # Degenerate clouds (fresh identical walkers, tiny nchains)
+            # fall back to the adapted covariance above.
+            if self.jump_probs[4] > 0:
+                cold_x = st.x[:self.nchains]
+                ind_mean = cold_x.mean(axis=0)
+                ind_cov = cov
+                if self.nchains > 2 * self.ndim:
+                    c = np.cov(cold_x.T) + 1e-12 * np.eye(self.ndim)
+                    if np.all(np.isfinite(c)) and \
+                            np.linalg.eigvalsh(c)[0] > 0:
+                        ind_cov = c
+                ind_L = np.linalg.cholesky(
+                    self.ind_inflate ** 2 * ind_cov)
+                ind_iL = np.linalg.inv(ind_L)
+            else:
+                ind_mean = np.zeros(self.ndim)
+                ind_L = ind_iL = np.eye(self.ndim)
+
             sacc_before = st.swaps_accepted.copy()
             sprop_before = st.swaps_proposed.copy()
             temps = np.repeat(st.ladder, self.nchains)
@@ -390,7 +444,8 @@ class PTSampler:
                 jnp.asarray(st.accepted), jnp.asarray(st.swaps_accepted),
                 jnp.asarray(st.swaps_proposed), jnp.asarray(eigvecs),
                 jnp.asarray(eigvals), jnp.asarray(chol),
-                jnp.asarray(temps), self._consts)
+                jnp.asarray(ind_mean), jnp.asarray(ind_L),
+                jnp.asarray(ind_iL), jnp.asarray(temps), self._consts)
             (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
              *_unused) = carry
             st.x = np.asarray(x)
@@ -508,6 +563,8 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
             am_weight=getattr(params, "AMweight", 15),
             de_weight=getattr(params, "DEweight", 50),
             prior_weight=getattr(params, "PriorDrawWeight", 10),
+            ind_weight=getattr(params, "IndWeight",
+                               skw.get("IndWeight", 0)),
             cov_update=getattr(params, "covUpdate", 1000) or 1000,
             write_hot_chains=bool(getattr(
                 params, "writeHotChains",

@@ -78,6 +78,23 @@ class TestPTMCMC:
                                    atol=0.15)
         np.testing.assert_allclose(post.std(0), [0.3, 0.7, 1.1], rtol=0.35)
 
+    def test_independence_jump_recovery(self, tmp_path):
+        # ensemble-fitted independence proposals (ind_weight) with the
+        # exact MH correction: posterior widths must NOT inherit the
+        # proposal's 1.4x inflation (they would if qcorr were wrong),
+        # and acceptance should be O(1) once the ensemble equilibrates
+        like = GaussianLike([1.0, -2.0], [0.3, 0.7])
+        s = PTSampler(like, str(tmp_path), ntemps=1, nchains=64, seed=2,
+                      scam_weight=10, am_weight=10, de_weight=10,
+                      prior_weight=5, ind_weight=65)
+        st = s.sample(3000, resume=False, verbose=False, block_size=500)
+        chain = np.loadtxt(tmp_path / "chain_1.txt")
+        post = chain[len(chain) // 4:, :like.ndim]
+        np.testing.assert_allclose(post.mean(0), [1.0, -2.0], atol=0.1)
+        np.testing.assert_allclose(post.std(0), [0.3, 0.7], rtol=0.15)
+        acc = st.accepted[:64].mean() / st.step
+        assert acc > 0.25
+
     def test_chain_contract(self, tmp_path):
         like = GaussianLike([0.0], [1.0])
         s = PTSampler(like, str(tmp_path), ntemps=1, nchains=4, seed=0,

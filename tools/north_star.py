@@ -95,6 +95,22 @@ LEGS = {
     # overshoot convergence and inflate cpu.steps (and with it ref_wall)
     "cpu": dict(nchains=4, gram_mode="f64", check_every=500,
                 block_size=None),
+    # TPU-native pipeline leg: the framework's intended device operating
+    # mode rather than the reference algorithm transplanted. ADVI warm
+    # start (chains drawn from the variational fit, z-space draws
+    # INFLATED so the start is overdispersed and R-hat stays meaningful)
+    # kills the init-bias transient that makes the vanilla device leg
+    # R-hat-bound at ~1e5 sequential steps; a single cold temperature
+    # (the posterior is unimodal — tempering buys nothing and doubles
+    # eval cost); and ensemble-fitted independence proposals (exact MH)
+    # convert the 256-walker batch into an O(1)-acceptance proposal that
+    # decorrelates chains in a handful of steps. Validated downstream by
+    # posterior match (means AND widths) against the f64 CPU leg.
+    "pipeline": dict(nchains=256, gram_mode="split", check_every=100,
+                     block_size=100, ntemps=1, scam_weight=15,
+                     am_weight=15, de_weight=20, prior_weight=2,
+                     ind_weight=48, ind_inflate=1.4,
+                     advi=dict(steps=600, mc=32, inflate=2.0)),
 }
 
 # everything that defines the measurement besides the per-leg configs;
@@ -158,8 +174,41 @@ def run_leg(name):
         with open(wall_path) as fh:
             prior_wall = json.load(fh)
 
-    sampler = PTSampler(like, outdir, ntemps=2,
-                        nchains=cfg["nchains"], seed=0)
+    opts = dict(ntemps=cfg.get("ntemps", 2), nchains=cfg["nchains"],
+                seed=0)
+    for k in ("scam_weight", "am_weight", "de_weight", "prior_weight",
+              "ind_weight", "ind_inflate"):
+        if k in cfg:
+            opts[k] = cfg[k]
+
+    advi_s = 0.0
+    if cfg.get("advi") and not os.path.exists(
+            os.path.join(outdir, "state.npz")):
+        # warm start: part of the measured pipeline, so its FULL wall
+        # (including its own jit compile) counts toward both clocks —
+        # the conservative accounting. Skipped on resume (a loaded
+        # checkpoint ignores init_x; refitting would double-charge).
+        import jax
+        import jax.numpy as jnp
+
+        from enterprise_warp_tpu.samplers.vi import fit_advi
+        acfg = cfg["advi"]
+        t1 = time.perf_counter()
+        fit = fit_advi(like, steps=acfg["steps"], mc=acfg["mc"], seed=0)
+        rng = np.random.default_rng(3)
+        z = (fit["z_mu"] + acfg["inflate"] * np.exp(fit["z_log_sig"])
+             * rng.standard_normal((opts["ntemps"] * opts["nchains"],
+                                    like.ndim)))
+        opts["init_x"] = np.asarray(jax.vmap(
+            lambda zz: like.from_unit(jax.nn.sigmoid(zz)))(
+                jnp.asarray(z)))
+        opts["init_cov"] = np.cov(np.asarray(fit["samples"]).T)
+        advi_s = time.perf_counter() - t1
+        prior_wall["wall_s"] += advi_s
+        prior_wall["steady_wall_s"] += advi_s
+        print(f"  advi warm start: {advi_s:.1f}s", flush=True)
+
+    sampler = PTSampler(like, outdir, **opts)
 
     def checkpoint_wall(steps, wall_s, steady_wall_s):
         # persist the attempt's wall-clock at every check, so a killed
@@ -186,13 +235,13 @@ def run_leg(name):
     posterior = {k: {"mean": v["mean"], "std": v["std"]}
                  for k, v in rep.summary.items() if not k.startswith("_")}
     return dict(
+        cfg,   # full leg config echoed so the stale-config check works
         leg=name, platform=jax.devices()[0].platform,
-        nchains=cfg["nchains"], gram_mode=cfg["gram_mode"],
-        check_every=cfg["check_every"], block_size=cfg["block_size"],
         converged=rep.converged, steps=rep.steps,
         wall_s=round(wall_s, 2),
         steady_wall_s=round(steady_wall_s, 2),
         build_s=round(build_s, 2),
+        advi_s=round(advi_s, 2),
         attempts=prior_wall["attempts"] + 1,
         rhat_max=round(rep.rhat_max, 4), ess_min=round(rep.ess_min, 1),
         evals=rep.steps * sampler.W,
@@ -351,8 +400,8 @@ def _drive_leg(name, cmd, env):
                                f"{MAX_ATTEMPTS} attempts")
         t0 = time.time()
         while time.time() - t0 < PROBE_WAIT_S:
-            if _device_reachable(env,
-                                 require_accelerator=(name == "device")):
+            if _device_reachable(env, require_accelerator=(
+                    name in ("device", "pipeline"))):
                 break
             print(f"[{name} leg] device unreachable; retrying probe in "
                   "120s", flush=True)
@@ -403,10 +452,11 @@ def run_legs(which):
     """Run the named legs in subprocesses, merging results into
     NORTH_STAR.partial.json; assemble NORTH_STAR.json once all three
     (device, cpu, scalar) are present."""
-    bad = [n for n in which if n not in ("device", "cpu", "scalar")]
+    bad = [n for n in which
+           if n not in ("device", "cpu", "scalar", "pipeline")]
     if bad:
         raise SystemExit(f"unknown leg(s) {bad}; "
-                         "valid: device, cpu, scalar")
+                         "valid: device, cpu, scalar, pipeline")
     out = {}
     if os.path.exists(PARTIAL):
         try:
@@ -420,10 +470,10 @@ def run_legs(which):
                   "changed)")
             out = {}
             # the resume dirs hold old-definition state too
-            for name in ("device", "cpu"):
+            for name in ("device", "cpu", "pipeline"):
                 shutil.rmtree(leg_dir(name), ignore_errors=True)
         # drop legs recorded under a different per-leg configuration
-        for name in ("device", "cpu"):
+        for name in ("device", "cpu", "pipeline"):
             leg = out.get(name)
             if leg is not None and any(
                     leg.get(k) != v for k, v in LEGS[name].items()):
@@ -435,9 +485,9 @@ def run_legs(which):
     out["meta"] = META
 
     for name in which:
-        if name in ("device", "cpu"):
-            env = dict(os.environ) if name == "device" else _cpu_env()
-            if name == "device":
+        if name in ("device", "cpu", "pipeline"):
+            env = _cpu_env() if name == "cpu" else dict(os.environ)
+            if name != "cpu":
                 env["PYTHONPATH"] = REPO + os.pathsep + \
                     env.get("PYTHONPATH", "")
             cmd = [sys.executable, os.path.abspath(__file__), "leg", name]
@@ -471,17 +521,27 @@ def run_legs(which):
     return out
 
 
+def _posterior_match(leg, cpu_leg):
+    """Worst mean shift (in pooled sigma) and worst width ratio of a
+    device-side leg's posterior against the f64 CPU leg's. The width
+    check matters most for warm-started legs: chains that never
+    decorrelated from a too-narrow variational init would pass a
+    means-only test with understated errors."""
+    worst_mean, worst_ratio = 0.0, 1.0
+    for k, d in leg["posterior"].items():
+        c = cpu_leg["posterior"][k]
+        s = max(d["std"], c["std"], 1e-12)
+        worst_mean = max(worst_mean, abs(d["mean"] - c["mean"]) / s)
+        r = d["std"] / max(c["std"], 1e-12)
+        worst_ratio = max(worst_ratio, r, 1.0 / max(r, 1e-12))
+    match = worst_mean <= 0.25 and worst_ratio <= 1.25
+    return match, round(worst_mean, 3), round(worst_ratio, 3)
+
+
 def assemble(out):
     scalar_steps_per_s = out["scalar_steps_per_s"]
-    # posterior match: means within a fraction of the pooled std
-    match, worst = True, 0.0
-    for k, d in out["device"]["posterior"].items():
-        c = out["cpu"]["posterior"][k]
-        s = max(d["std"], c["std"], 1e-12)
-        dev = abs(d["mean"] - c["mean"]) / s
-        worst = max(worst, dev)
-        if dev > 0.25:
-            match = False
+    match, worst, worst_ratio = _posterior_match(out["device"],
+                                                 out["cpu"])
     speedup = out["cpu"]["steady_wall_s"] / out["device"]["steady_wall_s"]
     # the reference stack runs the same algorithm at the same
     # steps-to-converge as the matched jax-CPU leg, but each step costs
@@ -492,7 +552,8 @@ def assemble(out):
         scalar_loop_steps_per_s=round(scalar_steps_per_s, 2),
         reference_shaped_wall_s=round(ref_wall, 1),
         posterior_match=match,
-        worst_mean_shift_sigma=round(worst, 3),
+        worst_mean_shift_sigma=worst,
+        worst_std_ratio=worst_ratio,
         speedup_vs_own_cpu=round(speedup, 2),
         speedup_vs_reference_shape=round(
             ref_wall / out["device"]["steady_wall_s"], 2),
@@ -501,12 +562,32 @@ def assemble(out):
         north_star_target=30.0,
         north_star_met=bool(
             ref_wall / out["device"]["steady_wall_s"] >= 30.0 and match))
+    if "pipeline" in out:
+        # the TPU-native operating mode (ADVI warm start + single-rung
+        # ensemble-independence sampler): the vanilla 'device' leg above
+        # answers "same algorithm, faster silicon?"; this one answers
+        # "what does the framework actually deliver end to end?" — the
+        # posterior-match gate (means AND widths vs the f64 CPU leg) is
+        # what keeps the warm start honest.
+        p = out["pipeline"]
+        pmatch, pworst, pratio = _posterior_match(p, out["cpu"])
+        pspeed = ref_wall / p["steady_wall_s"]
+        result.update(
+            pipeline=p,
+            pipeline_posterior_match=pmatch,
+            pipeline_worst_mean_shift_sigma=pworst,
+            pipeline_worst_std_ratio=pratio,
+            pipeline_speedup_vs_reference_shape=round(pspeed, 2),
+            pipeline_speedup_vs_own_cpu=round(
+                out["cpu"]["steady_wall_s"] / p["steady_wall_s"], 2),
+            north_star_met=bool(result["north_star_met"]
+                                or (pspeed >= 30.0 and pmatch)))
     final = os.path.join(REPO, "NORTH_STAR.json")
     with open(final + ".tmp", "w") as fh:
         json.dump(result, fh, indent=1)
     os.replace(final + ".tmp", final)
     print(json.dumps({k: v for k, v in result.items()
-                      if k not in ("device", "cpu")}))
+                      if k not in ("device", "cpu", "pipeline")}))
     return result
 
 
