@@ -114,7 +114,8 @@ def time_device(like, thetas, reps=REPS, trials=3):
 
 
 def main():
-    device_ok = probe_device()
+    device_ok = not os.environ.get("EWT_BENCH_FORCE_CPU") \
+        and probe_device()
     if not device_ok:
         force_cpu()
         print("# device probe FAILED — falling back to jax-CPU so the "
@@ -130,7 +131,20 @@ def main():
     thetas = like.sample_prior(rng, BATCH)
 
     # --- device throughput (batched, jit'd) ---------------------------- #
-    device_eps = time_device(like, thetas)
+    try:
+        device_eps = time_device(like, thetas)
+    except Exception as e:   # noqa: BLE001
+        if os.environ.get("EWT_BENCH_FORCE_CPU"):
+            raise   # already CPU-forced: not a tunnel problem, surface it
+        # tunnel dropped between the probe and the timing loop: the jax
+        # backend is already bound to the dead device, so re-exec this
+        # script CPU-forced — a degraded record beats an rc=1 crash
+        print(f"# device lost mid-headline ({type(e).__name__}); "
+              "re-running CPU-forced", file=sys.stderr)
+        env = dict(os.environ, EWT_BENCH_FORCE_CPU="1")
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
 
     # --- 1-core pure-numpy CPU reference (one theta at a time) --------- #
     basis_terms = [b for b in terms if hasattr(b, "F")]
@@ -184,19 +198,28 @@ def main():
     from enterprise_warp_tpu.sim.noise import make_fake_pulsar
     sweep = ((334, 20, 256), (334, 20, 4096), (1024, 30, 1024),
              (4096, 50, 1024), (32768, 50, 256)) if device_ok else ()
+    sweep_aborted = None
     for ntoa_s, nfreq_s, batch_s in sweep:
-        p = make_fake_pulsar(name="B", ntoa=ntoa_s,
-                             backends=("X", "Y"),
-                             freqs_mhz=(1400.0,), seed=3)
-        p.residuals = p.toaerrs * np.random.default_rng(3).standard_normal(
-            ntoa_s)
-        m = StandardModels(psr=p)
-        tl = TermList(p, [m.efac("by_backend"),
-                          m.spin_noise(f"powerlaw_{nfreq_s}_nfreqs"),
-                          m.dm_noise(f"powerlaw_{nfreq_s}_nfreqs")])
-        lk = build_pulsar_likelihood(p, tl)
-        th = lk.sample_prior(np.random.default_rng(4), batch_s)
-        eps = time_device(lk, th, reps=5)
+        try:
+            p = make_fake_pulsar(name="B", ntoa=ntoa_s,
+                                 backends=("X", "Y"),
+                                 freqs_mhz=(1400.0,), seed=3)
+            p.residuals = p.toaerrs * \
+                np.random.default_rng(3).standard_normal(ntoa_s)
+            m = StandardModels(psr=p)
+            tl = TermList(p, [m.efac("by_backend"),
+                              m.spin_noise(f"powerlaw_{nfreq_s}_nfreqs"),
+                              m.dm_noise(f"powerlaw_{nfreq_s}_nfreqs")])
+            lk = build_pulsar_likelihood(p, tl)
+            th = lk.sample_prior(np.random.default_rng(4), batch_s)
+            eps = time_device(lk, th, reps=5)
+        except Exception as e:   # noqa: BLE001 — tunnel drop mid-sweep
+            # the sweep is diagnostics; a dropped tunnel here must not
+            # forfeit the already-measured headline record (round-3
+            # failure mode: rc=1 meant NO perf record for the round)
+            sweep_aborted = f"{type(e).__name__}: {e}"[:200]
+            print(f"# sweep aborted ({sweep_aborted})", file=sys.stderr)
+            break
         print(f"# sweep ntoa={ntoa_s:5d} nbasis={4*nfreq_s:3d} "
               f"batch={batch_s:5d}: {eps:9.0f} evals/s", file=sys.stderr)
 
@@ -212,6 +235,8 @@ def main():
         out["device_unavailable"] = True
         out["unit"] = "evals/s (jax-CPU fallback, device tunnel down; " \
             "batch=%d, ntoa=334, nbasis=80+tm)" % BATCH
+    if sweep_aborted:
+        out["sweep_aborted"] = sweep_aborted
     # echo the convergence-gated sampling measurement when it exists
     # (tools/north_star.py writes NORTH_STAR.json)
     ns_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -286,13 +311,24 @@ def config_benches():
     def run(name, like, batch, note, seed=3):
         if not device_ok:
             batch = min(batch, 64)   # keep the fallback figure cheap
-        th = moderate_theta(like, seed=seed, batch=batch)
-        t0 = time.perf_counter()
-        o = like.loglike_batch(th)
-        jax.block_until_ready(o)
-        compile_s = time.perf_counter() - t0
-        eps = time_device(like, th, reps=5 if device_ok else 2,
-                          trials=3 if device_ok else 1)
+        try:
+            th = moderate_theta(like, seed=seed, batch=batch)
+            t0 = time.perf_counter()
+            o = like.loglike_batch(th)
+            jax.block_until_ready(o)
+            compile_s = time.perf_counter() - t0
+            eps = time_device(like, th, reps=5 if device_ok else 2,
+                              trials=3 if device_ok else 1)
+        except Exception as e:   # noqa: BLE001 — tunnel drop mid-config
+            # record the blocker and keep going: later configs may be
+            # cheap enough to survive a flaky tunnel, and the artifact
+            # must say WHY a number is missing either way
+            out[name] = {"blocked":
+                         f"{type(e).__name__}: {e}"[:200]}
+            print(f"# config {name} blocked: {type(e).__name__}",
+                  file=sys.stderr)
+            flush()
+            return
         out[name] = dict(evals_per_s=round(eps, 1), batch=batch,
                          compile_s=round(compile_s, 1), note=note)
         print(f"# config {name}: {eps:.1f} evals/s (batch={batch}, "

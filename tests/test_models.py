@@ -57,6 +57,7 @@ class TestVocabulary:
             "J1832-0836_red_noise_log10_A", "J1832-0836_red_noise_gamma",
             "J1832-0836_dm_gp_log10_A", "J1832-0836_dm_gp_gamma"]
 
+    @pytest.mark.slow
     def test_loglike_finite_and_batch(self, j1832):
         like = build_pulsar_likelihood(j1832, default_model_terms(j1832))
         rng = np.random.default_rng(0)
@@ -231,6 +232,7 @@ class TestSampledTimingModel:
         # noise first, tmparams appended (pars.txt order)
         assert ls.param_names[:lm.ndim] == lm.param_names
 
+    @pytest.mark.slow
     def test_marginalized_equals_laplace_of_sampled(self, fake_psr):
         """The analytic TM marginalization must equal the (exact, since
         the sampled likelihood is quadratic in dp) Gaussian integral of

@@ -214,6 +214,7 @@ class TestJointOracle:
                     - dense_joint_oracle(psrs, gwb_terms(psrs), tm2))
         assert np.isclose(d_kernel, d_oracle, rtol=rtol, atol=1e-4)
 
+    @pytest.mark.slow
     def test_finite_and_batched(self):
         psrs = pta_with_residuals()
         like = build_pta_likelihood(psrs, gwb_terms(psrs))
@@ -359,6 +360,7 @@ class TestSchurPath:
 
 
 class TestMeshSharding:
+    @pytest.mark.slow
     def test_mesh_matches_single_device(self):
         """8-way virtual mesh (pulsar count padded 3 -> 8) must reproduce
         the unsharded value bit-for-bit up to collective reduction order."""
@@ -470,6 +472,7 @@ class TestToaSharding:
         return build_pulsar_likelihood(psr, terms, gram_mode=gram_mode,
                                        mesh=mesh)
 
+    @pytest.mark.slow
     def test_sharded_matches_unsharded(self, monkeypatch):
         # isolate SHARDING: the unsharded build would otherwise take the
         # pair-program fast path, whose different (equally valid)

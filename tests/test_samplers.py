@@ -112,6 +112,7 @@ class TestPTMCMC:
         cov = np.load(tmp_path / "cov.npy")
         assert cov.shape == (1, 1)
 
+    @pytest.mark.slow
     def test_resume_continues(self, tmp_path):
         like = GaussianLike([0.0, 0.0], [1.0, 1.0])
         s = PTSampler(like, str(tmp_path), ntemps=1, nchains=4, seed=3,
@@ -149,6 +150,7 @@ class TestLadderAdaptation:
         rates = st.swaps_accepted / st.swaps_proposed
         assert np.mean(rates) < 0.98
 
+    @pytest.mark.slow
     def test_ladder_persists_through_resume(self, tmp_path):
         like = GaussianLike([0.0], [0.5])
         s = PTSampler(like, str(tmp_path), ntemps=3, nchains=4, seed=2,
@@ -238,6 +240,7 @@ class TestConvergence:
         flat = rep2.chains.reshape(-1, like.ndim)
         np.testing.assert_allclose(flat.mean(0), [0.5, -1.0], atol=0.15)
 
+    @pytest.mark.slow
     def test_resume_rewinds_checkpoint_when_chain_short(self, tmp_path):
         """Dropped/partial chain lines can leave FEWER complete steps on
         disk than the checkpoint counter. Resume must rewind the
@@ -268,6 +271,7 @@ class TestConvergence:
         assert len(chain) == rep.steps * 4      # contract restored
         assert np.load(tmp_path / "state.npz")["step"] == rep.steps
 
+    @pytest.mark.slow
     def test_resume_truncates_hot_chains(self, tmp_path):
         """Hot-rung files are appended in the same blocks as the cold
         file; a kill between the two appends must not leave them out of

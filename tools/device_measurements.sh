@@ -35,6 +35,11 @@ python tools/north_star.py legs device > "$OUT/north_star.log" 2>&1
 rc=$?
 echo "$(date +%H:%M:%S) north_star device leg rc=$rc" >> "$OUT/log"
 
+probe || { echo "$(date +%H:%M:%S) tunnel lost before pipeline" >> "$OUT/log"; exit 1; }
+python tools/north_star.py legs pipeline > "$OUT/north_star_pipeline.log" 2>&1
+rc=$?
+echo "$(date +%H:%M:%S) north_star pipeline leg rc=$rc" >> "$OUT/log"
+
 probe || { echo "$(date +%H:%M:%S) tunnel lost before bench" >> "$OUT/log"; exit 1; }
 python bench.py > "$OUT/bench_headline.json" 2> "$OUT/bench_headline.err"
 rc=$?
@@ -54,6 +59,11 @@ probe || exit 1
 python tools/profile_joint.py > "$OUT/profile_joint.log" 2>&1
 rc=$?
 echo "$(date +%H:%M:%S) profile_joint rc=$rc" >> "$OUT/log"
+
+probe || exit 1
+python tools/step_latency.py > "$OUT/step_latency.jsonl" 2> "$OUT/step_latency.err"
+rc=$?
+echo "$(date +%H:%M:%S) step_latency rc=$rc" >> "$OUT/log"
 
 probe || exit 1
 python tools/roofline.py > "$OUT/roofline.log" 2>&1

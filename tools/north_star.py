@@ -485,6 +485,21 @@ def run_legs(which):
     out["meta"] = META
 
     for name in which:
+        if name == "scalar" and "scalar_steps_per_s" in out:
+            print("=== scalar loop already recorded; skipping ===",
+                  flush=True)
+            continue
+        if name in out and name != "scalar" \
+                and out[name].get("converged"):
+            # already measured under the current configuration (stale
+            # results were dropped above) — a tunnel drop LATER in the
+            # chain must not re-buy a completed multi-hour leg. A
+            # non-converged record does NOT count: it must stay
+            # re-measurable (run_leg resumes nothing — the resume dir
+            # is gone — so it restarts that leg from scratch).
+            print(f"=== {name} leg already recorded; skipping ===",
+                  flush=True)
+            continue
         if name in ("device", "cpu", "pipeline"):
             env = _cpu_env() if name == "cpu" else dict(os.environ)
             if name != "cpu":

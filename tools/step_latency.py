@@ -1,0 +1,68 @@
+"""Per-step latency of the PT-MCMC block at north-star shapes.
+
+The north-star wall-clock is (sequential steps to converge) x (per-step
+latency); the pipeline leg attacks the first factor, this script
+measures the second — where the remaining time goes once the Gram stage
+is a single pair-program matmul (ops/kernel.py:build_pair_program).
+
+Sweeps sampler configurations on the flagship J1832-scale problem and
+prints one JSON line per point:
+  {"nchains": N, "ntemps": T, "blocked_chol": 0|1, "ind": 0|1,
+   "step_ms": ..., "evals_per_s": ...}
+
+Usage: python tools/step_latency.py [--quick]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_problem(gram_mode="split"):
+    from tools.north_star import build_problem as bp
+    return bp(gram_mode)
+
+
+def time_config(like, nchains, ntemps, ind, steps=200):
+    import numpy as np
+
+    from enterprise_warp_tpu.samplers.ptmcmc import PTSampler
+    with tempfile.TemporaryDirectory() as d:
+        kw = dict(ntemps=ntemps, nchains=nchains, seed=0)
+        if ind:
+            kw.update(ind_weight=48, scam_weight=15, am_weight=15,
+                      de_weight=20, prior_weight=2)
+        s = PTSampler(like, d, **kw)
+        # one warmup block compiles; the timed block reuses the cache
+        s.sample(steps, resume=False, verbose=False, block_size=steps)
+        t0 = time.perf_counter()
+        s.sample(2 * steps, resume=True, verbose=False,
+                 block_size=steps)
+        dt = time.perf_counter() - t0
+        del s
+    step_ms = 1e3 * dt / steps
+    return dict(nchains=nchains, ntemps=ntemps,
+                blocked_chol=int(os.environ.get("EWT_BLOCKED_CHOL",
+                                                "0")),
+                ind=int(ind), step_ms=round(step_ms, 3),
+                evals_per_s=round(nchains * ntemps / (dt / steps), 1))
+
+
+def main():
+    quick = "--quick" in sys.argv
+    like = build_problem("split")
+    grid = ([(256, 1, 1), (256, 2, 0)] if quick else
+            [(256, 1, 0), (256, 1, 1), (256, 2, 0), (512, 1, 1),
+             (1024, 1, 1), (64, 1, 1)])
+    for nchains, ntemps, ind in grid:
+        r = time_config(like, nchains, ntemps, ind)
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()
