@@ -34,6 +34,9 @@ def import_custom_models(py_path: str, class_name: str):
 
 def main(argv=None):
     import argparse
+
+    from .utils.compilecache import enable_compilation_cache
+    enable_compilation_cache()
     # the reference option set (config.parse_commandline) extended with the
     # custom-models hook and the precision mode
     parser = argparse.ArgumentParser(description="enterprise_warp_tpu run")

@@ -82,6 +82,35 @@ class PulsarLikelihood(PriorMixin):
         self.gram_mode = gram_mode
         self.loglike = jax.jit(loglike_fn)
         self.loglike_batch = jax.jit(jax.vmap(loglike_fn))
+        self.noise_pairs = _noise_slide_pairs(psr, self.param_names)
+
+
+def _noise_slide_pairs(psr, names):
+    """``(i_efac, i_equad, mean toaerr^2)`` triples for every backend
+    whose efac AND equad are both sampled — metadata consumed by the
+    sampler's noise-budget slide proposal (``samplers/ptmcmc.py``, the
+    ``ns`` family). The pair's total white variance
+    ``efac^2 sigma_bar^2 + 10^(2 equad)`` is what the data constrain;
+    the split between the two parameters is nearly flat, and the slide
+    proposal moves along that degeneracy curve in one step."""
+    out = []
+    err2 = np.asarray(psr.toaerrs) ** 2
+    flags = np.asarray(psr.backend_flags)
+    for i, n in enumerate(names):
+        if not n.endswith("_efac"):
+            continue
+        stem = n[: -len("_efac")]
+        partner = stem + "_log10_equad"
+        if partner not in names:
+            continue
+        j = names.index(partner)
+        key = stem[len(psr.name) + 1:] \
+            if stem.startswith(psr.name + "_") else stem
+        mask = flags == key
+        s2 = float(err2[mask].mean()) if mask.any() else \
+            float(err2.mean())
+        out.append((i, j, s2))
+    return out
 
 
 def _resolve_params(all_params, fixed_values):

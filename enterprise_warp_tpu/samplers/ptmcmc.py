@@ -83,16 +83,57 @@ class PTSampler:
                  tmax=None, init_cov=None, burn=0, adapt_ladder=True,
                  ladder_t0=1000.0, swap_target=0.25,
                  write_hot_chains=False, init_x=None,
-                 ind_weight=0, ind_inflate=1.4):
+                 ind_weight=0, ind_inflate=1.4,
+                 cg_weight=0, cg_k=3, cg_group_frac=0.5,
+                 kde_weight=0, kde_bw=None, ns_weight=0):
         self.like = like
         self.outdir = outdir
         self.ntemps = ntemps
         self.nchains = nchains
         self.W = ntemps * nchains
         self.ndim = like.ndim
+        # noise-budget slide (family 7): moves ALONG each backend's
+        # efac/equad degeneracy curve v = efac^2 sigma_bar^2 + equad^2
+        # (redraw the equad fraction of v uniformly, exact Jacobian
+        # correction). The two "modes" of the white-noise posterior —
+        # efac-dominated bulk and equad-dominated slab — are the two
+        # ends of this curve, so one slide crosses what random-walk
+        # moves need ~1000 steps to cross through the entropic neck.
+        # Auto-disabled when the likelihood exposes no noise_pairs.
+        self._ns_pairs = list(getattr(like, "noise_pairs", None) or [])
+        if not self._ns_pairs:
+            ns_weight = 0
+        # per-pair equad prior bounds for the global (uniform-in-q)
+        # slide branch; the bulk's equad marginal is log-flat, so
+        # proposing q' uniformly matches it far better than uniform-f
+        # (measured: 0.15 -> ~0.6 global acceptance)
+        self._ns_qb = []
+        for _, iq, _ in self._ns_pairs:
+            pr = like.params[iq].prior
+            self._ns_qb.append((float(getattr(pr, "lo", -10.0)),
+                                float(getattr(pr, "hi", -5.0))))
         weights = np.array([scam_weight, am_weight, de_weight,
-                            prior_weight, ind_weight], float)
+                            prior_weight, ind_weight, cg_weight,
+                            kde_weight, ns_weight], float)
         self.jump_probs = weights / weights.sum()
+        # ensemble-KDE subspace independence: propose a (structured)
+        # subset's values from a kernel-density estimate over the
+        # block-frozen cold-walker cloud, with the exact mixture-density
+        # MH correction. Unlike every Gaussian-fit family, the KDE
+        # carries the ensemble's MULTIMODAL structure — e.g. the
+        # per-backend efac/equad degeneracy slab — so bulk<->slab
+        # teleports happen at the modes' mass ratio instead of via rare
+        # random-walk passages through the entropic neck.
+        self.kde_bw = kde_bw            # None = Silverman per subset k
+        # conditional-Gibbs subset size: a FULL-vector independence
+        # proposal pays the fit-mismatch penalty in all ndim dimensions
+        # at once (measured acceptance ~2% on the flagship); redrawing
+        # only cg_k dimensions from the ensemble-fitted Gaussian's exact
+        # CONDITIONAL given the rest pays it in cg_k dimensions, keeping
+        # acceptance O(1) while still moving likelihood-constrained
+        # directions the single-dim prior draw cannot
+        self.cg_k = int(min(max(cg_k, 1), self.ndim))
+        self.cg_group_frac = float(cg_group_frac)
         # ensemble-fitted independence proposals: N(mean, inflate^2 * cov)
         # refit to the cold-walker ensemble every block. With a large
         # walker batch near equilibrium the proposal approximates the
@@ -128,10 +169,21 @@ class PTSampler:
             lambda t: like.log_prior(t)))
         self._compiled_block = None
         self._block_steps = -1
+        # per-family (scam, am, de, prior, ind, cgibbs, kde, ns)
+        # cold-rung counters — session-local tuning observability, not
+        # checkpointed
+        self.fam_accept = np.zeros(8)
+        self.fam_propose = np.zeros(8)
         os.makedirs(outdir, exist_ok=True)
 
     # ---------------- initialization / resume -------------------------- #
     def _fresh_state(self):
+        if getattr(self, "_anneal_state", None) is not None:
+            st = self._anneal_state
+            # one-shot: a later fresh start must re-anneal (or draw from
+            # the prior), not silently reuse the consumed state object
+            self._anneal_state = None
+            return st
         rng = np.random.default_rng(self.seed)
         x0 = self.like.sample_prior(rng, self.W)
         if self.init_x is not None:
@@ -227,13 +279,26 @@ class PTSampler:
         swap_every = self.swap_every
         emit_hot = self.write_hot
         use_ind = bool(self.jump_probs[4] > 0)
+        use_cg = bool(self.jump_probs[5] > 0)
+        use_kde = bool(self.jump_probs[6] > 0)
+        use_ns = bool(self.jump_probs[7] > 0)
+        kdims = self.cg_k
+        group_frac = self.cg_group_frac
+        if use_ns:
+            n_pairs = len(self._ns_pairs)
+            pair_i = jnp.asarray([p[0] for p in self._ns_pairs])
+            pair_j = jnp.asarray([p[1] for p in self._ns_pairs])
+            pair_s2 = jnp.asarray([p[2] for p in self._ns_pairs])
+            pair_qlo = jnp.asarray([b[0] for b in self._ns_qb])
+            pair_qhi = jnp.asarray([b[1] for b in self._ns_qb])
 
         def one_step(carry, step_idx):
             x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop, \
+                fam_acc, fam_prop, \
                 eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL, \
-                temps, consts = carry
-            key, k1, k2, k3, k4, k5, k6, k7, k8, k9 = \
-                jax.random.split(key, 10)
+                lam, cg_rows, kde_pts, kde_bw, temps, consts = carry
+            key, k1, k2, k3, k4, k5, k6, k7, k8, k9, k10, k11 = \
+                jax.random.split(key, 12)
 
             # --- proposals (all four families, select per walker) -----
             z = jax.random.normal(k1, (W, nd))
@@ -269,6 +334,136 @@ class PTSampler:
                 ind = ind_mean[None, :] + \
                     jax.random.normal(k9, (W, nd)) @ ind_L.T
                 prop = jnp.where((choice == 4)[:, None], ind, prop)
+            if use_cg:
+                # conditional-Gibbs: redraw a kdims-subset S from the
+                # ensemble-fitted Gaussian's exact conditional given
+                # the other coordinates, via the precision matrix:
+                #   x_S | x_rest ~ N(mu_S - Lam_SS^-1 b, Lam_SS^-1),
+                #   b = Lam_{S,rest} (x_rest - mu_rest)
+                # S is drawn either uniformly at random or as a
+                # CORRELATION-STRUCTURED block (a random dim plus its
+                # strongest ensemble-covariance partners, host-built
+                # ``cg_rows``): parameters that trade off — the
+                # per-backend efac/equad noise ridge — must move
+                # JOINTLY, and random subsets rarely contain the
+                # coupled pair
+                def cg_one(x_w, pkey, zkey):
+                    ku, kj, kp = jax.random.split(pkey, 3)
+                    S_rand = jax.random.permutation(kp, nd)[:kdims]
+                    j = jax.random.randint(kj, (), 0, nd)
+                    S = jnp.where(
+                        jax.random.uniform(ku) < group_frac,
+                        cg_rows[j], S_rand)
+                    d = x_w - ind_mean
+                    lam_rows = lam[S]                  # (k, nd)
+                    lam_ss = lam_rows[:, S]            # (k, k)
+                    b = lam_rows @ d - lam_ss @ d[S]
+                    # conditional cov = lam_ss^-1 via Cholesky only (LU
+                    # inverse is unsupported/slow on TPU): with
+                    # lam_ss = Lk Lk^T, a draw is m + Lk^-T z and the
+                    # log-density quadratic is |Lk^T (v - m)|^2
+                    Lk = jnp.linalg.cholesky(lam_ss)
+                    u1 = jax.scipy.linalg.solve_triangular(
+                        Lk, b, lower=True)
+                    m = ind_mean[S] - jax.scipy.linalg.solve_triangular(
+                        Lk.T, u1, lower=False)
+                    z = jax.random.normal(zkey, (kdims,))
+                    xs = m + jax.scipy.linalg.solve_triangular(
+                        Lk.T, z, lower=False)
+                    # reverse/forward density ratio for the S block
+                    # (the conditional's parameters depend only on the
+                    # UNCHANGED coordinates, so they are shared)
+                    r_old = Lk.T @ (x_w[S] - m)
+                    qc = 0.5 * (jnp.sum(z ** 2) - jnp.sum(r_old ** 2))
+                    return x_w.at[S].set(xs), qc
+                cg_prop, cg_qc = jax.vmap(cg_one)(
+                    x, jax.random.split(k10, W),
+                    jax.random.split(k11, W))
+                prop = jnp.where((choice == 5)[:, None], cg_prop, prop)
+            if use_kde:
+                # ensemble-KDE subspace independence (see __init__):
+                # draw the subset from the frozen cloud's KDE, correct
+                # with the exact mixture density at old and new points
+                key, km, kz, ks = jax.random.split(key, 4)
+
+                def kde_logq(v_S, S):
+                    d = (v_S[None, :] - kde_pts[:, S]) / kde_bw[S]
+                    return jax.scipy.special.logsumexp(
+                        -0.5 * jnp.sum(d * d, axis=1)) \
+                        - jnp.log(kde_pts.shape[0]) \
+                        - jnp.sum(jnp.log(kde_bw[S]))
+
+                def kde_one(x_w, pkey, mkey, zkey):
+                    ku, kj, kp = jax.random.split(pkey, 3)
+                    S_rand = jax.random.permutation(kp, nd)[:kdims]
+                    j = jax.random.randint(kj, (), 0, nd)
+                    S = jnp.where(
+                        jax.random.uniform(ku) < group_frac,
+                        cg_rows[j], S_rand)
+                    m = jax.random.randint(mkey, (), 0,
+                                           kde_pts.shape[0])
+                    xs = kde_pts[m, S] + kde_bw[S] * \
+                        jax.random.normal(zkey, (kdims,))
+                    qc = kde_logq(x_w[S], S) - kde_logq(xs, S)
+                    return x_w.at[S].set(xs), qc
+                kde_prop, kde_qc = jax.vmap(kde_one)(
+                    x, jax.random.split(ks, W),
+                    jax.random.split(km, W),
+                    jax.random.split(kz, W))
+                prop = jnp.where((choice == 6)[:, None], kde_prop, prop)
+            if use_ns:
+                # noise-budget slide (see __init__): redraw the equad
+                # fraction f of a random backend's total white variance
+                # v uniformly; v is exactly preserved, the Jacobian of
+                # (efac, equad) <-> (v, f) supplies the correction, and
+                # prior bounds are enforced by the generic lnp term
+                key, kb, kf = jax.random.split(key, 3)
+
+                def ns_one(x_w, bkey, fkey):
+                    kb1, ku, kz = jax.random.split(bkey, 3)
+                    b = jax.random.randint(kb1, (), 0, n_pairs)
+                    ie, iq = pair_i[b], pair_j[b]
+                    s2 = pair_s2[b]
+                    e, q = x_w[ie], x_w[iq]
+                    Q2 = 10.0 ** (2.0 * q)
+                    v = e * e * s2 + Q2
+                    f_old = jnp.clip(Q2 / v, 1e-15, 1.0 - 1e-12)
+                    # GLOBAL branch: q' uniform over the reachable
+                    # equad range at fixed v (upper-bounded where the
+                    # whole budget is equad). The bulk's equad marginal
+                    # is log-flat, so this proposes bulk<->slab
+                    # teleports at the right measure; the (v,q)->theta
+                    # Jacobian ratio is e/e'.
+                    upper = jnp.minimum(pair_qhi[b],
+                                        0.5 * jnp.log10(v) - 1e-6)
+                    lo = jnp.minimum(pair_qlo[b], upper - 1e-6)
+                    q_glob = lo + (upper - lo) * \
+                        jax.random.uniform(fkey)
+                    f_glob = jnp.clip(10.0 ** (2.0 * q_glob) / v,
+                                      1e-15, 1.0 - 1e-12)
+                    # LOCAL branch: logit-normal slide along the curve
+                    u_loc = jax.scipy.special.logit(f_old) \
+                        + 0.8 * jax.random.normal(kz)
+                    f_loc = jnp.clip(jax.nn.sigmoid(u_loc),
+                                     1e-15, 1.0 - 1e-12)
+                    is_glob = jax.random.uniform(ku) < 0.5
+                    f = jnp.where(is_glob, f_glob, f_loc)
+                    e_new = jnp.sqrt((1.0 - f) * v / s2)
+                    q_new = 0.5 * jnp.log10(f * v)
+                    # global correction: log(e) - log(e') with the
+                    # proposal's q-range identical both ways (same v)
+                    qc_glob = jnp.log(jnp.maximum(e, 1e-30)) \
+                        - jnp.log(jnp.maximum(e_new, 1e-30))
+                    # local correction: (v,f) Jacobian + logit-normal
+                    # density, combined = 0.5 log1p(-f) - 0.5 log1p(-f0)
+                    qc_loc = 0.5 * jnp.log1p(-f) \
+                        - 0.5 * jnp.log1p(-f_old)
+                    qc = jnp.where(is_glob, qc_glob, qc_loc)
+                    return x_w.at[ie].set(e_new).at[iq].set(q_new), qc
+                ns_prop, ns_qc = jax.vmap(ns_one)(
+                    x, jax.random.split(kb, W),
+                    jax.random.split(kf, W))
+                prop = jnp.where((choice == 7)[:, None], ns_prop, prop)
 
             key, ka = jax.random.split(key)
             lnp_new = like.log_prior(prop)
@@ -291,12 +486,25 @@ class PTSampler:
                 q_ind = 0.5 * (jnp.sum(dx_new ** 2, axis=-1)
                                - jnp.sum(dx_old ** 2, axis=-1))
                 qcorr = jnp.where(choice == 4, q_ind, qcorr)
+            if use_cg:
+                qcorr = jnp.where(choice == 5, cg_qc, qcorr)
+            if use_kde:
+                qcorr = jnp.where(choice == 6, kde_qc, qcorr)
+            if use_ns:
+                qcorr = jnp.where(choice == 7, ns_qc, qcorr)
             log_ratio = (lnp_new - lnp) + (lnl_new - lnl) / temps + qcorr
             accept = jnp.log(jax.random.uniform(ka, (W,))) < log_ratio
             x = jnp.where(accept[:, None], prop, x)
             lnl = jnp.where(accept, lnl_new, lnl)
             lnp = jnp.where(accept, lnp_new, lnp)
             acc = acc + accept
+            # per-family proposal/acceptance counters (cold rung only):
+            # the tuning observable — a global acceptance rate hides a
+            # dead family behind a healthy one
+            cold_ch = choice[:nchains]
+            fam_prop = fam_prop + jnp.zeros(8).at[cold_ch].add(1.0)
+            fam_acc = fam_acc + jnp.zeros(8).at[cold_ch].add(
+                accept[:nchains].astype(jnp.float32))
 
             # --- parallel-tempering swaps every swap_every steps ------
             def do_swap(args):
@@ -349,21 +557,184 @@ class PTSampler:
             else:
                 ys = (x[:nchains], lnl[:nchains], lnp[:nchains])
             return ((x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
+                     fam_acc, fam_prop,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
-                     temps, consts), ys)
+                     lam, cg_rows, kde_pts, kde_bw, temps, consts), ys)
 
         @partial(jax.jit, static_argnames=())
         def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
+                  fam_acc, fam_prop,
                   eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
-                  temps, consts):
+                  lam, cg_rows, kde_pts, kde_bw, temps, consts):
             carry = (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
+                     fam_acc, fam_prop,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
-                     temps, consts)
+                     lam, cg_rows, kde_pts, kde_bw, temps, consts)
             carry, ys = jax.lax.scan(
                 one_step, carry, jnp.arange(nsteps))
             return (carry,) + tuple(ys)
 
         return block
+
+    # ---------------- block execution ---------------------------------- #
+    def _run_block(self, st, todo, temps=None):
+        """Advance ``st`` by ``todo`` steps through the compiled block.
+
+        Host-side per-block work: eigendecomposition of the adapted
+        covariance, ensemble fits for the independence/conditional-Gibbs
+        proposals, the device call, and the state update. ``temps``
+        overrides the ladder-derived per-walker temperatures (used by
+        :meth:`anneal_init` to run the whole ensemble tempered).
+        Returns the block's ``(positions, lnl, lnp)`` emissions."""
+        if self._compiled_block is None or self._block_steps != todo:
+            self._block = self._make_block(todo)
+            self._block_steps = todo
+            self._compiled_block = True
+
+        # eigendecomposition of the adapted covariance (host side)
+        cov = st.cov + 1e-12 * np.eye(self.ndim)
+        eigvals, eigvecs = np.linalg.eigh(cov)
+        eigvals = np.maximum(eigvals, 1e-16)
+        chol = np.linalg.cholesky(cov)
+
+        # independence proposal: refit N(mean, inflate^2 cov) to the
+        # instantaneous cold-walker cloud (at equilibrium the cloud
+        # IS a posterior sample; inflation over-covers the tails).
+        # Degenerate clouds (fresh identical walkers, tiny nchains)
+        # fall back to the adapted covariance above.
+        if self.jump_probs[4:].sum() > 0:
+            cold_x = st.x[:self.nchains]
+            ind_mean = cold_x.mean(axis=0)
+            ind_cov = cov
+            if self.nchains > 2 * self.ndim:
+                c = np.cov(cold_x.T) + 1e-12 * np.eye(self.ndim)
+                if np.all(np.isfinite(c)) and \
+                        np.linalg.eigvalsh(c)[0] > 0:
+                    ind_cov = c
+            ind_L = np.linalg.cholesky(
+                self.ind_inflate ** 2 * ind_cov)
+            ind_iL = np.linalg.inv(ind_L)
+            # UNinflated precision for the conditional-Gibbs family
+            # (the conditional should match the posterior, not an
+            # overdispersed copy; MH corrects the residual misfit)
+            lam = np.linalg.inv(ind_cov)
+            # correlation-structured Gibbs blocks: row j = dim j plus
+            # its (cg_k - 1) strongest |corr| partners in the ensemble
+            # covariance — the dims that must move jointly
+            sd = np.sqrt(np.diag(ind_cov))
+            corr = np.abs(ind_cov / np.outer(sd, sd))
+            cg_rows = np.argsort(-corr, axis=1)[:, :self.cg_k]
+            # block-frozen cloud + per-dim Silverman bandwidth for the
+            # KDE family (bandwidth from the cloud's own spread)
+            kde_pts = cold_x.copy()
+            if self.kde_bw is not None:
+                bw_fac = float(self.kde_bw)
+            else:
+                k, n = self.cg_k, max(len(kde_pts), 2)
+                bw_fac = (4.0 / (k + 2)) ** (1.0 / (k + 4)) \
+                    * n ** (-1.0 / (k + 4))
+            kde_bw = np.maximum(bw_fac * cold_x.std(axis=0), 1e-12)
+        else:
+            ind_mean = np.zeros(self.ndim)
+            ind_L = ind_iL = lam = np.eye(self.ndim)
+            cg_rows = np.tile(np.arange(self.cg_k), (self.ndim, 1))
+            kde_pts = np.zeros((1, self.ndim))
+            kde_bw = np.ones(self.ndim)
+
+        if temps is None:
+            temps = np.repeat(st.ladder, self.nchains)
+        carry, cold, cold_lnl, cold_lnp = self._block(
+            jnp.asarray(st.x), jnp.asarray(st.lnl),
+            jnp.asarray(st.lnp), jnp.asarray(st.key),
+            jnp.asarray(st.history), st.hist_len,
+            jnp.asarray(st.accepted), jnp.asarray(st.swaps_accepted),
+            jnp.asarray(st.swaps_proposed),
+            jnp.asarray(self.fam_accept),
+            jnp.asarray(self.fam_propose), jnp.asarray(eigvecs),
+            jnp.asarray(eigvals), jnp.asarray(chol),
+            jnp.asarray(ind_mean), jnp.asarray(ind_L),
+            jnp.asarray(ind_iL), jnp.asarray(lam),
+            jnp.asarray(cg_rows), jnp.asarray(kde_pts),
+            jnp.asarray(kde_bw), jnp.asarray(temps), self._consts)
+        (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
+         fam_acc, fam_prop, *_unused) = carry
+        self.fam_accept = np.asarray(fam_acc)
+        self.fam_propose = np.asarray(fam_prop)
+        st.x = np.asarray(x)
+        st.lnl = np.asarray(lnl)
+        st.lnp = np.asarray(lnp)
+        st.key = np.asarray(key)
+        st.history = np.asarray(hist)
+        st.hist_len = int(min(st.hist_len + todo, _HISTORY))
+        st.accepted = np.asarray(acc)
+        st.swaps_accepted = np.asarray(sacc, dtype=float)
+        st.swaps_proposed = np.asarray(sprop, dtype=float)
+        st.step += todo
+        return cold, cold_lnl, cold_lnp
+
+    def anneal_init(self, schedule=None, steps_per=100, resample=True,
+                    ess_frac=0.5, verbose=True):
+        """SMC-style tempered initialization of the walker ensemble.
+
+        Runs the ensemble through a decreasing likelihood-temperature
+        schedule (all walkers at the SAME temperature per stage) with
+        multinomial resampling between stages when the incremental
+        importance weights degrade, then installs the final ensemble as
+        the fresh-start state for :meth:`sample`. A ~stationary,
+        properly dispersed start removes the burn-in transient that
+        keeps R-hat elevated for thousands of steps after a point-mass
+        or fitted-Gaussian warm start — and unlike those, the tempered
+        bridge handles multimodality and non-Gaussian flat directions.
+
+        ``schedule`` defaults to a geometric ladder 64 → 1. Uses the
+        same compiled block as ``sample(block_size=steps_per)``, so with
+        matching sizes the main run pays no extra compile. No chain
+        rows are written; counters and the step count are reset so the
+        measurement starts clean. No-op when a checkpoint exists (a
+        resumed run must not re-anneal).
+
+        Intended for single-rung ensembles (``ntemps == 1``); with a
+        PT ladder the ladder itself already provides the bridge.
+        """
+        if os.path.exists(self._ckpt_path):
+            return None
+        if schedule is None:
+            schedule = (64.0, 32.0, 16.0, 8.0, 4.0, 2.0)
+        rng = np.random.default_rng(self.seed + 7)
+        st = self._fresh_state()
+        for i, T in enumerate(schedule):
+            temps = np.full(self.W, float(T))
+            cold, _, _ = self._run_block(st, int(steps_per), temps=temps)
+            # adapt the jump covariance from this stage's emissions
+            flat = np.asarray(cold)[:, :self.nchains].reshape(
+                -1, self.ndim)
+            if flat.shape[0] > 10:
+                st.cov = 0.5 * st.cov + 0.5 * np.cov(flat.T)
+            next_T = schedule[i + 1] if i + 1 < len(schedule) else 1.0
+            if resample:
+                lw = (1.0 / next_T - 1.0 / T) * st.lnl
+                lw -= lw.max()
+                w = np.exp(lw)
+                w /= w.sum()
+                ess = 1.0 / np.sum(w ** 2)
+                if ess < ess_frac * self.W:
+                    idx = rng.choice(self.W, self.W, p=w)
+                    st.x = st.x[idx]
+                    st.lnl = st.lnl[idx]
+                    st.lnp = st.lnp[idx]
+                if verbose:
+                    print(f"  anneal T={T:g}: acc_ess={ess:.0f}/"
+                          f"{self.W} maxlnl={st.lnl.max():.1f}",
+                          flush=True)
+        # the measurement starts here: reset counters and step count
+        st.accepted = np.zeros(self.W)
+        st.swaps_accepted = np.zeros(self.ntemps - 1)
+        st.swaps_proposed = np.zeros(self.ntemps - 1)
+        st.step = 0
+        self.fam_accept = np.zeros(8)
+        self.fam_propose = np.zeros(8)
+        self._anneal_state = st
+        return st
 
     # ---------------- public API --------------------------------------- #
     def sample(self, nsamp, resume=True, verbose=True, thin=1,
@@ -401,63 +772,9 @@ class PTSampler:
 
         while st.step < nsamp:
             todo = int(min(block_size, nsamp - st.step))
-            if self._compiled_block is None or \
-                    self._block_steps != todo:
-                self._block = self._make_block(todo)
-                self._block_steps = todo
-                self._compiled_block = True
-
-            # eigendecomposition of the adapted covariance (host side)
-            cov = st.cov + 1e-12 * np.eye(self.ndim)
-            eigvals, eigvecs = np.linalg.eigh(cov)
-            eigvals = np.maximum(eigvals, 1e-16)
-            chol = np.linalg.cholesky(cov)
-
-            # independence proposal: refit N(mean, inflate^2 cov) to the
-            # instantaneous cold-walker cloud (at equilibrium the cloud
-            # IS a posterior sample; inflation over-covers the tails).
-            # Degenerate clouds (fresh identical walkers, tiny nchains)
-            # fall back to the adapted covariance above.
-            if self.jump_probs[4] > 0:
-                cold_x = st.x[:self.nchains]
-                ind_mean = cold_x.mean(axis=0)
-                ind_cov = cov
-                if self.nchains > 2 * self.ndim:
-                    c = np.cov(cold_x.T) + 1e-12 * np.eye(self.ndim)
-                    if np.all(np.isfinite(c)) and \
-                            np.linalg.eigvalsh(c)[0] > 0:
-                        ind_cov = c
-                ind_L = np.linalg.cholesky(
-                    self.ind_inflate ** 2 * ind_cov)
-                ind_iL = np.linalg.inv(ind_L)
-            else:
-                ind_mean = np.zeros(self.ndim)
-                ind_L = ind_iL = np.eye(self.ndim)
-
             sacc_before = st.swaps_accepted.copy()
             sprop_before = st.swaps_proposed.copy()
-            temps = np.repeat(st.ladder, self.nchains)
-            carry, cold, cold_lnl, cold_lnp = self._block(
-                jnp.asarray(st.x), jnp.asarray(st.lnl),
-                jnp.asarray(st.lnp), jnp.asarray(st.key),
-                jnp.asarray(st.history), st.hist_len,
-                jnp.asarray(st.accepted), jnp.asarray(st.swaps_accepted),
-                jnp.asarray(st.swaps_proposed), jnp.asarray(eigvecs),
-                jnp.asarray(eigvals), jnp.asarray(chol),
-                jnp.asarray(ind_mean), jnp.asarray(ind_L),
-                jnp.asarray(ind_iL), jnp.asarray(temps), self._consts)
-            (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-             *_unused) = carry
-            st.x = np.asarray(x)
-            st.lnl = np.asarray(lnl)
-            st.lnp = np.asarray(lnp)
-            st.key = np.asarray(key)
-            st.history = np.asarray(hist)
-            st.hist_len = int(min(st.hist_len + todo, _HISTORY))
-            st.accepted = np.asarray(acc)
-            st.swaps_accepted = np.asarray(sacc, dtype=float)
-            st.swaps_proposed = np.asarray(sprop, dtype=float)
-            st.step += todo
+            cold, cold_lnl, cold_lnp = self._run_block(st, todo)
 
             # --- swap-rate-targeted ladder adaptation ----------------- #
             if self.adapt_ladder and self.ntemps > 1:
@@ -542,8 +859,13 @@ class PTSampler:
                 np.save(os.path.join(self.outdir, "cov.npy"), st.cov)
             self._save_state(st)
             if verbose:
+                fam = " ".join(
+                    f"{n}={a / max(p, 1.0):.2f}" for n, a, p in zip(
+                        ("scam", "am", "de", "pd", "ind", "cg", "kde",
+                         "ns"),
+                        self.fam_accept, self.fam_propose))
                 print(f"step {st.step}/{nsamp} acc={acc_rate:.3f} "
-                      f"swap={swap_rate:.3f} "
+                      f"swap={swap_rate:.3f} [{fam}] "
                       f"maxlnl={np.max(st.lnl):.2f}")
         return st
 
@@ -565,6 +887,12 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
             prior_weight=getattr(params, "PriorDrawWeight", 10),
             ind_weight=getattr(params, "IndWeight",
                                skw.get("IndWeight", 0)),
+            cg_weight=getattr(params, "CGWeight",
+                              skw.get("CGWeight", 0)),
+            kde_weight=getattr(params, "KDEWeight",
+                               skw.get("KDEWeight", 0)),
+            ns_weight=getattr(params, "NSWeight",
+                              skw.get("NSWeight", 0)),
             cov_update=getattr(params, "covUpdate", 1000) or 1000,
             write_hot_chains=bool(getattr(
                 params, "writeHotChains",

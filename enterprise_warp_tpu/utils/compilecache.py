@@ -1,0 +1,49 @@
+"""Persistent XLA compilation cache for the framework's entry points.
+
+TPU compiles of the sampler blocks / likelihood kernels cost seconds to
+minutes; every CLI run, benchmark leg, and measurement subprocess pays
+them again because each runs in a fresh process. jax's persistent
+compilation cache keys the serialized computation and reloads the
+executable across processes (verified working through the remote-compile
+backend: ~30x faster reload), so steady-state operation of a deployed
+installation compiles each program once per machine.
+
+Opt-out with ``EWT_NO_COMPILE_CACHE=1``; relocate with
+``EWT_COMPILE_CACHE=<dir>`` (default ``~/.cache/ewt_xla``).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(cache_dir=None):
+    """Enable jax's persistent compilation cache; returns the directory
+    actually used, or None when disabled/unavailable. Safe to call
+    multiple times and before/after backend initialization."""
+    if os.environ.get("EWT_NO_COMPILE_CACHE"):
+        return None
+    if cache_dir is None:
+        # scope by the platform hint so CPU-forced measurement
+        # subprocesses never load AOT entries compiled under the device
+        # terminal's target flags (observed: XLA:CPU machine-feature
+        # mismatch warnings threatening SIGILL)
+        plat = (os.environ.get("JAX_PLATFORMS")
+                or os.environ.get("EWT_PLATFORM") or "default")
+        cache_dir = os.environ.get(
+            "EWT_COMPILE_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         f"ewt_xla_{plat.replace(',', '_')}"))
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything that takes measurable compile time; the
+        # default thresholds skip exactly the small-but-many programs
+        # (prior evals, transforms) a sampler session accumulates
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.2)
+    except Exception:   # noqa: BLE001 — older jax / readonly FS
+        return None
+    return cache_dir

@@ -395,3 +395,126 @@ class TestHyperModel:
         n0 = np.sum(nmodel < 0.5)
         logbf = np.log(n1 / max(n0, 1))
         assert logbf == pytest.approx(2.0, abs=0.7)
+
+
+class TestEnsembleFamilies:
+    """The round-4 proposal families: conditional-Gibbs subsets (cg),
+    ensemble-KDE subset independence (kde), the white-noise budget slide
+    (ns), and the SMC-style tempered anneal init."""
+
+    def test_cgibbs_only_recovers_gaussian(self, tmp_path):
+        mu = np.array([1.0, -2.0, 0.5])
+        sig = np.array([0.5, 2.0, 1.0])
+        like = GaussianLike(mu, sig)
+        s = PTSampler(like, str(tmp_path), ntemps=1, nchains=64, seed=0,
+                      scam_weight=0, am_weight=0, de_weight=0,
+                      prior_weight=0, cg_weight=100, cg_k=2)
+        blocks = []
+        s.sample(3000, resume=False, verbose=False, block_size=250,
+                 collect=blocks)
+        c = np.concatenate(blocks, 0)[1000:]
+        assert s.fam_accept[5] / max(s.fam_propose[5], 1) > 0.3
+        assert np.allclose(c.reshape(-1, 3).mean(0), mu, atol=0.1)
+        assert np.allclose(c.reshape(-1, 3).std(0), sig, rtol=0.15)
+
+    @pytest.mark.slow
+    def test_kde_family_crosses_separated_modes(self, tmp_path):
+        import jax.numpy as jnp
+
+        class Bimodal(GaussianLike):
+            def __init__(self):
+                super().__init__([0.0, 0.0], [1.0, 1.0])
+
+                def ll(t):
+                    a = -0.5 * jnp.sum(
+                        (t - jnp.array([3.0, 2.0])) ** 2 / 0.25)
+                    b = -0.5 * jnp.sum(
+                        (t - jnp.array([-3.0, -2.0])) ** 2 / 0.25)
+                    return jnp.logaddexp(a + jnp.log(0.7),
+                                         b + jnp.log(0.3))
+                self._fn = ll
+                self.loglike = jax.jit(ll)
+                self.loglike_batch = jax.jit(jax.vmap(ll))
+
+        like = Bimodal()
+        s = PTSampler(like, str(tmp_path), ntemps=1, nchains=128, seed=0,
+                      scam_weight=10, am_weight=5, de_weight=15,
+                      prior_weight=5, cg_weight=25, kde_weight=40,
+                      cg_k=2)
+        s.anneal_init(schedule=[16.0, 4.0], steps_per=100, verbose=False)
+        blocks = []
+        s.sample(3000, resume=False, verbose=False, block_size=100,
+                 collect=blocks)
+        c = np.concatenate(blocks, 0)[1000:]
+        occ_a = (c[:, :, 0] > 0).mean()
+        # mode occupancy must match the 0.7/0.3 mass split — random-walk
+        # families alone cannot cross the ~24-sigma gap
+        assert occ_a == pytest.approx(0.7, abs=0.07)
+        assert s.fam_accept[6] / max(s.fam_propose[6], 1) > 0.1
+
+    @pytest.mark.slow
+    def test_noise_slide_posterior_invariance(self, tmp_path):
+        """The ns family must leave the (efac, equad) posterior exactly
+        invariant (Jacobian-corrected MH along the budget curve)."""
+        from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                                build_pulsar_likelihood)
+        from enterprise_warp_tpu.sim.noise import (inject_white,
+                                                   make_fake_pulsar)
+        psr = make_fake_pulsar(name="T", ntoa=100, backends=("X",),
+                               freqs_mhz=(1400.,), seed=2)
+        psr.residuals = 0.0 * psr.toaerrs
+        inject_white(psr, efac=1.1, equad_log10=-6.8,
+                     rng=np.random.default_rng(5))
+        m = StandardModels(psr=psr)
+        like = build_pulsar_likelihood(
+            psr, TermList(psr, [m.efac("by_backend"),
+                                m.equad("by_backend")]), gram_mode="f64")
+        assert like.noise_pairs, "pair metadata missing"
+        res = {}
+        for ns in (0, 30):
+            out = tmp_path / f"ns{ns}"
+            s = PTSampler(like, str(out), ntemps=2, nchains=32, seed=3,
+                          scam_weight=20, am_weight=10, de_weight=30,
+                          prior_weight=15, ns_weight=ns)
+            blocks = []
+            s.sample(15000, resume=False, verbose=False, block_size=500,
+                     collect=blocks)
+            c = np.concatenate(blocks, 0)[4000:]
+            res[ns] = c.reshape(-1, like.ndim)
+            if ns:
+                assert s.fam_accept[7] / max(s.fam_propose[7], 1) > 0.3
+        for i in range(like.ndim):
+            assert res[0][:, i].mean() == pytest.approx(
+                res[30][:, i].mean(), abs=0.15 * res[0][:, i].std())
+            assert res[30][:, i].std() == pytest.approx(
+                res[0][:, i].std(), rel=0.15)
+
+    def test_anneal_init_one_shot_and_reset(self, tmp_path):
+        like = GaussianLike([1.0, -1.0], [0.5, 0.5])
+        s = PTSampler(like, str(tmp_path), ntemps=1, nchains=32, seed=0,
+                      cg_weight=30)
+        st = s.anneal_init(schedule=[8.0], steps_per=50, verbose=False)
+        assert st.step == 0 and st.accepted.sum() == 0
+        assert np.isfinite(st.lnl).all()
+        s.sample(100, resume=False, verbose=False, block_size=50)
+        # the annealed state is consumed exactly once
+        assert s._anneal_state is None
+        # resume=True continues from the checkpoint (no re-anneal)
+        assert s.anneal_init(schedule=[8.0], steps_per=50) is None
+
+
+class TestFitCEM:
+    @pytest.mark.slow
+    def test_gaussian_moments_and_evidence(self):
+        from enterprise_warp_tpu.samplers.cem import fit_cem
+        mu = np.array([1.0, -2.0])
+        sig = np.array([0.5, 1.5])
+        like = GaussianLike(mu, sig)
+        fit = fit_cem(like, batch=192, seed=0, search_rounds=12,
+                      refine_rounds=12)
+        assert np.allclose(fit["mean"], mu, atol=0.3)
+        assert np.allclose(np.sqrt(np.diag(fit["cov"])), sig, rtol=0.5)
+        # normalized Gaussian in a [-10,10]^2 uniform box
+        assert fit["lnZ"] == pytest.approx(like.analytic_lnz, abs=0.5)
+        assert np.isfinite(fit["init_x"]).all()
+        assert fit["init_x"].shape == (192, 2)

@@ -2,13 +2,19 @@
 # Unattended device-side measurement chain (referenced by BASELINE.md).
 #
 # Waits for the accelerator to answer a probe (a dead tunnel hangs device
-# calls forever — see tools/north_star.py), then runs, in order:
-#   1. the north-star device leg (resumable; watchdogged internally),
+# calls forever — see tools/north_star.py), then runs the stages CHEAPEST
+# AND MOST VALUABLE FIRST, so a tunnel that dies mid-chain still leaves
+# the headline artifacts:
+#   1. north-star PIPELINE leg (the TPU-native operating mode; minutes),
 #   2. the headline benchmark (bench.py),
 #   3. the per-BASELINE-config benchmark (bench.py --configs),
-#   4. the kernel and joint-likelihood profilers.
-# Each stage re-probes first so a tunnel drop between stages aborts
-# cleanly instead of wedging. All output lands in $OUT.
+#   4. the north-star vanilla DEVICE leg (same-algorithm comparison;
+#      the long one),
+#   5. the CPU + scalar reference legs (no device needed) and the
+#      NORTH_STAR.json assembly,
+#   6. kernel/joint profilers, step-latency grid, roofline.
+# Each device stage re-probes first so a tunnel drop between stages
+# aborts cleanly instead of wedging. All output lands in $OUT.
 #
 # Usage: nohup bash tools/device_measurements.sh &   (from the repo root)
 set -u
@@ -27,18 +33,29 @@ probe() {
   timeout 50 python -c "import jax, jax.numpy as jnp; jnp.ones((8,8)).sum().block_until_ready(); assert jax.devices()[0].platform != 'cpu'; print('ok')" >/dev/null 2>&1
 }
 
+stage() {  # stage <name> <logfile> <cmd...>
+  local name=$1 logf=$2; shift 2
+  "$@" > "$OUT/$logf" 2>&1
+  local rc=$?
+  echo "$(date +%H:%M:%S) $name rc=$rc" >> "$OUT/log"
+}
+
 echo "$(date +%H:%M:%S) waiting for device" >> "$OUT/log"
 until probe; do sleep 90; done
-echo "$(date +%H:%M:%S) device UP — north-star device leg" >> "$OUT/log"
+echo "$(date +%H:%M:%S) device UP — warm compile cache" >> "$OUT/log"
 
-python tools/north_star.py legs device > "$OUT/north_star.log" 2>&1
-rc=$?
-echo "$(date +%H:%M:%S) north_star device leg rc=$rc" >> "$OUT/log"
+# populate the persistent XLA compile cache with the legs' program
+# shapes so the measured walls reflect steady-state (warm-cache)
+# operation; the leg artifacts record compile_cache_warm
+stage "warm_cache" warm_cache.log python tools/warm_cache.py
+
+probe || { echo "$(date +%H:%M:%S) tunnel lost before nested leg" >> "$OUT/log"; exit 1; }
+stage "north_star nested_device leg" north_star_nested.log \
+  python tools/north_star.py legs nested_device
 
 probe || { echo "$(date +%H:%M:%S) tunnel lost before pipeline" >> "$OUT/log"; exit 1; }
-python tools/north_star.py legs pipeline > "$OUT/north_star_pipeline.log" 2>&1
-rc=$?
-echo "$(date +%H:%M:%S) north_star pipeline leg rc=$rc" >> "$OUT/log"
+stage "north_star pipeline leg" north_star_pipeline.log \
+  python tools/north_star.py legs pipeline
 
 probe || { echo "$(date +%H:%M:%S) tunnel lost before bench" >> "$OUT/log"; exit 1; }
 python bench.py > "$OUT/bench_headline.json" 2> "$OUT/bench_headline.err"
@@ -50,24 +67,24 @@ python bench.py --configs > "$OUT/bench_configs.json" 2> "$OUT/bench_configs.err
 rc=$?
 echo "$(date +%H:%M:%S) bench configs rc=$rc" >> "$OUT/log"
 
-probe || exit 1
-python tools/profile_kernel.py > "$OUT/profile_kernel.log" 2>&1
-rc=$?
-echo "$(date +%H:%M:%S) profile_kernel rc=$rc" >> "$OUT/log"
+probe || { echo "$(date +%H:%M:%S) tunnel lost before device leg" >> "$OUT/log"; exit 1; }
+stage "north_star device leg" north_star.log \
+  python tools/north_star.py legs device
+
+# CPU-only reference legs + NORTH_STAR.json assembly (no device needed;
+# north_star skips already-recorded legs and assembles when complete)
+stage "north_star cpu+scalar+nested_cpu legs + assembly" north_star_cpu.log \
+  python tools/north_star.py legs cpu,scalar,nested_cpu
 
 probe || exit 1
-python tools/profile_joint.py > "$OUT/profile_joint.log" 2>&1
-rc=$?
-echo "$(date +%H:%M:%S) profile_joint rc=$rc" >> "$OUT/log"
-
+stage "profile_kernel" profile_kernel.log python tools/profile_kernel.py
+probe || exit 1
+stage "profile_joint" profile_joint.log python tools/profile_joint.py
 probe || exit 1
 python tools/step_latency.py > "$OUT/step_latency.jsonl" 2> "$OUT/step_latency.err"
 rc=$?
 echo "$(date +%H:%M:%S) step_latency rc=$rc" >> "$OUT/log"
-
 probe || exit 1
-python tools/roofline.py > "$OUT/roofline.log" 2>&1
-rc=$?
-echo "$(date +%H:%M:%S) roofline rc=$rc" >> "$OUT/log"
+stage "roofline" roofline.log python tools/roofline.py
 echo "$(date +%H:%M:%S) CHAIN DONE" >> "$OUT/log"
 touch "$OUT/DONE"               # completion marker for device_guard.sh

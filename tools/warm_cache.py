@@ -1,0 +1,72 @@
+"""Populate the persistent XLA compile cache with the north-star leg's
+programs (tools/device_measurements.sh runs this before the measured
+legs).
+
+The pipeline leg's wall-clock includes its warm start and first blocks;
+on a cold cache those are dominated by minutes of TPU compilation that
+a deployed installation pays exactly once per machine. This script runs
+the SAME builds and sampler shapes as the legs against a throwaway
+output directory so the measured runs reload every program from the
+cache (the leg records ``compile_cache_warm`` so the artifact states
+which regime was measured).
+"""
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from enterprise_warp_tpu.utils.compilecache import \
+    enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
+
+from tools.north_star import LEGS, build_problem  # noqa: E402
+
+
+def main():
+    from enterprise_warp_tpu.samplers.ptmcmc import PTSampler
+    cfg = LEGS["pipeline"]
+    like = build_problem(cfg["gram_mode"])
+    opts = dict(ntemps=cfg.get("ntemps", 2), nchains=cfg["nchains"],
+                seed=0)
+    for k in ("scam_weight", "am_weight", "de_weight", "prior_weight",
+              "ind_weight", "ind_inflate", "cg_weight", "cg_k",
+              "cg_group_frac", "kde_weight", "kde_bw", "ns_weight"):
+        if k in cfg:
+            opts[k] = cfg[k]
+    with tempfile.TemporaryDirectory() as d:
+        s = PTSampler(like, d, **opts)
+        # one short block per program shape the leg will use
+        a = cfg.get("anneal")
+        if a:
+            s.anneal_init(schedule=a["schedule"][-1:],
+                          steps_per=a["steps_per"], verbose=False)
+        s.sample(cfg["block_size"], resume=False, verbose=False,
+                 block_size=cfg["block_size"])
+    # the nested leg's iteration + init shapes
+    ncfg = LEGS["nested_device"]
+    if ncfg["gram_mode"] == cfg["gram_mode"]:
+        from enterprise_warp_tpu.samplers.nested import run_nested
+        with tempfile.TemporaryDirectory() as d:
+            run_nested(like, outdir=d, nlive=ncfg["nlive"],
+                       dlogz=ncfg["dlogz"], nsteps=ncfg["nsteps"],
+                       kbatch=ncfg["kbatch"], seed=1, resume=False,
+                       verbose=False, max_iter=2, label="warm")
+
+    # the vanilla device leg's block shape too
+    dcfg = LEGS["device"]
+    if dcfg["gram_mode"] == cfg["gram_mode"]:
+        dopts = dict(ntemps=dcfg.get("ntemps", 2),
+                     nchains=dcfg["nchains"], seed=0)
+        with tempfile.TemporaryDirectory() as d:
+            s = PTSampler(like, d, **dopts)
+            s.sample(dcfg["block_size"], resume=False, verbose=False,
+                     block_size=dcfg["block_size"])
+    print("compile cache warmed")
+
+
+if __name__ == "__main__":
+    main()
