@@ -37,7 +37,8 @@ from .modeldict import (merge_two_noise_model_dicts, parse_extra_model_terms,
 # kernel.
 IMPLEMENTED_SAMPLERS = {
     "ptmcmcsampler": dict(nsamp=1000000, SCAMweight=30, AMweight=15,
-                          DEweight=50, IndWeight=0, ntemps=1,
+                          DEweight=50, IndWeight=0, CGWeight=0,
+                          KDEWeight=0, NSWeight=0, ntemps=1,
                           writeHotChains=False,
                           covUpdate=1000, burn=10000, thin=10,
                           advi_init=False, advi_steps=800),

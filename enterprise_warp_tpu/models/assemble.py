@@ -47,6 +47,12 @@ class MultiPulsarLikelihood(PriorMixin):
         self._index_maps = [
             jnp.asarray([seen[p.name] for p in pl.params], dtype=jnp.int32)
             for pl in pulsar_likes]
+        # remap members' white-noise pair metadata (sampler ns family)
+        # into the global parameter indexing
+        self.noise_pairs = [
+            (seen[pl.param_names[i]], seen[pl.param_names[j]], s2)
+            for pl in pulsar_likes
+            for (i, j, s2) in (getattr(pl, "noise_pairs", None) or [])]
 
         def loglike(theta):
             out = 0.0

@@ -100,13 +100,20 @@ def _noise_slide_pairs(psr, names):
         if not n.endswith("_efac"):
             continue
         stem = n[: -len("_efac")]
+        # require THIS pulsar's name: in a joint/multi-pulsar name
+        # list, another pulsar's pair must not be claimed with this
+        # pulsar's TOA errors. ``<psr>_efac`` with no backend key is
+        # the no_selection option — one pair over all TOAs.
+        if stem == psr.name:
+            mask = np.ones_like(flags, dtype=bool)
+        elif stem.startswith(psr.name + "_"):
+            mask = flags == stem[len(psr.name) + 1:]
+        else:
+            continue
         partner = stem + "_log10_equad"
         if partner not in names:
             continue
         j = names.index(partner)
-        key = stem[len(psr.name) + 1:] \
-            if stem.startswith(psr.name + "_") else stem
-        mask = flags == key
         s2 = float(err2[mask].mean()) if mask.any() else \
             float(err2.mean())
         out.append((i, j, s2))

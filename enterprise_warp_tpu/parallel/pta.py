@@ -120,6 +120,12 @@ class PTALikelihood(PriorMixin):
         self.ndim = len(sampled)
         self.gram_mode = gram_mode
         self.mesh = mesh
+        # white-noise pair metadata for the sampler's noise-budget
+        # slide family, gathered per pulsar against the joint name list
+        from ..models.build import _noise_slide_pairs
+        self.noise_pairs = [p for psr in psrs
+                            for p in _noise_slide_pairs(
+                                psr, self.param_names)]
         from ..samplers.evalproto import install_protocol
         install_protocol(self, loglike_fn,
                          consts if consts is not None else {})

@@ -46,6 +46,22 @@ class HyperModelLikelihood(PriorMixin):
         self.param_names = [p.name for p in self.params]
         self.ndim = len(self.params)
 
+        # union of members' white-noise pair metadata (sampler ns
+        # family), remapped and name-deduplicated: a slide on a pair of
+        # the currently-inactive model is just another valid proposal
+        # (likelihood unchanged there, prior-bounded, MH-corrected)
+        pair_seen = set()
+        self.noise_pairs = []
+        for like in self.likes.values():
+            for (i, j, s2) in (getattr(like, "noise_pairs", None)
+                               or []):
+                key = like.param_names[i]
+                if key not in pair_seen:
+                    pair_seen.add(key)
+                    self.noise_pairs.append(
+                        (seen[like.param_names[i]],
+                         seen[like.param_names[j]], s2))
+
         index_maps = [
             jnp.asarray([seen[p.name] for p in like.params],
                         dtype=jnp.int32)

@@ -7,8 +7,11 @@ is a single pair-program matmul (ops/kernel.py:build_pair_program).
 
 Sweeps sampler configurations on the flagship J1832-scale problem and
 prints one JSON line per point:
-  {"nchains": N, "ntemps": T, "blocked_chol": 0|1, "ind": 0|1,
+  {"nchains": N, "ntemps": T, "blocked_chol": 0|1, "ind": 0|1|2,
    "step_ms": ..., "evals_per_s": ...}
+where ind=0 is the classic scam/am/de/pd mix, ind=1 adds the
+full-vector independence family, and ind=2 is the pipeline leg's
+ensemble mix (cg/kde/ns).
 
 Usage: python tools/step_latency.py [--quick]
 """
@@ -34,7 +37,11 @@ def time_config(like, nchains, ntemps, ind, steps=200):
     from enterprise_warp_tpu.samplers.ptmcmc import PTSampler
     with tempfile.TemporaryDirectory() as d:
         kw = dict(ntemps=ntemps, nchains=nchains, seed=0)
-        if ind:
+        if ind == 2:      # the pipeline leg's ensemble mix (cg/kde/ns)
+            kw.update(ns_weight=35, kde_weight=18, cg_weight=15,
+                      de_weight=10, prior_weight=12, scam_weight=8,
+                      am_weight=2, cg_k=3)
+        elif ind:
             kw.update(ind_weight=48, scam_weight=15, am_weight=15,
                       de_weight=20, prior_weight=2)
         s = PTSampler(like, d, **kw)
@@ -56,9 +63,9 @@ def time_config(like, nchains, ntemps, ind, steps=200):
 def main():
     quick = "--quick" in sys.argv
     like = build_problem("split")
-    grid = ([(256, 1, 1), (256, 2, 0)] if quick else
-            [(256, 1, 0), (256, 1, 1), (256, 2, 0), (512, 1, 1),
-             (1024, 1, 1), (64, 1, 1)])
+    grid = ([(256, 1, 2), (256, 2, 0)] if quick else
+            [(256, 1, 0), (256, 1, 1), (256, 1, 2), (256, 2, 0),
+             (512, 1, 1), (1024, 1, 1), (64, 1, 2)])
     for nchains, ntemps, ind in grid:
         r = time_config(like, nchains, ntemps, ind)
         print(json.dumps(r), flush=True)
