@@ -503,6 +503,11 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     # EWT_PAIR_PROGRAM): reading env inside the traced function would be
     # frozen into the jit cache and silently ignore later toggles
     use_blocked_chol = _os.environ.get("EWT_BLOCKED_CHOL", "0") == "1"
+    # refinement passes of the mixed Sigma solve (accuracy knob; 3 is
+    # oracle-grade through the TM-Schur cancellation, 2 trades ~1.5 ms
+    # per batch-320 eval for ~10x looser — still sampler-noise-level —
+    # lnL error; resolved at build time like the toggles above)
+    n_refine = int(_os.environ.get("EWT_REFINE", "3"))
 
     def loglike_inner(theta, sh):
         wb = [(kind, mm, refs) for (kind, _, refs), mm
@@ -518,14 +523,16 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
                                        mask=sh["mask"],
                                        gram_mode=gram_mode,
                                        pair_program=pair_prog,
-                                       blocked_chol=use_blocked_chol)
+                                       blocked_chol=use_blocked_chol,
+                                       refine=n_refine)
         else:
             dp = jnp.stack([param_value(theta, rf) for rf in tm_refs])
             r_eff = r_eff - sh["M"] @ dp
             lnl = marginalized_loglike(nw, phi, r_eff, None, T_mat,
                                        mask=sh["mask"],
                                        gram_mode=gram_mode,
-                                       blocked_chol=use_blocked_chol)
+                                       blocked_chol=use_blocked_chol,
+                                       refine=n_refine)
         # a numerically non-PD Sigma (extreme prior corners) yields NaN;
         # the reference stack maps Cholesky failure to -inf likewise
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)

@@ -14,8 +14,33 @@ import numpy as np
 class PriorMixin:
     """Requires ``self.params`` (list of Parameter with priors)."""
 
+    def _uniform_tables(self):
+        """(lo, hi, -log width) arrays when EVERY prior is Uniform,
+        else None — enables fused whole-vector prior ops on the
+        samplers' sequential critical path (evaluated twice per MCMC
+        step and once per nested walk step)."""
+        from .priors import Uniform
+        cached = getattr(self, "_unif_tab", False)
+        if cached is not False:
+            return cached
+        if all(type(p.prior) is Uniform for p in self.params):
+            lo = np.array([p.prior.lo for p in self.params])
+            hi = np.array([p.prior.hi for p in self.params])
+            # cache NUMPY arrays: jnp constants created under an active
+            # trace would leak tracers into later traces via the cache
+            tab = (lo, hi, -np.log(hi - lo))
+        else:
+            tab = None
+        self._unif_tab = tab
+        return tab
+
     def log_prior(self, theta):
         theta = jnp.atleast_1d(theta)
+        tab = PriorMixin._uniform_tables(self)
+        if tab is not None:
+            lo, hi, neglogw = tab
+            inside = jnp.all((theta >= lo) & (theta <= hi), axis=-1)
+            return jnp.where(inside, jnp.sum(neglogw), -jnp.inf)
         out = 0.0
         for i, p in enumerate(self.params):
             out = out + p.prior.logpdf(theta[..., i])
@@ -26,11 +51,25 @@ class PriorMixin:
         proposal-asymmetry correction of prior-draw jumps needs the
         replaced dimension's density on its own."""
         theta = jnp.atleast_1d(theta)
+        tab = PriorMixin._uniform_tables(self)
+        if tab is not None:
+            lo, hi, neglogw = tab
+            inside = (theta >= lo) & (theta <= hi)
+            return jnp.where(inside, neglogw, -jnp.inf)
         return jnp.stack([p.prior.logpdf(theta[..., i])
                           for i, p in enumerate(self.params)], axis=-1)
 
     def from_unit(self, u):
-        """Unit-cube transform across all sampled parameters."""
+        """Unit-cube transform across all sampled parameters.
+
+        All-Uniform parameter sets (the overwhelmingly common case)
+        take a single fused affine op instead of ndim per-column
+        transforms — this sits on the sequential critical path of every
+        nested-sampling walk step and every prior-draw proposal."""
+        tab = PriorMixin._uniform_tables(self)
+        if tab is not None:
+            lo, hi, _ = tab
+            return lo + (hi - lo) * u
         cols = [p.prior.from_unit(u[..., i])
                 for i, p in enumerate(self.params)]
         return jnp.stack(cols, axis=-1)

@@ -458,9 +458,11 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     return s[:, None] * Z, logdet
 
 
-@partial(jax.jit, static_argnames=("gram_mode", "blocked_chol"))
+@partial(jax.jit, static_argnames=("gram_mode", "blocked_chol",
+                                   "refine"))
 def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
-                         pair_program=None, blocked_chol=False):
+                         pair_program=None, blocked_chol=False,
+                         refine=3):
     """Marginalized GP log-likelihood for one pulsar at one parameter point.
 
     Parameters
@@ -550,8 +552,8 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
         else:
             jitter = CHOL_JITTER[gram_mode]
             zx, logdet_sigma = _mixed_psd_solve_logdet(
-                Sigma, X[:, None], jitter, refine=3, delta_mode="split",
-                blocked=blocked_chol)
+                Sigma, X[:, None], jitter, refine=refine,
+                delta_mode="split", blocked=blocked_chol)
             quad = rwr - X @ zx[:, 0]
         logdet_n = jnp.sum(jnp.log(nw) * (mask if mask is not None
                                           else 1.0))
@@ -589,7 +591,7 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
         # cost (CPU: 83 -> 18 ms/16-batch)
         ZXH, logdet_sigma = _mixed_psd_solve_logdet(
             Sigma, jnp.concatenate([X[:, None], H], axis=1), jitter,
-            refine=3, delta_mode="split", blocked=blocked_chol)
+            refine=refine, delta_mode="split", blocked=blocked_chol)
         zx, ZH = ZXH[:, 0], ZXH[:, 1:]
         A = P - H.T @ ZH
         y = q - ZH.T @ X

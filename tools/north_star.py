@@ -10,10 +10,15 @@ Usage:
   python tools/north_star.py legs device        # e.g. later, on the chip
   python tools/north_star.py leg <device|cpu>   # one leg in-process (JSON)
 
-Legs: ``device`` (TPU batched sampler), ``cpu`` (same algorithm, jax-CPU,
-1 core), ``scalar`` (reference-shaped scalar numpy loop). Results merge
-into NORTH_STAR.partial.json (config-fingerprinted; stale legs rerun);
-NORTH_STAR.json is assembled once all three are present.
+Legs: ``device`` (TPU batched sampler, reference jump families),
+``cpu`` (same algorithm, jax-CPU, 1 core), ``scalar`` (reference-shaped
+scalar numpy loop), ``pipeline`` (the TPU-native operating mode:
+tempered-anneal init + ensemble proposal families), ``nested_device`` /
+``nested_cpu`` (batched nested sampling at the reference example's
+dynesty settings — the configuration the reference actually ships).
+Results merge into NORTH_STAR.partial.json (config-fingerprinted; stale
+legs rerun); NORTH_STAR.json is assembled once device+cpu+scalar are
+present, folding in whichever optional legs exist.
 
 Each leg runs in its own process (platform/thread forcing must precede jax
 backend init). Both legs run the *same* adaptive PT-MCMC on the same
