@@ -272,7 +272,7 @@ def fit_cem(like, rounds=None, batch=256, inflate=1.5, seed=0,
     # flag the estimate rather than letting a confident-looking number
     # feed a cross-check (measured on the flagship: lnZ -302 at
     # ess_is~5 vs the nested sampler's validated -262)
-    lnZ_reliable = bool(ess_is >= 10.0 * (nd + 2))
+    lnZ_reliable = bool(ess_is >= ess_target_factor * (nd + 2))
     return dict(mean=np.asarray(mean), cov=np.asarray(cov),
                 init_x=init, samples=samples,
                 lnZ=lnZ, lnZ_err=lnZ_err,

@@ -34,6 +34,52 @@ def _make_refill(like, nlive, kbatch, nsteps):
     from .evalproto import eval_protocol
     batch_eval, _, _ = eval_protocol(like)
 
+    # white-noise budget-slide moves inside the constrained walk (same
+    # geometry as the MCMC ``ns`` family, retargeted at the
+    # PRIOR-restricted-above-L* distribution): the efac/equad corner —
+    # equad-dominated, efac nearly free — is an entropic pocket that
+    # Gaussian/DE walks enter rarely, which is where the nested
+    # posterior's efac widths pick up run-to-run variance. Enabled when
+    # the likelihood exposes pair metadata AND every prior is Uniform
+    # (the walk lives in the unit cube; the slide needs the affine
+    # theta<->u map).
+    _pairs = list(getattr(like, "noise_pairs", None) or [])
+    from ..models.prior_mixin import PriorMixin
+    _tab = PriorMixin._uniform_tables(like)
+    use_slide = bool(_pairs) and _tab is not None
+    if use_slide:
+        import numpy as _np
+        _lo, _hi = _np.asarray(_tab[0]), _np.asarray(_tab[1])
+        sl_i = jnp.asarray([p[0] for p in _pairs])
+        sl_j = jnp.asarray([p[1] for p in _pairs])
+        sl_s2 = jnp.asarray([p[2] for p in _pairs])
+        sl_lo = jnp.asarray(_lo)
+        sl_span = jnp.asarray(_hi - _lo)
+        n_pairs = len(_pairs)
+
+        def slide_one(u_w, bkey, fkey):
+            """u-space budget slide: theta -> (v, q') at fixed v ->
+            back to u. Returns (proposed u, log measure correction
+            log(e/e'), in-box flag)."""
+            th = sl_lo + sl_span * u_w
+            b = jax.random.randint(bkey, (), 0, n_pairs)
+            ie, iq = sl_i[b], sl_j[b]
+            s2 = sl_s2[b]
+            e, q = th[ie], th[iq]
+            v = e * e * s2 + 10.0 ** (2.0 * q)
+            upper = jnp.minimum(sl_lo[iq] + sl_span[iq],
+                                0.5 * jnp.log10(v) - 1e-9)
+            lo_q = jnp.minimum(sl_lo[iq], upper - 1e-9)
+            q_new = lo_q + (upper - lo_q) * jax.random.uniform(fkey)
+            e_new = jnp.sqrt(jnp.maximum(
+                (v - 10.0 ** (2.0 * q_new)) / s2, 0.0))
+            th = th.at[ie].set(e_new).at[iq].set(q_new)
+            qc = jnp.log(jnp.maximum(e, 1e-300)) \
+                - jnp.log(jnp.maximum(e_new, 1e-300))
+            u_new = (th - sl_lo) / sl_span
+            inbox = jnp.all((u_new > 0.0) & (u_new < 1.0))
+            return u_new, qc, inbox
+
     # per-batch shrinkage bookkeeping, device-resident (a batch of K
     # deletions == K sequential deletions at live counts N..N-K+1)
     _counts = nlive - jnp.arange(kbatch)
@@ -89,11 +135,40 @@ def _make_refill(like, nlive, kbatch, nsteps):
             prop = jnp.abs(prop)
             prop = 1.0 - jnp.abs(1.0 - prop)
             prop = jnp.clip(prop, 1e-12, 1.0 - 1e-12)
+            qcorr = jnp.zeros(walk_u.shape[0])
+            supp = jnp.ones(walk_u.shape[0], dtype=bool)
+            pick = jnp.zeros(walk_u.shape[0], dtype=bool)
+            if use_slide:
+                key, kb, kf, kc = jax.random.split(key, 4)
+                s_prop, s_qc, s_in = jax.vmap(slide_one)(
+                    walk_u, jax.random.split(kb, walk_u.shape[0]),
+                    jax.random.split(kf, walk_u.shape[0]))
+                # move-type choice must NOT depend on the state (a
+                # state-dependent mixture is not pi-invariant): an
+                # out-of-support slide proposal is a REJECTION of the
+                # slide move, not a fallback to the symmetric one
+                pick = jax.random.uniform(
+                    kc, (walk_u.shape[0],)) < 0.25
+                prop = jnp.where(pick[:, None], s_prop, prop)
+                qcorr = jnp.where(pick, s_qc, qcorr)
+                supp = jnp.where(pick, s_in, supp)
             lnl_p = batch_eval(like.from_unit(prop), consts)
-            ok = lnl_p > lstar
+            key, ka = jax.random.split(key)
+            # hard likelihood floor + support + the slide's
+            # (v,q)-measure correction against the uniform prior
+            # (symmetric moves have qcorr = 0, supp = True and reduce
+            # to the plain floor rule)
+            ok = supp & (lnl_p > lstar) & (
+                jnp.log(jax.random.uniform(
+                    ka, (walk_u.shape[0],))) < qcorr)
             walk_u = jnp.where(ok[:, None], prop, walk_u)
             walk_lnl = jnp.where(ok, lnl_p, walk_lnl)
-            return (walk_u, walk_lnl, key, nacc + jnp.mean(ok)), None
+            # scale adaptation feedback from the SYMMETRIC moves only
+            # (slide acceptance is scale-independent and would pollute
+            # the 40%-target loop)
+            sym = ~pick
+            sym_acc = jnp.sum(ok & sym) / jnp.maximum(jnp.sum(sym), 1)
+            return (walk_u, walk_lnl, key, nacc + sym_acc), None
 
         (walk_u, walk_lnl, key, nacc), _ = jax.lax.scan(
             step, (walk_u, walk_lnl, key, 0.0), None, length=nsteps)

@@ -112,7 +112,8 @@ LEGS = {
     # Warm start: SMC-style tempered anneal (PTSampler.anneal_init) —
     # ~300 steps, properly dispersed, no separate fit machinery.
     "pipeline": dict(nchains=256, gram_mode="split", check_every=100,
-                     block_size=100, ntemps=1, scam_weight=8,
+                     block_size=100, check_growth=1.08, ntemps=1,
+                     scam_weight=8,
                      am_weight=2, de_weight=10, prior_weight=12,
                      ind_weight=0, cg_weight=15, cg_k=3,
                      kde_weight=18, ns_weight=35,
@@ -221,9 +222,18 @@ def run_leg(name):
             json.dump({"wall_s": wall_s, "steady_wall_s": wall_s,
                        "attempts": prior_wall["attempts"] + 1}, fh)
         os.replace(tmp, wall_path)
-        post = res["posterior_samples"]
-        posterior = {n: {"mean": float(post[:, i].mean()),
-                         "std": float(post[:, i].std())}
+        # EXACT weighted moments over every dead point — the
+        # equal-weight resample's Monte Carlo noise (neff can be a few
+        # hundred) is enough to trip the 1.25x width gate on a
+        # perfectly fine run
+        th = np.asarray(res["samples"])
+        w = np.exp(np.asarray(res["log_weights"]))
+        w = w / w.sum()
+        mu = w @ th
+        var = w @ (th - mu) ** 2 / max(1.0 - float(np.sum(w ** 2)),
+                                       1e-3)
+        posterior = {n: {"mean": float(mu[i]),
+                         "std": float(np.sqrt(var[i]))}
                      for i, n in enumerate(like.param_names)}
         import jax
         return dict(

@@ -5,6 +5,10 @@ isolation over a walker batch, to locate where the batched-eval wall-clock
 goes (VERDICT round-1 item 2: profile before optimizing).
 """
 
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import os
 import time
 
