@@ -41,7 +41,8 @@ IMPLEMENTED_SAMPLERS = {
                           KDEWeight=0, NSWeight=0, ntemps=1,
                           writeHotChains=False,
                           covUpdate=1000, burn=10000, thin=10,
-                          advi_init=False, advi_steps=800),
+                          advi_init=False, advi_steps=800,
+                          anneal_init=False),
     "dynesty": dict(nlive=500, dlogz=0.1),
     "nestle": dict(nlive=500, dlogz=0.1),
     "pymultinest": dict(nlive=500, dlogz=0.1),

@@ -924,6 +924,16 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
             opts["init_x"] = fit["samples"]
     opts.update(kw)
     sampler = PTSampler(like, outdir, **opts)
+    if params is not None and getattr(
+            params, "anneal_init",
+            getattr(params, "sampler_kwargs", {}).get("anneal_init",
+                                                      False)):
+        # SMC-style tempered warm start (the pipeline-leg operating
+        # mode) from the paramfile: no-op on resume (checkpoint
+        # present), counters reset so the measurement starts clean
+        if verbose:
+            print("anneal_init: tempered warm start")
+        sampler.anneal_init(verbose=verbose)
     sampler.sample(nsamp, resume=resume, verbose=verbose, thin=thin)
     return sampler
 
