@@ -106,3 +106,39 @@ def test_truth_recovery_on_fake_psr(tmp_path, monkeypatch):
     i_A = pars.index("J0042-0000_red_noise_log10_A")
     med_A = np.median(burn[:, i_A])
     assert -14.5 < med_A < -11.5
+
+
+@pytest.mark.slow
+def test_anneal_init_and_ensemble_families_via_paramfile(tmp_path,
+                                                         monkeypatch):
+    """The paramfile route to the pipeline-leg machinery: anneal_init
+    plus CG/KDE/NS weights must reach the sampler and run end-to-end."""
+    import shutil
+
+    from enterprise_warp_tpu.samplers.ptmcmc import run_ptmcmc
+    monkeypatch.chdir(tmp_path)
+    src = (PARAMS / "default_model_nested.dat").read_text()
+    src = src.replace("sampler: dynesty",
+                      "sampler: ptmcmcsampler\nnsamp: 600\n"
+                      "CGWeight: 25\nKDEWeight: 15\nNSWeight: 20\n"
+                      "anneal_init: True\nthin: 1\nburn: 0")
+    src = src.replace("nlive: 800\n", "").replace("dlogz: 0.1\n", "")
+    src = src.replace("datadir: data",
+                      f"datadir: {EXAMPLES / 'data'}")
+    pr = tmp_path / "anneal.dat"
+    pr.write_text(src)
+    shutil.copytree(EXAMPLES / "example_noisemodels",
+                    tmp_path / "example_noisemodels",
+                    dirs_exist_ok=True)
+    params, likes = _build(pr, num=1, tmp=tmp_path)
+    like = likes[0]
+    out = tmp_path / "run"
+    s = run_ptmcmc(like, str(out), 600, params=params, resume=False,
+                   seed=0, verbose=False, nchains=16, ntemps=1)
+    # the families were actually proposed (weights reached the sampler)
+    assert s.fam_propose[5] > 0 and s.fam_propose[6] > 0
+    if like.noise_pairs:
+        assert s.fam_propose[7] > 0
+    chain = np.loadtxt(out / "chain_1.txt")
+    assert chain.shape[0] == 600 * 16
+    assert np.isfinite(chain[:, :like.ndim]).all()
