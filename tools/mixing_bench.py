@@ -263,6 +263,45 @@ def flagship_pt_vs_hmc(nsamp_pt=20000, nsamp_hmc=4000, seed=0):
     return out
 
 
+def flagship_ensemble(nsamp=20000, seed=0):
+    """ESS-per-eval of the round-4 ensemble jump mix (cg/kde/ns +
+    tempered anneal) on the SAME flagship model and chain budget as
+    ``flagship_pt`` — the platform-independent record of what the new
+    families buy (the per-step cost is unchanged: one batched value
+    eval; only the proposal structure differs)."""
+    import time
+
+    from enterprise_warp_tpu.samplers import PTSampler
+
+    sys.path.insert(0, REPO)
+    from __graft_entry__ import _flagship_single_pulsar
+    from enterprise_warp_tpu.models import build_pulsar_likelihood
+
+    psr, terms = _flagship_single_pulsar()
+    like = build_pulsar_likelihood(psr, terms)
+    ntemps, nchains = 1, 16
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as outdir:
+        s = PTSampler(like, outdir, ntemps=ntemps, nchains=nchains,
+                      seed=seed, cov_update=1000, ns_weight=35,
+                      kde_weight=18, cg_weight=15, de_weight=10,
+                      prior_weight=12, scam_weight=8, am_weight=2)
+        s.anneal_init(schedule=[64.0, 16.0, 4.0], steps_per=200,
+                      verbose=False)
+        blocks = []
+        s.sample(nsamp, resume=False, verbose=False, collect=blocks)
+    rep = _ess_report(blocks, like, nsamp, 0.4)
+    rep["value_evals_per_chain"] = nsamp * ntemps
+    rep["ess_per_value_eval"] = round(
+        rep["ess_min"] / (nsamp * ntemps), 5)
+    rep["wall_s"] = round(time.perf_counter() - t0, 1)
+    rep["fam_accept"] = {
+        n: round(float(a / max(p, 1)), 3) for n, a, p in zip(
+            ("scam", "am", "de", "pd", "ind", "cg", "kde", "ns"),
+            s.fam_accept, s.fam_propose)}
+    return rep
+
+
 def main():
     quick = "--quick" in sys.argv
     n = 4000 if quick else 20000
@@ -284,7 +323,9 @@ def main():
     report["hypermodel_no_prior_draws"] = hop_rate(0, n)
     report["hypermodel_local_jumps_only"] = hop_rate(0, n, de_weight=0)
     if not quick:
-        report.update(flagship_pt_vs_hmc())
+        report["flagship_ensemble"] = flagship_ensemble(
+        nsamp=(4000 if quick else 20000))
+    report.update(flagship_pt_vs_hmc())
 
     if not quick:
         # --quick is a smoke mode; only full runs publish the artifact
