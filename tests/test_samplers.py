@@ -555,3 +555,26 @@ class TestNestedSlideMove:
             b = r_plain["posterior_samples"][:, i]
             s = max(a.std(), b.std())
             assert abs(a.mean() - b.mean()) < 0.35 * s
+
+
+class TestConvergenceGrowth:
+    def test_geometric_checks_and_thinned_diagnostics(self, tmp_path):
+        """check_growth spaces checks geometrically (block-size-aligned)
+        and diag_max_kept bounds the per-check cost without changing
+        the verdict on an easy target."""
+        from enterprise_warp_tpu.samplers.convergence import \
+            sample_to_convergence
+        like = GaussianLike([0.5, -0.5], [1.0, 2.0])
+        s = PTSampler(like, str(tmp_path), ntemps=1, nchains=32, seed=0,
+                      cg_weight=40, de_weight=30, scam_weight=20,
+                      prior_weight=10)
+        rep = sample_to_convergence(
+            s, target_ess=300.0, rhat_max=1.05, check_every=200,
+            max_steps=20000, block_size=100, verbose=False,
+            diag_max_kept=150, check_growth=1.5)
+        assert rep.converged
+        assert rep.steps % 100 == 0        # block-aligned growth
+        assert rep.ess_min >= 300.0
+        su = rep.summary
+        assert abs(su["p0"]["mean"] - 0.5) < 0.15
+        assert abs(su["p1"]["std"] - 2.0) < 0.4
