@@ -264,10 +264,16 @@ def main():
             with open(ns_path) as fh:
                 ns = json.load(fh)
             out["north_star"] = {
-                k: ns[k] for k in ("speedup_vs_reference_shape",
-                                   "speedup_vs_own_cpu",
-                                   "posterior_match",
-                                   "north_star_met") if k in ns}
+                k: ns[k] for k in (
+                    "speedup_vs_reference_shape",
+                    "speedup_vs_own_cpu",
+                    "posterior_match",
+                    "pipeline_speedup_vs_reference_shape",
+                    "pipeline_posterior_match",
+                    "nested_speedup_vs_reference_shape",
+                    "nested_posterior_match",
+                    "nested_lnZ_agree",
+                    "north_star_met") if k in ns}
         except ValueError:
             pass   # truncated/in-flight file must not sink the metric
     print(json.dumps(out))
