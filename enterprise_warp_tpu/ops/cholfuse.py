@@ -1,0 +1,334 @@
+"""Fused batched f32 preconditioner factorization for the mixed solve.
+
+The round-4 device roofline (``ROOFLINE.json``) put the mixed solve at
+0.6% of its FLOP/bandwidth ceilings: with the likelihood vmapped over
+walkers, XLA lowers ``jnp.linalg.cholesky`` / ``solve_triangular`` on
+TPU as sequential column sweeps — O(n) serialized micro-steps per
+batched call — and the jittered-retry ``where`` computes BOTH
+factorizations for every walker. The wall was dispatch/latency, not
+silicon.
+
+This module replaces that stage with one Pallas kernel that, per tile
+of walkers, entirely in VMEM:
+
+- factors ``Sn32 + j1*I`` (in-place right-looking Cholesky, stored as
+  the upper factor ``U = L^T``),
+- re-factors only when a walker went numerically indefinite
+  (``pl.when``-predicated tier-2 jitter retry; identity fallback tier-3
+  — same three-tier semantics as ``ops.kernel._mixed_psd_solve_logdet``),
+- back-substitutes for ``V = U^-1`` (`= Linv^T`),
+- forms the factorization-residual matrix
+  ``E = Linv (Sn32 - L L^T) Linv^T`` on the MXU
+
+so the whole preconditioner stage is a single dispatch instead of ~10
+latency-bound batched ops. Everything downstream (f64-residual
+refinement, logdet trace correction) keeps its existing XLA form — those
+are MXU-shaped batched matmuls that were never the bottleneck.
+
+Precision: identical class to the existing split path. The factorization
+is f32 (it is only a preconditioner; refinement targets the computed
+f64 Sigma), and ``E`` matches the ``delta_mode='split'`` error class —
+its ~eps_f32 per-product rounding is the documented ~1e-4 logdet noise
+at kappa~1e4, far below the split-Gram lnL error (see the delta_mode
+comment in ``ops.kernel``).
+
+Autodiff: the Pallas call carries a ``jax.custom_jvp`` whose rule
+differentiates the XLA implementation instead — gradient samplers (HMC,
+ADVI) stay exact at the old cost; value-only samplers get the fused
+kernel.
+
+Dispatch: ``chol_precond`` is a ``jax.custom_batching.custom_vmap`` op.
+Unbatched calls use the XLA path; under ``vmap`` (every sampler batches
+walkers this way) the rule routes to the Pallas kernel when the backend
+is TPU, ``EWT_PALLAS_CHOL`` != "0", and a one-time compile probe of the
+real kernel succeeds (the axon remote-compile path may lack Mosaic
+support; the probe keeps that failure out of the hot jit).
+
+Reference hot path being replaced:
+/root/reference/enterprise_warp/bilby_warp.py:19-35 (scalar per-theta
+callback; the reference has no batched-factorization analog at all).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import custom_batching
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_HIGH = jax.lax.Precision.HIGHEST
+
+
+def _tile_for(n):
+    """Walkers per Pallas program: bounded by VMEM (~6 (T,n,n) f32
+    buffers live at once)."""
+    if n <= 128:
+        return 8
+    if n <= 192:
+        return 4
+    if n <= 320:
+        return 2
+    return 1
+
+
+# --------------------------------------------------------------------
+# XLA implementation (CPU path, AD rule, and numerical reference)
+# --------------------------------------------------------------------
+
+def _fused_xla(Sn_b, j1, j2):
+    """Batched (B, n, n) three-tier factorization in plain XLA.
+
+    Returns ``(U, V, E)`` with ``U = L^T`` (upper Cholesky factor of the
+    jittered cast), ``V = U^-1 = Linv^T`` and
+    ``E = Linv (Sn - L L^T) Linv^T`` — the trio the fused mixed solve
+    consumes. Tier-2 runs under a batch-level ``lax.cond`` so a clean
+    batch pays one factorization, not two (the old vmapped ``where``
+    always paid both).
+    """
+    n = Sn_b.shape[-1]
+    f32 = Sn_b.dtype
+    eye = jnp.eye(n, dtype=f32)
+    L1 = jnp.linalg.cholesky(Sn_b + jnp.asarray(j1, f32) * eye)
+    bad1 = ~jnp.all(jnp.isfinite(L1), axis=(-2, -1))
+
+    def _retry(L):
+        jm = jnp.where(bad1, jnp.asarray(j2, f32), jnp.asarray(j1, f32))
+        L2 = jnp.linalg.cholesky(Sn_b + jm[:, None, None] * eye)
+        return jnp.where(bad1[:, None, None], L2, L)
+
+    L = jax.lax.cond(jnp.any(bad1), _retry, lambda L: L, L1)
+    bad2 = ~jnp.all(jnp.isfinite(L), axis=(-2, -1))
+    L = jnp.where(bad2[:, None, None], eye, L)
+    Linv = jax.scipy.linalg.solve_triangular(
+        L, jnp.broadcast_to(eye, L.shape), lower=True)
+    Delta = Sn_b - jnp.matmul(L, jnp.swapaxes(L, -1, -2),
+                              precision=_HIGH)
+    K = jnp.matmul(Linv, Delta, precision=_HIGH)
+    E = jnp.matmul(K, jnp.swapaxes(Linv, -1, -2), precision=_HIGH)
+    return (jnp.swapaxes(L, -1, -2), jnp.swapaxes(Linv, -1, -2), E)
+
+
+# --------------------------------------------------------------------
+# Pallas kernel
+# --------------------------------------------------------------------
+
+def _chol_kernel(j1_ref, j2_ref, Sn_ref, U_ref, V_ref, E_ref,
+                 X_ref, U2_ref):
+    T, n = Sn_ref.shape[0], Sn_ref.shape[1]
+    f32 = jnp.float32
+    j1 = j1_ref[0, 0]
+    j2 = j2_ref[0, 0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    eyem = (rows == cols).astype(f32)                   # (n, n)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)  # (1, n)
+
+    def _chol_into(jit_vec, out_ref):
+        """Right-looking Cholesky of Sn + diag(jit_vec), upper factor
+        into ``out_ref``. The working copy stays symmetric (the rank-1
+        update preserves symmetry), so 'column k' reads are row reads —
+        sublane-indexed, which the TPU layout supports."""
+        X_ref[:] = Sn_ref[:] + jit_vec[:, None, None] * eyem[None]
+        out_ref[:] = jnp.zeros((T, n, n), f32)
+
+        def step(k, carry):
+            rowk = X_ref[:, pl.ds(k, 1), :][:, 0, :]          # (T, n)
+            dkk = jnp.sum(jnp.where(lane == k, rowk, 0.0), axis=1)
+            ipiv = 1.0 / jnp.sqrt(dkk)                         # (T,)
+            lcol = jnp.where(lane >= k, rowk * ipiv[:, None], 0.0)
+            out_ref[:, pl.ds(k, 1), :] = lcol[:, None, :]
+            X_ref[:] = X_ref[:] - lcol[:, :, None] * lcol[:, None, :]
+            return carry
+
+        jax.lax.fori_loop(0, n, step, 0)
+
+    # tier 1
+    _chol_into(jnp.full((T,), j1, f32), U_ref)
+    bad1 = ~jnp.all(jnp.isfinite(U_ref[:]), axis=(1, 2))       # (T,)
+
+    # tier 2: only when some walker in the tile went indefinite
+    @pl.when(jnp.any(bad1))
+    def _():
+        _chol_into(jnp.where(bad1, j2, j1), U2_ref)
+        U_ref[:] = jnp.where(bad1[:, None, None], U2_ref[:], U_ref[:])
+
+    # tier 3: identity preconditioner — never NaN
+    bad2 = ~jnp.all(jnp.isfinite(U_ref[:]), axis=(1, 2))
+    U_ref[:] = jnp.where(bad2[:, None, None], eyem[None], U_ref[:])
+
+    # back substitution: V = U^-1 (upper), row i from rows > i
+    V_ref[:] = jnp.zeros((T, n, n), f32)
+
+    def bstep(irev, carry):
+        i = n - 1 - irev
+        urow = U_ref[:, pl.ds(i, 1), :][:, 0, :]               # (T, n)
+        dii = jnp.sum(jnp.where(lane == i, urow, 0.0), axis=1)
+        uoff = jnp.where(lane > i, urow, 0.0)
+        acc = jnp.sum(uoff[:, :, None] * V_ref[:], axis=1)     # (T, n)
+        onei = (lane == i).astype(f32)                          # (1, n)
+        V_ref[:, pl.ds(i, 1), :] = \
+            ((onei - acc) / dii[:, None])[:, None, :]
+        return carry
+
+    jax.lax.fori_loop(0, n, bstep, 0)
+
+    # E = Linv (Sn - L L^T) Linv^T = V^T (Sn - U^T U) V, on the MXU.
+    # Static unroll over the tile: Mosaic's batched-dot support is not
+    # relied on, and T is small.
+    for t in range(T):
+        Ut = U_ref[t]
+        Vt = V_ref[t]
+        # precision=HIGHEST: default TPU matmul precision would lower
+        # these f32 dots to bf16 passes, and E feeds the logdet trace
+        # correction (same rationale as the unfused path's K/E products)
+        utu = jax.lax.dot_general(
+            Ut, Ut, (((0,), (0,)), ((), ())),
+            preferred_element_type=f32, precision=_HIGH)
+        delta = Sn_ref[t] - utu
+        k1 = jax.lax.dot_general(
+            Vt, delta, (((0,), (0,)), ((), ())),
+            preferred_element_type=f32, precision=_HIGH)        # V^T D
+        E_ref[t] = jnp.dot(k1, Vt, preferred_element_type=f32,
+                           precision=_HIGH)
+
+
+def _pallas_fused_raw(Sn_b, j1, j2, interpret=False):
+    """Invoke the Pallas kernel on a (B, n, n) f32 batch."""
+    B, n = Sn_b.shape[0], Sn_b.shape[-1]
+    T = _tile_for(n)
+    Bp = -(-B // T) * T
+    if Bp != B:
+        # pad with identity matrices: finite work, no spurious tier-2
+        pad = jnp.broadcast_to(jnp.eye(n, dtype=Sn_b.dtype),
+                               (Bp - B, n, n))
+        Sn_b = jnp.concatenate([Sn_b, pad], axis=0)
+    j1a = jnp.full((1, 1), j1, jnp.float32)
+    j2a = jnp.full((1, 1), j2, jnp.float32)
+    out_shape = [jax.ShapeDtypeStruct((Bp, n, n), jnp.float32)] * 3
+    smem = pl.BlockSpec((1, 1), lambda i: (0, 0),
+                        memory_space=pltpu.SMEM)
+    tile = pl.BlockSpec((T, n, n), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    U, V, E = pl.pallas_call(
+        _chol_kernel,
+        grid=(Bp // T,),
+        in_specs=[smem, smem, tile],
+        out_specs=[tile, tile, tile],
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((T, n, n), jnp.float32),
+                        pltpu.VMEM((T, n, n), jnp.float32)],
+        interpret=interpret,
+    )(j1a, j2a, Sn_b)
+    if Bp != B:
+        U, V, E = U[:B], V[:B], E[:B]
+    return U, V, E
+
+
+@jax.custom_jvp
+def _pallas_fused(Sn_b, j1, j2):
+    return _pallas_fused_raw(Sn_b, j1, j2)
+
+
+@_pallas_fused.defjvp
+def _pallas_fused_jvp(primals, tangents):
+    # gradient samplers differentiate the XLA implementation — exact,
+    # at the pre-fusion cost; Pallas stays value-only
+    return jax.jvp(_fused_xla, primals, tangents)
+
+
+# --------------------------------------------------------------------
+# availability probe + dispatch
+# --------------------------------------------------------------------
+
+_PROBE_RESULT = None
+
+
+def _probe_once(interpret=False):
+    """Compile and run the real kernel on an n=80 tile and check it
+    against a float64 reference factorization. Raises on any compile
+    or execution failure; returns the accuracy verdict."""
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((80, 80)).astype(np.float64)
+    S = A @ A.T / 80 + np.eye(80)
+    d = np.sqrt(np.diag(S))
+    S = (S / d[:, None] / d[None, :]).astype(np.float32)
+    Sb = jnp.broadcast_to(jnp.asarray(S), (8, 80, 80))
+    U, V, E = _pallas_fused_raw(Sb, 1e-6, 3e-5, interpret=interpret)
+    ref = np.linalg.cholesky(np.asarray(S, np.float64)
+                             + 1e-6 * np.eye(80)).T
+    ok = np.all(np.isfinite(np.asarray(U)))
+    return bool(ok and np.allclose(np.asarray(U[0], np.float64), ref,
+                                   atol=1e-4))
+
+
+def pallas_chol_available():
+    """One-time compile-and-run probe of the real kernel (n=80 tile) on
+    the default backend. The axon remote-compile path may not support
+    Mosaic lowering; probing here keeps that failure out of the hot jit
+    (where it could not be caught). A failed probe is reported once —
+    a silently broken probe would silently disable the fast path."""
+    global _PROBE_RESULT
+    if _PROBE_RESULT is None:
+        try:
+            _PROBE_RESULT = _probe_once()
+        except Exception as exc:  # Mosaic/compile failure -> XLA path
+            import sys
+            print(f"# cholfuse: Pallas probe failed ({exc!r}); "
+                  "using the XLA preconditioner path", file=sys.stderr)
+            _PROBE_RESULT = False
+    return _PROBE_RESULT
+
+
+def _pallas_enabled():
+    if os.environ.get("EWT_PALLAS_CHOL", "1") == "0":
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    return pallas_chol_available()
+
+
+@custom_batching.custom_vmap
+def chol_precond(Sn32, j1, j2):
+    """Three-tier f32 preconditioner factorization of one equilibrated
+    matrix: ``(U, V, E)`` as in :func:`_fused_xla`. Under ``vmap`` the
+    batched rule dispatches the whole batch to the Pallas kernel on TPU
+    (one dispatch instead of O(n) latency-bound sweeps), and to batched
+    XLA with a batch-level tier-2 ``lax.cond`` elsewhere."""
+    U, V, E = _fused_xla(Sn32[None], j1, j2)
+    return U[0], V[0], E[0]
+
+
+# Above this matrix size the kernel's VMEM working set (in + 3 out +
+# 2 scratch (T, n, n) f32 buffers, double-buffered by the pipeline) no
+# longer fits on-chip even at T=1, and the n=80 availability probe says
+# nothing about whether Mosaic can still compile it — route such calls
+# (very large joint-PTA Schur complements) to the XLA path instead.
+_PALLAS_MAX_N = 448
+
+
+@chol_precond.def_vmap
+def _chol_precond_vmap(axis_size, in_batched, Sn32, j1, j2):
+    del axis_size
+    if not in_batched[0] or in_batched[1] or in_batched[2]:
+        raise NotImplementedError(
+            "chol_precond expects the matrix batched and scalar jitters")
+    if Sn32.shape[-1] <= _PALLAS_MAX_N and _pallas_enabled():
+        out = _pallas_fused(Sn32, j1, j2)
+    else:
+        out = _fused_xla(Sn32, j1, j2)
+    return out, (True, True, True)
+
+
+def fused_chol_enabled():
+    """Module switch for the fused preconditioner path (read at trace
+    time; the likelihood builder resolves it once per build like its
+    other toggles)."""
+    return os.environ.get("EWT_FUSED_CHOL", "1") != "0"

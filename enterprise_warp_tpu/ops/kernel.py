@@ -304,7 +304,8 @@ def equilibrated_cholesky(S, jitter):
 
 
 def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
-                            delta_mode="tree", blocked=False):
+                            delta_mode="tree", blocked=False,
+                            fused=None):
     """Solve ``S Z = B`` and compute ``log|S|`` for symmetric PD ``S`` in
     mixed precision (TPU-fast: no emulated-f64 factorization).
 
@@ -328,6 +329,15 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     jitter-regularized solve whose effective condition is bounded by
     ``1/jitter`` — instead of silently diverging; only ``gram_mode='f64'``
     is oracle-grade through kappa ~1e15.
+
+    ``fused`` (None = auto: on for ``delta_mode='split'`` unless
+    ``EWT_FUSED_CHOL=0``) routes the whole f32 preconditioner stage —
+    three-tier factorization, triangular inverse, factorization-residual
+    matrix ``E`` — through :mod:`ops.cholfuse`: one Pallas dispatch on
+    TPU instead of the O(n) latency-bound column sweeps the round-4
+    roofline showed at 0.6% of ceiling. Identical precision class
+    (f32 preconditioner + split-mode ``E``); the refined solves and the
+    trace-corrected logdet are unchanged downstream.
 
     Returns ``(Z, logdet)`` with ``Z`` (n, k) f64.
     """
@@ -360,35 +370,55 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     Sn = S * s[:, None] * s[None, :]
     Sn = jnp.fill_diagonal(
         Sn, jnp.where(null, 1.0, jnp.diagonal(Sn)), inplace=False)
+    if fused is None:
+        from .cholfuse import fused_chol_enabled
+        # an explicit blocked-factorization request (EWT_BLOCKED_CHOL)
+        # outranks the fused auto-route — the toggle must never no-op
+        fused = (delta_mode == "split" and not blocked
+                 and fused_chol_enabled())
     Sn32 = Sn.astype(jnp.float32)
     eye = jnp.eye(n, dtype=jnp.float32)
-    _chol = blocked_cholesky if blocked else jnp.linalg.cholesky
-    L = _chol(Sn32 + jnp.float32(jitter) * eye)
-    bad = ~jnp.all(jnp.isfinite(L))
-    L = jnp.where(bad, _chol(Sn32 + jnp.float32(jitter2) * eye),
-                  L)
-    # last-resort Jacobi preconditioner: when the equilibrated cast is so
-    # far from PSD that both jittered factorizations fail (numerically
-    # null Schur rows with relatively large off-diagonal residue), fall
-    # back to L = I — never NaN. The refined/plain residual comparison
-    # below then picks the better finite solution, and the logdet trace
-    # correction gates itself off, leaving a bounded diagonal
-    # approximation where the alternative was poisoning the walker with
-    # NaN -> -inf.
-    L = jnp.where(jnp.all(jnp.isfinite(L)), L, eye)
+    if fused:
+        # single-dispatch preconditioner stage (ops.cholfuse): U = L^T,
+        # Vu = U^-1 = Linv^T, E32f = Linv (Sn32 - L L^T) Linv^T — same
+        # three-tier jitter semantics and precision class as the branch
+        # below, minus the latency-bound column sweeps
+        from .cholfuse import chol_precond
+        U, Vu, E32f = chol_precond(Sn32, float(jitter), float(jitter2))
+        diagL = jnp.diagonal(U)
 
-    # One explicit triangular inverse turns every preconditioner solve
-    # into two tiny MXU matmuls: XLA's batched triangular solve is a
-    # sequential column sweep on TPU, and the solve is hit 2x per
-    # refinement step. Inverse-application error is the same
-    # O(kappa(L) eps_f32) class as the trisolve — and the refinement
-    # targets the computed Sn, so preconditioner quality only affects
-    # the contraction rate, not the answer.
-    Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+        def psolve(R):
+            x = jnp.matmul(Vu.T, R.astype(jnp.float32), precision=_HIGH)
+            return jnp.matmul(Vu, x, precision=_HIGH).astype(f64)
+    else:
+        _chol = blocked_cholesky if blocked else jnp.linalg.cholesky
+        L = _chol(Sn32 + jnp.float32(jitter) * eye)
+        bad = ~jnp.all(jnp.isfinite(L))
+        L = jnp.where(bad, _chol(Sn32 + jnp.float32(jitter2) * eye),
+                      L)
+        # last-resort Jacobi preconditioner: when the equilibrated cast
+        # is so far from PSD that both jittered factorizations fail
+        # (numerically null Schur rows with relatively large
+        # off-diagonal residue), fall back to L = I — never NaN. The
+        # refined/plain residual comparison below then picks the better
+        # finite solution, and the logdet trace correction gates itself
+        # off, leaving a bounded diagonal approximation where the
+        # alternative was poisoning the walker with NaN -> -inf.
+        L = jnp.where(jnp.all(jnp.isfinite(L)), L, eye)
 
-    def psolve(R):
-        x = jnp.matmul(Linv, R.astype(jnp.float32), precision=_HIGH)
-        return jnp.matmul(Linv.T, x, precision=_HIGH).astype(f64)
+        # One explicit triangular inverse turns every preconditioner
+        # solve into two tiny MXU matmuls: XLA's batched triangular
+        # solve is a sequential column sweep on TPU, and the solve is
+        # hit 2x per refinement step. Inverse-application error is the
+        # same O(kappa(L) eps_f32) class as the trisolve — and the
+        # refinement targets the computed Sn, so preconditioner quality
+        # only affects the contraction rate, not the answer.
+        Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+        diagL = jnp.diagonal(L)
+
+        def psolve(R):
+            x = jnp.matmul(Linv, R.astype(jnp.float32), precision=_HIGH)
+            return jnp.matmul(Linv.T, x, precision=_HIGH).astype(f64)
 
     # f64 matmuls lower ~7x faster on TPU as broadcast-multiply +
     # tree-sum than as emulated-f64 dots (same accuracy: genuine f64
@@ -435,16 +465,19 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     # 'tree' (exact f64) is the default for oracle-grade small-n logdets;
     # 'split' is for the large joint-PTA Schur complement where O(n^3)
     # f64 tree ops are prohibitive and the tolerance is looser.
-    if delta_mode == "split":
-        Lp = _pad_to_chunk(L.T, (-n) % _CHUNK)
-        LLt = _chunked_f32_gram(Lp, Lp)
+    if fused:
+        E = E32f.astype(f64)
     else:
-        LLt = mm64(L.astype(f64), L.astype(f64).T)
-    Delta = (Sn - LLt).astype(jnp.float32)
-    # full f32 precision: default matmul would lower these to bf16
-    # passes, and the Delta products feed the logdet trace correction
-    K = jnp.matmul(Linv, Delta, precision=_HIGH)
-    E = jnp.matmul(Linv, K.T, precision=_HIGH).astype(f64)
+        if delta_mode == "split":
+            Lp = _pad_to_chunk(L.T, (-n) % _CHUNK)
+            LLt = _chunked_f32_gram(Lp, Lp)
+        else:
+            LLt = mm64(L.astype(f64), L.astype(f64).T)
+        Delta = (Sn - LLt).astype(jnp.float32)
+        # full f32 precision: default matmul would lower these to bf16
+        # passes, and the Delta products feed the logdet trace correction
+        K = jnp.matmul(Linv, Delta, precision=_HIGH)
+        E = jnp.matmul(Linv, K.T, precision=_HIGH).astype(f64)
     E32 = E.astype(jnp.float32)
     E2 = E32 @ E32
     corr = (jnp.trace(E) - jnp.sum(E * E.T) / 2.0
@@ -453,7 +486,7 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     # the trace expansion converges for ||E|| < 1; outside it, keep the
     # (jitter-regularized) preconditioner logdet uncorrected
     corr = jnp.where(jnp.sum(E * E) < 0.09, corr, 0.0)
-    logdet = (2.0 * jnp.sum(jnp.log(jnp.diagonal(L).astype(f64)))
+    logdet = (2.0 * jnp.sum(jnp.log(diagL.astype(f64)))
               + corr + jnp.sum(jnp.log(d)))
     return s[:, None] * Z, logdet
 
