@@ -246,13 +246,40 @@ def main():
         "value": round(device_eps, 1),
         "unit": "evals/s (batch=%d, ntoa=334, nbasis=80+tm)" % BATCH,
         "vs_baseline": round(device_eps / cpu_eps, 2),
+        # baseline provenance (round-4 verdict: cross-round vs_baseline
+        # values are incomparable without it — the theta regime alone
+        # moved the 1-core rate ~4x)
+        "baseline": {
+            "evals_per_s": round(cpu_eps, 1),
+            "impl": "1-core pure-numpy Woodbury, one theta per call",
+            "theta_regime": "posterior-typical (x86-subnormal-safe)",
+        },
     }
-    if not device_ok:
+    cache_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "DEVICE_BENCH_CACHE.json")
+    if device_ok:
+        # persist the device measurement so a later tunnel-down bench
+        # can still echo a real device number (flagged stale)
+        with open(cache_path + ".tmp", "w") as fh:
+            json.dump({"value": out["value"],
+                       "vs_baseline": out["vs_baseline"],
+                       "baseline": out["baseline"],
+                       "measured_at":
+                           time.strftime("%Y-%m-%dT%H:%M:%S")}, fh,
+                      indent=1)
+        os.replace(cache_path + ".tmp", cache_path)
+    else:
         # The value above is the jax-CPU figure, NOT a device number.
         # Flag it so the record can never be misread as a TPU result.
         out["device_unavailable"] = True
         out["unit"] = "evals/s (jax-CPU fallback, device tunnel down; " \
             "batch=%d, ntoa=334, nbasis=80+tm)" % BATCH
+        try:
+            with open(cache_path) as fh:
+                cached = json.load(fh)
+            out["last_device"] = dict(cached, stale=True)
+        except (OSError, ValueError):
+            pass   # no prior device measurement to echo
     if sweep_aborted:
         out["sweep_aborted"] = sweep_aborted
     # echo the convergence-gated sampling measurement when it exists

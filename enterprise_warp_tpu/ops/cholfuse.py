@@ -274,10 +274,17 @@ def pallas_chol_available():
     a silently broken probe would silently disable the fast path."""
     global _PROBE_RESULT
     if _PROBE_RESULT is None:
+        import sys
         try:
             _PROBE_RESULT = _probe_once()
+            if not _PROBE_RESULT:
+                # compiled and ran but produced a WRONG factor (Mosaic
+                # lowering regression) — as disable-worthy as a crash,
+                # and just as much in need of a visible trace
+                print("# cholfuse: Pallas probe compiled but failed "
+                      "the accuracy check; using the XLA "
+                      "preconditioner path", file=sys.stderr)
         except Exception as exc:  # Mosaic/compile failure -> XLA path
-            import sys
             print(f"# cholfuse: Pallas probe failed ({exc!r}); "
                   "using the XLA preconditioner path", file=sys.stderr)
             _PROBE_RESULT = False

@@ -196,6 +196,11 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
                 if verbose:
                     print(f"  resuming at step {steps}", flush=True)
     def _diag(chains):
+        # R-hat is thinning-invariant; the Geyer ESS of the thinned
+        # chain is only a LOWER bound on total ESS while the stride is
+        # below the autocorrelation time, so the target_ess gate can
+        # overshoot (extra sampling) but never falsely pass — the safe
+        # direction for a convergence gate.
         stride = max(1, -(-chains.shape[1] // diag_max_kept))
         return summarize_chains(chains[:, ::stride],
                                 sampler.like.param_names)

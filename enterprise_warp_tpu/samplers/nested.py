@@ -27,7 +27,21 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _make_refill(like, nlive, kbatch, nsteps):
+def slide_effective(like, slide_moves=None):
+    """Whether the budget-slide walk move will actually run: it needs
+    the likelihood's (efac, equad) pair metadata AND all-Uniform priors
+    (the walk lives in the unit cube). Callers recording a slide A/B
+    must record THIS, not the requested flag — a silently-degraded ON
+    arm would fabricate a measured effect."""
+    pairs = list(getattr(like, "noise_pairs", None) or [])
+    from ..models.prior_mixin import PriorMixin
+    avail = bool(pairs) and PriorMixin._uniform_tables(like) is not None
+    if slide_moves is None:
+        return avail
+    return bool(slide_moves) and avail
+
+
+def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
     """One jitted NS iteration: delete the K worst, refill by constrained
     random walks from random survivors. Likelihood device arrays flow in
     as the ``consts`` argument (samplers/evalproto.py)."""
@@ -43,10 +57,10 @@ def _make_refill(like, nlive, kbatch, nsteps):
     # the likelihood exposes pair metadata AND every prior is Uniform
     # (the walk lives in the unit cube; the slide needs the affine
     # theta<->u map).
+    use_slide = slide_effective(like, slide_moves)
     _pairs = list(getattr(like, "noise_pairs", None) or [])
     from ..models.prior_mixin import PriorMixin
     _tab = PriorMixin._uniform_tables(like)
-    use_slide = bool(_pairs) and _tab is not None
     if use_slide:
         import numpy as _np
         _lo, _hi = _np.asarray(_tab[0]), _np.asarray(_tab[1])
@@ -189,7 +203,8 @@ def _make_refill(like, nlive, kbatch, nsteps):
 
 def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
                kbatch=None, seed=0, max_iter=100000, verbose=True,
-               label="result", resume=True, checkpoint_every=50):
+               label="result", resume=True, checkpoint_every=50,
+               slide_moves=None):
     """Nested sampling over a compiled likelihood object.
 
     Returns a dict with ``log_evidence``, ``log_evidence_err``,
@@ -219,7 +234,8 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
             os.makedirs(outdir, exist_ok=True)
         ckpt_path = os.path.join(outdir, f"{label}_nested_ckpt.npz")
 
-    iteration = _make_refill(like, nlive, kbatch, nsteps)
+    iteration = _make_refill(like, nlive, kbatch, nsteps,
+                             slide_moves=slide_moves)
     from .evalproto import eval_protocol
     _consts = eval_protocol(like)[2]
 
@@ -381,6 +397,7 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
         log_evidence_err=lnz_err,
         log_noise_evidence=float("nan"),
         sampler="enterprise_warp_tpu.nested",
+        slide_moves_effective=slide_effective(like, slide_moves),
         parameter_labels=list(like.param_names),
         posterior={n: posterior[:, i].tolist()
                    for i, n in enumerate(like.param_names)},

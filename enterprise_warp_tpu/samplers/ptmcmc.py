@@ -436,7 +436,16 @@ class PTSampler:
                     # Jacobian ratio is e/e'.
                     upper = jnp.minimum(pair_qhi[b],
                                         0.5 * jnp.log10(v) - 1e-6)
+                    # the global draw is only a valid MH move when the
+                    # reachable range is non-empty AND the reverse draw
+                    # (same v, same range) can reach the current q —
+                    # states inside the 1e-6 guard band of the upper
+                    # bound are outside the proposal's support, so
+                    # moves from them must reject, not carry a tiny
+                    # detailed-balance asymmetry
                     lo = jnp.minimum(pair_qlo[b], upper - 1e-6)
+                    glob_ok = (pair_qlo[b] < upper) & (q >= lo) \
+                        & (q <= upper)
                     q_glob = lo + (upper - lo) * \
                         jax.random.uniform(fkey)
                     f_glob = jnp.clip(10.0 ** (2.0 * q_glob) / v,
@@ -454,6 +463,7 @@ class PTSampler:
                     # proposal's q-range identical both ways (same v)
                     qc_glob = jnp.log(jnp.maximum(e, 1e-30)) \
                         - jnp.log(jnp.maximum(e_new, 1e-30))
+                    qc_glob = jnp.where(glob_ok, qc_glob, -jnp.inf)
                     # local correction: (v,f) Jacobian + logit-normal
                     # density, combined = 0.5 log1p(-f) - 0.5 log1p(-f0)
                     qc_loc = 0.5 * jnp.log1p(-f) \

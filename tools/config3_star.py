@@ -1,0 +1,369 @@
+"""Config-3 north-star: a CONVERGED, posterior-gated joint-GWB run.
+
+Round-4 verdict #4: the multi-pulsar joint fit is where the chip wins
+big (per-eval ~80x vs the CPU dense oracle at 45 psr), but the repo had
+no converged sampling run of it — only throughput. This tool runs the
+whole north-star protocol on a modest joint problem (default 10 pulsars,
+334 TOAs, per-pulsar red noise + Hellings-Downs-correlated GWB with an
+injected signal on the common grid):
+
+- ``scalar``: times a single-threaded pure-numpy DENSE joint eval (the
+  reference-shaped cost: one theta per call, no jax anywhere), validated
+  against the framework's f64 likelihood on lnL differences;
+- ``cpu``: f64 jax-CPU leg, 4 chains, convergence-gated (split R-hat
+  <= 1.01, ESS >= 400);
+- ``device``: the TPU leg, 128 walkers, ensemble jump mix + tempered
+  anneal init, same gates; posterior matched against the cpu leg with
+  the same error-aware gate as ``tools/north_star.py``.
+
+Artifacts merge into CONFIG3_STAR.partial.json; once scalar+cpu+device
+are present the gated CONFIG3_STAR.json is assembled. Every leg flushes
+on completion, so a tunnel drop costs one leg, not the run.
+
+Usage: python tools/config3_star.py legs scalar,cpu   (no tunnel needed)
+       python tools/config3_star.py legs device        (chip required)
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+PARTIAL = os.path.join(REPO, "CONFIG3_STAR.partial.json")
+FINAL = os.path.join(REPO, "CONFIG3_STAR.json")
+
+# problem definition — part of the artifact fingerprint
+NPSR = 10
+NTOA = 334
+NRED = 10          # per-pulsar red-noise Fourier modes
+NGW = 10           # common-process modes
+SEED = 21
+INJ = dict(efac=1.1, red_lgA=-13.3, red_gamma=4.0,
+           gw_lgA=-13.6, gw_gamma=4.33)
+TARGET_ESS = 400.0
+RHAT_MAX = 1.01
+MAX_STEPS = 200_000
+META = dict(npsr=NPSR, ntoa=NTOA, nred=NRED, ngw=NGW, seed=SEED,
+            inj=INJ, target_ess=TARGET_ESS, rhat_max=RHAT_MAX,
+            scalar_w=8)
+
+
+def build_pta(seed=SEED):
+    from enterprise_warp_tpu.sim.noise import (fourier_design,
+                                               inject_basis_process,
+                                               inject_white,
+                                               make_fake_pta, red_psd)
+    from enterprise_warp_tpu.parallel.orf import hd_matrix
+    from enterprise_warp_tpu.sim.noise import df_from_freqs
+
+    psrs = make_fake_pta(npsr=NPSR, ntoa=NTOA, seed=seed,
+                         backends=("X", "Y"), freqs_mhz=(1400.0,))
+    rng = np.random.default_rng(seed)
+    for p in psrs:
+        p.residuals = np.zeros(len(p))
+        inject_white(p, efac=INJ["efac"], rng=rng)
+        inject_basis_process(p, log10_A=INJ["red_lgA"],
+                             gamma=INJ["red_gamma"], components=NRED,
+                             rng=rng)
+
+    # HD-correlated GWB on the COMMON grid (the same PTA-wide span the
+    # model's CommonTerm basis uses — parallel/pta.py common_grid)
+    t0 = min(p.toas.min() for p in psrs)
+    t1 = max(p.toas.max() for p in psrs)
+    Tspan = t1 - t0
+    pos = np.stack([p.pos for p in psrs])
+    gam = hd_matrix(pos, auto=True)
+    Lg = np.linalg.cholesky(gam + 1e-10 * np.eye(NPSR))
+    Fs, phi = [], None
+    for p in psrs:
+        F, freqs = fourier_design(p.toas - t0, NGW, Tspan)
+        Fs.append(F)
+        if phi is None:
+            df = df_from_freqs(freqs)
+            phi = np.repeat(
+                red_psd(freqs, INJ["gw_lgA"], INJ["gw_gamma"]) * df, 2)
+    coeffs = Lg @ rng.standard_normal((NPSR, 2 * NGW)) * np.sqrt(phi)
+    for p, F, c in zip(psrs, Fs, coeffs):
+        p.residuals = p.residuals + F @ c
+    return psrs
+
+
+def build_like(gram_mode="split", seed=SEED):
+    from enterprise_warp_tpu.models import StandardModels, TermList
+    from enterprise_warp_tpu.parallel import build_pta_likelihood
+
+    psrs = build_pta(seed)
+    tls = []
+    for p in psrs:
+        m = StandardModels(psr=p)
+        tls.append(TermList(p, [
+            m.efac("by_backend"),
+            m.spin_noise(f"powerlaw_{NRED}_nfreqs"),
+            m.gwb(f"hd_vary_gamma_{NGW}_nfreqs")]))
+    return build_pta_likelihood(psrs, tls, gram_mode=gram_mode), psrs
+
+
+# ------------------------------------------------------------------ #
+# scalar numpy dense joint eval (the reference-shaped cost)
+# ------------------------------------------------------------------ #
+
+def make_scalar_eval(psrs, names):
+    """Single-threaded numpy dense-Woodbury joint eval, one theta per
+    call — the cost shape of the reference stack's common-signal PTA
+    likelihood (scipy cholesky over the stacked basis). Theta indices
+    are resolved from ``names`` (the builder's param_names)."""
+    from enterprise_warp_tpu.parallel.orf import hd_matrix
+    from enterprise_warp_tpu.sim.noise import (df_from_freqs,
+                                               fourier_design, red_psd)
+    from scipy.linalg import cho_factor, cho_solve
+
+    t0 = min(p.toas.min() for p in psrs)
+    t1 = max(p.toas.max() for p in psrs)
+    Tspan_c = t1 - t0
+    pos = np.stack([p.pos for p in psrs])
+    gam = hd_matrix(pos, auto=True)
+
+    statics = []
+    for p in psrs:
+        Fr, fr = fourier_design(p.toas - p.toas.min(), NRED, p.Tspan)
+        Fg, fg = fourier_design(p.toas - t0, NGW, Tspan_c)
+        M = p.Mmat / np.linalg.norm(p.Mmat, axis=0)
+        backends = sorted(set(p.backend_flags))
+        bmask = np.stack([p.backend_flags == b for b in backends])
+        # theta indices resolved BY NAME — positional assumptions about
+        # the builder's parameter ordering would silently mis-evaluate
+        i_ef = [names.index(f"{p.name}_{b}_efac") for b in backends]
+        i_red = (names.index(f"{p.name}_red_noise_log10_A"),
+                 names.index(f"{p.name}_red_noise_gamma"))
+        statics.append(dict(
+            r=p.residuals, s2=p.toaerrs ** 2, bmask=bmask,
+            i_ef=np.asarray(i_ef), i_red=i_red,
+            Fr=Fr, dfr=df_from_freqs(fr), fr=fr,
+            Fg=Fg, dfg=df_from_freqs(fg), fg=fg, M=M))
+    ntm = statics[0]["M"].shape[1]
+    TM_PHI = 1e40
+    gw_name = "gw" if "gw_log10_A" in names else "gw_hd"
+    i_gw = (names.index(f"{gw_name}_log10_A"),
+            names.index(f"{gw_name}_gamma"))
+
+    def ev(theta):
+        lnl = 0.0
+        Ts, lndets = [], 0.0
+        for st in statics:
+            efacs = theta[st["i_ef"]]
+            lgA, gam_r = theta[st["i_red"][0]], theta[st["i_red"][1]]
+            nvar = st["s2"] * (st["bmask"].T @ efacs ** 2)
+            w = 1.0 / nvar
+            T = np.concatenate([st["Fr"], st["Fg"], st["M"]], axis=1)
+            Tw = T * w[:, None]
+            Ts.append((T, Tw))
+            lnl -= 0.5 * (st["r"] @ (w * st["r"]))
+            lndets += np.sum(np.log(nvar))
+            phi_r = np.repeat(
+                red_psd(st["fr"], lgA, gam_r) * st["dfr"], 2)
+            st["_phi_r"] = phi_r
+        gw_lgA, gw_gam = theta[i_gw[0]], theta[i_gw[1]]
+        phi_g = np.repeat(
+            red_psd(statics[0]["fg"], gw_lgA, gw_gam)
+            * statics[0]["dfg"], 2)
+
+        # dense Sigma = B^-1 + T^T N^-1 T over stacked per-psr bases
+        nb = 2 * NRED + 2 * NGW + ntm
+        n_tot = NPSR * nb
+        Sigma = np.zeros((n_tot, n_tot))
+        x = np.zeros(n_tot)
+        lnb = 0.0
+        for pi, (st, (T, Tw)) in enumerate(zip(statics, Ts)):
+            sl = slice(pi * nb, (pi + 1) * nb)
+            Sigma[sl, sl] += Tw.T @ T
+            x[sl] = Tw.T @ st["r"]
+            lnb += np.sum(np.log(st["_phi_r"]))
+        lnb += NPSR * ntm * np.log(TM_PHI)
+        # prior inverse: per-psr red/tm diagonal; GW coupled via the
+        # per-mode (npsr x npsr) HD inverse
+        gami = np.linalg.inv(gam)
+        sign, ld_gam = np.linalg.slogdet(gam)
+        lnb += 2 * NGW * ld_gam + NPSR * np.sum(np.log(phi_g))
+        for pi, st in enumerate(statics):
+            sl0 = pi * nb
+            ii = np.arange(sl0, sl0 + 2 * NRED)
+            Sigma[ii, ii] += 1.0 / st["_phi_r"]
+            it = np.arange(sl0 + 2 * NRED + 2 * NGW, sl0 + nb)
+            Sigma[it, it] += 1.0 / TM_PHI
+        for k in range(2 * NGW):
+            idx = np.arange(NPSR) * nb + 2 * NRED + k
+            Sigma[np.ix_(idx, idx)] += gami / phi_g[k]
+        c, low = cho_factor(Sigma, lower=True)
+        z = cho_solve((c, low), x)
+        lnl += 0.5 * (x @ z)
+        lnl -= 0.5 * (lndets + lnb
+                      + 2.0 * np.sum(np.log(np.diag(c))))
+        return lnl
+
+    return ev
+
+
+def scalar_leg():
+    """Time the scalar loop; validate lnL DIFFERENCES against the f64
+    framework likelihood (additive constants differ by convention)."""
+    like, psrs = build_like("f64")
+    names = like.param_names
+    ev = make_scalar_eval(psrs, names)
+    rng = np.random.default_rng(3)
+    th0 = np.empty(like.ndim)
+    for i, n in enumerate(names):
+        th0[i] = (1.1 if "efac" in n else
+                  -13.5 if n.endswith("log10_A") else 4.0)
+    thetas = th0 + 0.02 * rng.standard_normal((6, like.ndim))
+    ours = np.array([float(like.loglike(t)) for t in thetas])
+    theirs = np.array([ev(t) for t in thetas])
+    d = (ours - ours[0]) - (theirs - theirs[0])
+    if np.abs(d).max() > 2e-2 * max(1.0, np.abs(ours - ours[0]).max()):
+        raise SystemExit(f"scalar eval disagrees with f64 oracle: {d}")
+    n_ev, t0 = 30, time.perf_counter()
+    for i in range(n_ev):
+        ev(thetas[i % len(thetas)])
+    rate = n_ev / (time.perf_counter() - t0)
+    return dict(scalar_evals_per_s=round(rate, 2),
+                cross_check_max_diff=float(np.abs(d).max()))
+
+
+# ------------------------------------------------------------------ #
+# sampling legs
+# ------------------------------------------------------------------ #
+
+LEGS = {
+    # both legs run the ensemble jump mix (cg/kde decorrelate the
+    # GWB-amplitude/red-noise degeneracies that stall the classic
+    # SCAM/AM/DE mix at rhat~1.3 for tens of thousands of steps);
+    # giving the CPU leg the same mix keeps the comparison same-
+    # algorithm and makes the device speedup claim conservative
+    "cpu": dict(gram_mode="f64", nchains=4, ntemps=2,
+                check_every=1000, block_size=500,
+                scam_weight=8, am_weight=2, de_weight=15,
+                prior_weight=10, cg_weight=15, cg_k=3,
+                kde_weight=20),
+    "device": dict(gram_mode="split", nchains=128, ntemps=1,
+                   check_every=200, block_size=100,
+                   scam_weight=8, am_weight=2, de_weight=15,
+                   prior_weight=10, cg_weight=15, cg_k=3,
+                   kde_weight=20,
+                   anneal=dict(schedule=[64.0, 16.0, 4.0],
+                               steps_per=100)),
+}
+
+
+def run_sampling_leg(name):
+    import tempfile
+
+    from enterprise_warp_tpu.samplers.convergence import \
+        sample_to_convergence
+    from enterprise_warp_tpu.samplers.ptmcmc import PTSampler
+    from enterprise_warp_tpu.utils.compilecache import \
+        enable_compilation_cache
+
+    enable_compilation_cache()
+    cfg = dict(LEGS[name])
+    like, _ = build_like(cfg.pop("gram_mode"))
+    anneal = cfg.pop("anneal", None)
+    drive = dict(check_every=cfg.pop("check_every"),
+                 block_size=cfg.pop("block_size"))
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as outdir:
+        sampler = PTSampler(like, outdir, seed=0, **cfg)
+        if anneal is not None:
+            sampler.anneal_init(schedule=anneal["schedule"],
+                                steps_per=anneal["steps_per"])
+        rep = sample_to_convergence(
+            sampler, target_ess=TARGET_ESS, rhat_max=RHAT_MAX,
+            max_steps=MAX_STEPS, verbose=True, **drive)
+    wall = time.perf_counter() - t0
+    import jax
+    post = {k: {"mean": v["mean"], "std": v["std"],
+                "mean_err": v["std"] / max(v["ess"], 1.0) ** 0.5}
+            for k, v in rep.summary.items() if k != "_worst"}
+    return dict(LEGS[name], leg=name,
+                platform=jax.devices()[0].platform,
+                converged=bool(rep.converged),
+                steps=int(rep.steps), rhat_max=float(rep.rhat_max),
+                ess_min=float(rep.ess_min),
+                wall_s=round(wall, 2),
+                steady_wall_s=round(rep.steady_wall_s, 2),
+                posterior=post)
+
+
+def assemble(out):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from north_star import _posterior_match
+    pm = _posterior_match(out["device"], out["cpu"])
+    scalar_eps = out["scalar"]["scalar_evals_per_s"]
+    # same convention as tools/north_star.py: the reference-shaped stack
+    # pays W scalar evals per sampler step at the CPU leg's schedule
+    ref_wall = out["cpu"]["steps"] * META["scalar_w"] / scalar_eps
+    result = dict(
+        meta=META, scalar=out["scalar"], cpu=out["cpu"],
+        device=out["device"],
+        reference_shaped_wall_s=round(ref_wall, 1),
+        posterior_match=pm["match"],
+        worst_mean_shift_sigma=pm["mean"],
+        worst_mean_shift_sigma_noise_adjusted=pm["mean_adj"],
+        worst_std_ratio=pm["ratio"],
+        worst_std_ratio_noise_adjusted=pm["ratio_adj"],
+        # steady walls (first-block/compile excluded) — the same
+        # warm-cache convention as NORTH_STAR.json's same-named keys
+        speedup_vs_own_cpu=round(
+            out["cpu"]["steady_wall_s"] / out["device"]["steady_wall_s"],
+            2),
+        speedup_vs_reference_shape=round(
+            ref_wall / out["device"]["steady_wall_s"], 2))
+    with open(FINAL + ".tmp", "w") as fh:
+        json.dump(result, fh, indent=1)
+    os.replace(FINAL + ".tmp", FINAL)
+    print(json.dumps({k: v for k, v in result.items()
+                      if k not in ("cpu", "device", "meta")}))
+    return result
+
+
+def main(argv):
+    which = argv[argv.index("legs") + 1].split(",") \
+        if "legs" in argv else ["scalar", "cpu"]
+    out = {}
+    if os.path.exists(PARTIAL):
+        with open(PARTIAL) as fh:
+            out = json.load(fh)
+        if out.get("meta") != _jsonable(META):
+            print("dropping stale partial (problem changed)")
+            out = {}
+    out["meta"] = _jsonable(META)
+    for name in which:
+        if name in out and (name == "scalar"
+                            or out[name].get("converged")):
+            print(f"=== {name} already recorded; skipping ===")
+            continue
+        print(f"=== running {name} leg ===", flush=True)
+        out[name] = scalar_leg() if name == "scalar" \
+            else run_sampling_leg(name)
+        with open(PARTIAL + ".tmp", "w") as fh:
+            json.dump(out, fh, indent=1)
+        os.replace(PARTIAL + ".tmp", PARTIAL)
+    if all(k in out for k in ("scalar", "cpu", "device")) \
+            and out["cpu"].get("converged") \
+            and out["device"].get("converged"):
+        assemble(out)
+    else:
+        missing = [k for k in ("scalar", "cpu", "device")
+                   if k not in out]
+        print(f"partial saved; missing legs: {missing}")
+
+
+def _jsonable(x):
+    return json.loads(json.dumps(x))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

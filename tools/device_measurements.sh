@@ -67,6 +67,10 @@ python bench.py --configs > "$OUT/bench_configs.json" 2> "$OUT/bench_configs.err
 rc=$?
 echo "$(date +%H:%M:%S) bench configs rc=$rc" >> "$OUT/log"
 
+probe || { echo "$(date +%H:%M:%S) tunnel lost before config3" >> "$OUT/log"; exit 1; }
+stage "config3_star device leg" config3_device.log \
+  python tools/config3_star.py legs device
+
 probe || { echo "$(date +%H:%M:%S) tunnel lost before device leg" >> "$OUT/log"; exit 1; }
 stage "north_star device leg" north_star.log \
   python tools/north_star.py legs device

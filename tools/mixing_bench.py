@@ -323,9 +323,10 @@ def main():
     report["hypermodel_no_prior_draws"] = hop_rate(0, n)
     report["hypermodel_local_jumps_only"] = hop_rate(0, n, de_weight=0)
     if not quick:
-        report["flagship_ensemble"] = flagship_ensemble(
-        nsamp=(4000 if quick else 20000))
-    report.update(flagship_pt_vs_hmc())
+        # flagship-scale runs only in full mode: --quick is a smoke
+        # gate, and these two are the multi-minute benchmark legs
+        report["flagship_ensemble"] = flagship_ensemble(nsamp=20000)
+        report.update(flagship_pt_vs_hmc())
 
     if not quick:
         # --quick is a smoke mode; only full runs publish the artifact

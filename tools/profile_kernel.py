@@ -199,6 +199,19 @@ def main():
         return jax.vmap(lambda S, B: _mixed_psd_solve_logdet(
             S, B, 3e-6, refine=3, delta_mode="split"))(G, R)
 
+    # fused=False forces the pre-round-5 column-sweep preconditioner so
+    # the Pallas fusion's win is measured head-to-head on device
+    @jax.jit
+    def mixed_split_unfused(G, R):
+        return jax.vmap(lambda S, B: _mixed_psd_solve_logdet(
+            S, B, 3e-6, refine=3, delta_mode="split", fused=False))(G, R)
+
+    @jax.jit
+    def chol_fused_stage(G):
+        from enterprise_warp_tpu.ops.cholfuse import chol_precond
+        return jax.vmap(lambda S: chol_precond(
+            S.astype(jnp.float32), 3e-6, 9e-5))(G)
+
     @jax.jit
     def llt_tree(L):
         L6 = L.astype(jnp.float64)
@@ -242,6 +255,9 @@ def main():
 
     timeit("mixed solve+logdet (delta tree)", mixed_tree, G64, RHS)
     timeit("mixed solve+logdet (delta split)", mixed_split, G64, RHS)
+    timeit("mixed solve+logdet (split, UNfused)", mixed_split_unfused,
+           G64, RHS)
+    timeit("fused chol+inv+E stage alone", chol_fused_stage, G64)
     timeit("LLt f64 tree (nb^3)", llt_tree, Lf)
     timeit("LLt chunked f32 gram", llt_chunked, Lf)
     timeit("psolve via Linv matmuls", linv_matmul_psolve, Lf, RHS)
