@@ -1,0 +1,60 @@
+"""Config-3 north-star tooling (tools/config3_star.py).
+
+The scalar numpy dense joint eval IS the reference-shaped baseline the
+artifact prices the speedup against — its agreement with the f64
+oracle is load-bearing, so it is tested at small shapes (the tool
+itself re-validates at full shape before timing).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+
+@pytest.fixture()
+def small_cfg(monkeypatch):
+    import config3_star as c3
+    monkeypatch.setattr(c3, "NPSR", 3)
+    monkeypatch.setattr(c3, "NTOA", 64)
+    monkeypatch.setattr(c3, "NRED", 3)
+    monkeypatch.setattr(c3, "NGW", 3)
+    return c3
+
+
+def test_scalar_eval_matches_f64_oracle(small_cfg):
+    c3 = small_cfg
+    like, psrs = c3.build_like("f64")
+    ev = c3.make_scalar_eval(psrs, like.param_names)
+    max_diff, rel, _ = c3.cross_check(like, ev, n=4, spread=0.05,
+                                      seed=5)
+    assert rel < 1e-6, (max_diff, rel)
+
+
+def test_injected_signal_is_recoverable(small_cfg, monkeypatch):
+    # the injected HD-correlated GWB must raise the likelihood at the
+    # injected parameters relative to a no-GWB corner — a basic sanity
+    # check that the injection rides the same basis the model fits.
+    # At this test's tiny scale (3 psr, 64 TOAs) the artifact's default
+    # amplitude is genuinely sub-threshold (checked: delta lnL ~ -0.2),
+    # so the test injects louder (-12.5: delta lnL ~ +91).
+    c3 = small_cfg
+    monkeypatch.setattr(c3, "INJ", dict(c3.INJ, gw_lgA=-12.5))
+    like, _ = c3.build_like("f64")
+    names = like.param_names
+    th = np.empty(like.ndim)
+    for i, n in enumerate(names):
+        th[i] = (c3.INJ["efac"] if "efac" in n else
+                 c3.INJ["red_lgA"] if "red_noise_log10_A" in n else
+                 c3.INJ["red_gamma"] if "red_noise_gamma" in n else
+                 c3.INJ["gw_lgA"] if n.endswith("log10_A") else
+                 c3.INJ["gw_gamma"])
+    th_off = th.copy()
+    for i, n in enumerate(names):
+        if n.startswith("gw") and n.endswith("log10_A"):
+            th_off[i] = -19.0
+    assert float(like.loglike(th)) > float(like.loglike(th_off))

@@ -32,6 +32,12 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# the scalar leg is the 1-CORE reference-shaped baseline — pin BLAS
+# before numpy loads it (same convention as bench.py's numpy baseline)
+os.environ.setdefault("OMP_NUM_THREADS", "1")
+os.environ.setdefault("OPENBLAS_NUM_THREADS", "1")
+os.environ.setdefault("MKL_NUM_THREADS", "1")
+
 import numpy as np  # noqa: E402
 
 PARTIAL = os.path.join(REPO, "CONFIG3_STAR.partial.json")
@@ -208,29 +214,40 @@ def make_scalar_eval(psrs, names):
     return ev
 
 
-def scalar_leg():
-    """Time the scalar loop; validate lnL DIFFERENCES against the f64
-    framework likelihood (additive constants differ by convention)."""
-    like, psrs = build_like("f64")
-    names = like.param_names
-    ev = make_scalar_eval(psrs, names)
-    rng = np.random.default_rng(3)
+def cross_check(like, ev, n=6, spread=0.02, seed=3):
+    """Max |lnL-difference| disagreement between the scalar numpy eval
+    and the f64 framework likelihood over ``n`` moderate thetas
+    (additive constants differ by convention, so DIFFERENCES are
+    compared). Shared by scalar_leg() and tests/test_config3.py —
+    one validation convention, not two."""
+    rng = np.random.default_rng(seed)
     th0 = np.empty(like.ndim)
-    for i, n in enumerate(names):
-        th0[i] = (1.1 if "efac" in n else
-                  -13.5 if n.endswith("log10_A") else 4.0)
-    thetas = th0 + 0.02 * rng.standard_normal((6, like.ndim))
+    for i, nm in enumerate(like.param_names):
+        th0[i] = (1.1 if "efac" in nm else
+                  -13.5 if nm.endswith("log10_A") else 4.0)
+    thetas = th0 + spread * rng.standard_normal((n, like.ndim))
     ours = np.array([float(like.loglike(t)) for t in thetas])
     theirs = np.array([ev(t) for t in thetas])
     d = (ours - ours[0]) - (theirs - theirs[0])
-    if np.abs(d).max() > 2e-2 * max(1.0, np.abs(ours - ours[0]).max()):
-        raise SystemExit(f"scalar eval disagrees with f64 oracle: {d}")
+    rel = np.abs(d).max() / max(1.0, np.abs(ours - ours[0]).max())
+    return float(np.abs(d).max()), float(rel), thetas
+
+
+def scalar_leg():
+    """Time the scalar loop; validate it against the f64 framework
+    likelihood first."""
+    like, psrs = build_like("f64")
+    ev = make_scalar_eval(psrs, like.param_names)
+    max_diff, rel, thetas = cross_check(like, ev)
+    if rel > 2e-2:
+        raise SystemExit(
+            f"scalar eval disagrees with f64 oracle: {max_diff}")
     n_ev, t0 = 30, time.perf_counter()
     for i in range(n_ev):
         ev(thetas[i % len(thetas)])
     rate = n_ev / (time.perf_counter() - t0)
     return dict(scalar_evals_per_s=round(rate, 2),
-                cross_check_max_diff=float(np.abs(d).max()))
+                cross_check_max_diff=max_diff)
 
 
 # ------------------------------------------------------------------ #
