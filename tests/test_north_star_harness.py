@@ -96,3 +96,41 @@ def test_leg_dir_stamp_invalidation(tmp_path, monkeypatch):
     (d / "config.json").write_text('{"nchains": 4, "me')
     ns.prepare_leg_dir("cpu", ns.LEGS["cpu"])
     assert not (d / "chain_1.txt").exists()
+
+
+def _mk_leg(names, mean, std, std_err=0.0, mean_err=0.0, lnz=-262.0,
+            wall=100.0, steps=1000, **extra):
+    post = {n: {"mean": mean, "std": std, "std_err": std_err,
+                "mean_err": mean_err} for n in names}
+    leg = dict(posterior=post, steady_wall_s=wall, wall_s=wall,
+               steps=steps, lnZ=lnz, lnZ_err=0.16, evals=100000,
+               converged=True)
+    leg.update(extra)
+    return leg
+
+
+def test_assemble_pooled_nested_gate(tmp_path, monkeypatch):
+    """Two device seeds whose width estimates straddle the CPU leg's
+    (0.8x and 1.2x) must POOL to ~1.0x and pass the pooled gate even
+    though one single-seed ratio would be marginal; the pooled verdict
+    supersedes nested_posterior_match."""
+    ns = _load_ns()
+    monkeypatch.setattr(ns, "REPO", str(tmp_path))
+    names = ["a", "b"]
+    cpu = _mk_leg(names, mean=0.0, std=1.0)
+    dev = _mk_leg(names, mean=0.0, std=1.0, wall=500.0)
+    nd1 = _mk_leg(names, mean=0.02, std=0.8, std_err=0.01,
+                  mean_err=0.02, wall=10.0)
+    nd2 = _mk_leg(names, mean=-0.02, std=1.2, std_err=0.01,
+                  mean_err=0.02, lnz=-262.1, wall=10.0)
+    out = dict(device=dev, cpu=cpu, scalar_steps_per_s=300.0,
+               nested_device=nd1, nested_device2=nd2,
+               nested_cpu=_mk_leg(names, mean=0.0, std=1.0, wall=80.0))
+    res = ns.assemble(out)
+    # single-seed raw ratio is 1/0.8 = 1.25-class; pooled is 1.0
+    assert res["nested_pooled_worst_std_ratio"] <= 1.05
+    assert res["nested_pooled_posterior_match"] is True
+    assert res["nested_posterior_match"] is True
+    assert res["nested_device_seed_lnZ_agree"] is True
+    # both single-seed and pooled values stay published
+    assert "nested_worst_std_ratio" in res

@@ -50,8 +50,8 @@ echo "$(date +%H:%M:%S) device UP — warm compile cache" >> "$OUT/log"
 stage "warm_cache" warm_cache.log python tools/warm_cache.py
 
 probe || { echo "$(date +%H:%M:%S) tunnel lost before nested leg" >> "$OUT/log"; exit 1; }
-stage "north_star nested_device leg" north_star_nested.log \
-  python tools/north_star.py legs nested_device
+stage "north_star nested_device legs (2 seeds)" north_star_nested.log \
+  python tools/north_star.py legs nested_device,nested_device2
 
 probe || { echo "$(date +%H:%M:%S) tunnel lost before pipeline" >> "$OUT/log"; exit 1; }
 stage "north_star pipeline leg" north_star_pipeline.log \
