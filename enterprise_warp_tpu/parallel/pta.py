@@ -619,10 +619,18 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         Xn, Xm, Xg = X[:, :NW], X[:, NW:NW + MW], X[:, NW + MW:]
 
         # stage 1: mixed-precision factorization of the noise blocks,
-        # vmapped over the (sharded) pulsar axis
+        # vmapped over the (sharded) pulsar axis. The f64 oracle path
+        # keeps the tree-exact logdet; reduced-precision gram modes take
+        # the split/fused route (ops.cholfuse single-dispatch
+        # preconditioner on TPU) — its ~1e-4-class per-block logdet
+        # noise is far below the split Gram error this branch already
+        # carries, and the batched (walkers x pulsars) column sweeps it
+        # removes were the dominant latency of the joint device eval.
+        stage1_delta = "tree" if gram_mode == "f64" else "split"
         RHS = jnp.concatenate([Xn[:, :, None], H, Cng], axis=2)
         Z, ld_nn = jax.vmap(
-            lambda S, B: _mixed_psd_solve_logdet(S, B, jitter, refine=3)
+            lambda S, B: _mixed_psd_solve_logdet(
+                S, B, jitter, refine=3, delta_mode=stage1_delta)
         )(Gnn, RHS)
         Zx, ZH, ZC = Z[:, :, 0], Z[:, :, 1:1 + MW], Z[:, :, 1 + MW:]
 

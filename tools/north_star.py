@@ -798,8 +798,12 @@ def assemble(out):
                 nested_device_seed_lnZ_agree=bool(
                     dzd <= 3.0 * max(szd, 0.1)))
             # the pooled gate supersedes the single-seed one as the
-            # headline nested match verdict (both stay published)
-            nmatch = ppm2["match"]
+            # headline nested match verdict (both stay published) —
+            # but ONLY if the two seeds' lnZ estimates also reproduce:
+            # a same-platform reproducibility failure must block the
+            # headline claim, same as every other lnZ check here
+            nmatch = bool(ppm2["match"]
+                          and result["nested_device_seed_lnZ_agree"])
             result["nested_posterior_match"] = nmatch
         lnz_ok = None
         if "nested_cpu" in out:
