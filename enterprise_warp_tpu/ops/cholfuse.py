@@ -303,12 +303,7 @@ def _pallas_enabled():
 
 
 @custom_batching.custom_vmap
-def chol_precond(Sn32, j1, j2):
-    """Three-tier f32 preconditioner factorization of one equilibrated
-    matrix: ``(U, V, E)`` as in :func:`_fused_xla`. Under ``vmap`` the
-    batched rule dispatches the whole batch to the Pallas kernel on TPU
-    (one dispatch instead of O(n) latency-bound sweeps), and to batched
-    XLA with a batch-level tier-2 ``lax.cond`` elsewhere."""
+def _chol_precond_inner(Sn32, j1, j2):
     U, V, E = _fused_xla(Sn32[None], j1, j2)
     return U[0], V[0], E[0]
 
@@ -321,7 +316,7 @@ def chol_precond(Sn32, j1, j2):
 _PALLAS_MAX_N = 448
 
 
-@chol_precond.def_vmap
+@_chol_precond_inner.def_vmap
 def _chol_precond_vmap(axis_size, in_batched, Sn32, j1, j2):
     del axis_size
     if not in_batched[0] or in_batched[1] or in_batched[2]:
@@ -332,6 +327,73 @@ def _chol_precond_vmap(axis_size, in_batched, Sn32, j1, j2):
     else:
         out = _fused_xla(Sn32, j1, j2)
     return out, (True, True, True)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def chol_precond(Sn32, j1, j2):
+    """Three-tier f32 preconditioner factorization of one equilibrated
+    matrix: ``(U, V, E)`` as in :func:`_fused_xla`. Under ``vmap`` the
+    batched rule dispatches the whole batch to the Pallas kernel on TPU
+    (one dispatch instead of O(n) latency-bound sweeps), and to batched
+    XLA with a batch-level tier-2 ``lax.cond`` elsewhere.
+
+    The ``custom_vjp`` wrapper exists because ``custom_vmap`` defines
+    no AD rule: ``vmap(grad(...))`` — the HMC/ADVI per-chain pattern —
+    would die with "Linearization failed". The backward pass re-derives
+    the primal through the XLA twin and transposes it (exact,
+    pre-fusion cost); value-only calls and the forward pass keep the
+    fused dispatch."""
+    return _chol_precond_inner(Sn32, j1, j2)
+
+
+def _fused_xla_ad(Sn_b, j1, j2):
+    """AD-safe twin of :func:`_fused_xla`: identical primal values, but
+    every Cholesky runs on an input SANITIZED to the identity wherever
+    that tier's factorization failed (tier selection is detected on a
+    ``stop_gradient`` copy). Without this, the ``where`` over a failed
+    factorization back-propagates NaN — zero cotangent times the NaN
+    residuals of the dead branch — into every retried walker's gradient
+    (the classic double-``where`` trap)."""
+    n = Sn_b.shape[-1]
+    f32 = Sn_b.dtype
+    eye = jnp.eye(n, dtype=f32)
+
+    def _safe_chol(A):
+        A_ng = jax.lax.stop_gradient(A)
+        bad = ~jnp.all(jnp.isfinite(jnp.linalg.cholesky(A_ng)),
+                       axis=(-2, -1))
+        L = jnp.linalg.cholesky(jnp.where(bad[:, None, None], eye, A))
+        return L, bad
+
+    L1, bad1 = _safe_chol(Sn_b + jnp.asarray(j1, f32) * eye)
+    jm = jnp.where(bad1, jnp.asarray(j2, f32), jnp.asarray(j1, f32))
+    L2, bad2t = _safe_chol(Sn_b + jm[:, None, None] * eye)
+    L = jnp.where(bad1[:, None, None], L2, L1)
+    bad2 = jnp.where(bad1, bad2t, bad1)   # tier-3 = selected tier failed
+    L = jnp.where(bad2[:, None, None], eye, L)
+    Linv = jax.scipy.linalg.solve_triangular(
+        L, jnp.broadcast_to(eye, L.shape), lower=True)
+    Delta = Sn_b - jnp.matmul(L, jnp.swapaxes(L, -1, -2),
+                              precision=_HIGH)
+    K = jnp.matmul(Linv, Delta, precision=_HIGH)
+    E = jnp.matmul(K, jnp.swapaxes(Linv, -1, -2), precision=_HIGH)
+    return (jnp.swapaxes(L, -1, -2), jnp.swapaxes(Linv, -1, -2), E)
+
+
+def _chol_precond_fwd(Sn32, j1, j2):
+    return _chol_precond_inner(Sn32, j1, j2), Sn32
+
+
+def _chol_precond_bwd(j1, j2, Sn, ct):
+    def f(s):
+        U, V, E = _fused_xla_ad(s[None], j1, j2)
+        return U[0], V[0], E[0]
+
+    _, vjp = jax.vjp(f, Sn)
+    return vjp(ct)
+
+
+chol_precond.defvjp(_chol_precond_fwd, _chol_precond_bwd)
 
 
 def fused_chol_enabled():

@@ -100,6 +100,39 @@ class TestFusedXla:
             np.testing.assert_allclose(np.asarray(Vb[i]), np.asarray(v),
                                        rtol=1e-6, atol=1e-6)
 
+    def test_grad_finite_with_retried_walkers(self):
+        # the AD twin must sanitize failed factorizations (double-where)
+        # — a batch mixing clean, tier-2, and tier-3 walkers has to
+        # yield FINITE gradients for all of them, in both vmap(grad)
+        # and grad-of-vmap composition orders
+        n = 16
+        rng = np.random.default_rng(21)
+        Q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+        ev = np.linspace(0.5, 1.5, n)
+        ev[0] = -5e-5                       # tier-2 rescue case
+        Sb = jnp.asarray(np.stack([
+            _spd_batch(1, n, seed=2)[0],
+            ((Q * ev) @ Q.T).astype(np.float32),
+            -np.eye(n, dtype=np.float32),   # tier-3 identity fallback
+        ]))
+
+        def f(s):
+            U, V, E = chol_precond(s, 1e-6, 1e-3)
+            return jnp.sum(jnp.log(jnp.abs(jnp.diagonal(U)))) \
+                + jnp.sum(E)
+
+        g1 = jax.vmap(jax.grad(f))(Sb)
+        assert np.isfinite(np.asarray(g1)).all()
+        g2 = jax.grad(lambda s: jnp.sum(jax.vmap(f)(s)))(Sb)
+        assert np.isfinite(np.asarray(g2)).all()
+        # clean-walker gradients agree with direct differentiation of
+        # the XLA twin
+        g_ref = jax.grad(
+            lambda s: f(s[0]))(Sb[:1])
+        np.testing.assert_allclose(np.asarray(g1[0]),
+                                   np.asarray(g_ref[0]), rtol=1e-4,
+                                   atol=1e-6)
+
     def test_grad_through_vmapped_op(self):
         Sb = jnp.asarray(_spd_batch(2, 16, seed=5))
 

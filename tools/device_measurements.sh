@@ -68,8 +68,11 @@ rc=$?
 echo "$(date +%H:%M:%S) bench configs rc=$rc" >> "$OUT/log"
 
 probe || { echo "$(date +%H:%M:%S) tunnel lost before config3" >> "$OUT/log"; exit 1; }
+# bounded: config3_star has no in-process watchdog, and a tunnel drop
+# wedges device calls forever — the timeout kills the stage, the guard
+# respawns the chain, and the leg RESUMES from its .ns_runs checkpoint
 stage "config3_star device leg" config3_device.log \
-  python tools/config3_star.py legs device
+  timeout 5400 python tools/config3_star.py legs device
 
 probe || { echo "$(date +%H:%M:%S) tunnel lost before device leg" >> "$OUT/log"; exit 1; }
 stage "north_star device leg" north_star.log \

@@ -76,6 +76,9 @@ def north_star_table(ns):
             "pipeline_speedup_vs_reference_shape")
     leg_row("nested_device", "TPU nested (dynesty settings)",
             "nested_speedup_vs_reference_shape")
+    if "nested_device2" in ns:
+        leg_row("nested_device2",
+                "TPU nested, 2nd seed (pooled width gate)", "_none")
     leg_row("nested_cpu", "jax-CPU nested (same algorithm)", "_none")
 
     gates = []
@@ -83,8 +86,14 @@ def north_star_table(ns):
             ("posterior_match", "posterior_match"),
             ("pipeline_posterior_match", "pipeline_posterior_match"),
             ("nested_posterior_match", "nested_posterior_match"),
+            ("nested_pooled_posterior_match",
+             "nested_pooled_posterior_match"),
+            ("nested_pooled_worst_std_ratio",
+             "nested_pooled_worst_std_ratio"),
             ("nested_lnZ_delta", "nested_lnZ_delta"),
             ("nested_lnZ_agree", "nested_lnZ_agree"),
+            ("nested_device_seed_lnZ_agree",
+             "nested_device_seed_lnZ_agree"),
             ("north_star_met", "north_star_met")):
         if key in ns:
             gates.append(f"`{label}: {ns[key]}`")
@@ -129,6 +138,33 @@ def headline_lines(cache):
     return lines
 
 
+def config3_lines(c3):
+    lines = ["### Config-3 joint-GWB north star (generated from "
+             "CONFIG3_STAR.json)", ""]
+    lines += ["| leg | steady wall (s) | detail |", "|---|---|---|"]
+    sc = _need(c3, "scalar", "CONFIG3_STAR.json")
+    lines.append(
+        f"| reference-shaped scalar (1 core, dense numpy) | "
+        f"**{_need(c3, 'reference_shaped_wall_s', 'CONFIG3_STAR.json')}"
+        f"** | "
+        f"{_need(sc, 'scalar_evals_per_s', 'CONFIG3_STAR.json:scalar')} "
+        "evals/s, x-checked "
+        f"{_need(sc, 'cross_check_max_diff', 'CONFIG3_STAR.json:scalar'):.1e} |")
+    for leg in ("cpu", "device"):
+        if leg in c3:
+            d = c3[leg]
+            lines.append(
+                f"| {leg} ({d.get('platform', '?')}) | "
+                f"{d.get('steady_wall_s', '?')} | {d.get('steps', '?')}"
+                f" steps, rhat {round(d.get('rhat_max', 0), 4)}, "
+                f"ESS {round(d.get('ess_min', 0))} |")
+    gates = [f"`{k}: {c3[k]}`" for k in (
+        "posterior_match", "worst_std_ratio_noise_adjusted",
+        "speedup_vs_reference_shape", "speedup_vs_own_cpu") if k in c3]
+    lines += ["", "Gates: " + ", ".join(gates) + "."]
+    return lines
+
+
 def generate():
     parts = []
     ns = _load("NORTH_STAR.json")
@@ -136,6 +172,9 @@ def generate():
         parts += north_star_table(ns) + [""]
     else:
         parts += ["*(no NORTH_STAR.json committed yet)*", ""]
+    c3 = _load("CONFIG3_STAR.json")
+    if c3 is not None:
+        parts += config3_lines(c3) + [""]
     cache = _load("DEVICE_BENCH_CACHE.json")
     if cache is not None:
         parts += headline_lines(cache) + [""]

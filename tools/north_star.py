@@ -54,15 +54,19 @@ def leg_dir(name):
 
 
 def prepare_leg_dir(name, cfg):
-    """Create/validate a leg's persistent resume directory.
+    """Create/validate a leg's persistent resume directory (north-star
+    legs; see :func:`prepare_stamped_dir` for the invariant)."""
+    return prepare_stamped_dir(leg_dir(name), dict(cfg, meta=META))
 
-    Config stamp: a resume dir left by a killed run under a DIFFERENT
-    leg configuration or measurement definition must not warm-start this
+
+def prepare_stamped_dir(outdir, stamp):
+    """Create/validate a config-stamped resume directory.
+
+    A resume dir left by a killed run under a DIFFERENT leg
+    configuration or measurement definition must not warm-start this
     one (wrong nchains scrambles the chain reshape; wrong problem mixes
     parameters; old wall-clock pollutes the measurement) — mismatched
-    state is wiped."""
-    outdir = leg_dir(name)
-    stamp = dict(cfg, meta=META)
+    state is wiped. Shared with tools/config3_star.py."""
     stamp_path = os.path.join(outdir, "config.json")
     if os.path.isdir(outdir):
         old = None
