@@ -267,6 +267,16 @@ class EnterpriseWarpResult:
             if loaded is None:
                 if self.opts.info:
                     print("   (no chain found)")
+                    # nested runs publish a Bilby-schema result JSON
+                    # instead of PTMCMC chain files (same contract
+                    # split as the reference's --bilby flag at
+                    # results.py:104,1060) — point the user there
+                    import glob as _glob
+                    d = os.path.join(self.outdir_all, psr_dir)
+                    if _glob.glob(os.path.join(d, "*_result.json")):
+                        print("   found a *_result.json here — "
+                              "rerun with --bilby 1 to load nested-"
+                              "sampling output")
                 continue
             chain, diag, pars = loaded
             if self.opts.info:

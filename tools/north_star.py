@@ -142,8 +142,13 @@ LEGS = {
     # (no explicit seed key: run_leg defaults to seed 0, and adding
     # the key would change the config fingerprint and needlessly
     # invalidate already-recorded, behaviorally identical legs)
+    # refine=2: the mixed solve's accuracy knob — one fewer f64
+    # residual pass per eval; its ~10x-looser (still ~1e-2-class) lnL
+    # error is far inside the nested error budget, and the dev-vs-cpu
+    # lnZ agreement gate plus the pooled posterior gate validate it
+    # directly against the refine=3 f64 CPU leg
     "nested_device": dict(kind="nested", gram_mode="split", nlive=800,
-                          dlogz=0.1, nsteps=12, kbatch=400),
+                          dlogz=0.1, nsteps=12, kbatch=400, refine=2),
     # second independent device seed: NESTED_WIDTH_AB.json measured
     # ~15-20% seed-to-seed scatter in single-run width estimates (far
     # above the per-run bootstrap stderr), so the unbiased width test
@@ -151,7 +156,8 @@ LEGS = {
     # gate a pooled one, and their lnZ agreement is a same-platform
     # reproducibility check on top of the device-vs-cpu one
     "nested_device2": dict(kind="nested", gram_mode="split", nlive=800,
-                           dlogz=0.1, nsteps=12, kbatch=400, seed=1),
+                           dlogz=0.1, nsteps=12, kbatch=400, seed=1,
+                           refine=2),
     "nested_cpu": dict(kind="nested", gram_mode="f64", nlive=800,
                        dlogz=0.1, nsteps=12, kbatch=400),
 }
@@ -230,6 +236,16 @@ def run_leg(name):
     partial, so a finished leg never warm-starts a future re-measurement.
     """
     cfg = LEGS[name]
+    # per-leg accuracy knob: resolved HERE, in the leg process, from the
+    # same cfg the resume-dir fingerprint stamps — a leg invoked
+    # directly (`north_star.py leg <name>`) must build at the stamped
+    # refine, and a leg WITHOUT the key must not inherit an ambient
+    # EWT_REFINE (a degraded reference oracle would be recorded as
+    # current, invisibly to the stale-config check)
+    if "refine" in cfg:
+        os.environ["EWT_REFINE"] = str(cfg["refine"])
+    else:
+        os.environ.pop("EWT_REFINE", None)
     import numpy as np  # noqa: F401
 
     from enterprise_warp_tpu.samplers.convergence import \
