@@ -53,6 +53,20 @@ def leg_dir(name):
     return os.path.join(REPO, ".ns_runs", name)
 
 
+def apply_refine_env(cfg):
+    """Resolve the per-leg accuracy knob in THIS process, from the same
+    cfg the resume-dir fingerprint stamps: a leg (or warm-cache build)
+    with a ``refine`` key must build at exactly that refine, and one
+    WITHOUT the key must not inherit an ambient EWT_REFINE — a degraded
+    reference oracle (or a warmed HLO at the wrong accuracy) would be
+    recorded as current, invisibly to the stale-config check. Shared
+    with tools/warm_cache.py."""
+    if "refine" in cfg:
+        os.environ["EWT_REFINE"] = str(cfg["refine"])
+    else:
+        os.environ.pop("EWT_REFINE", None)
+
+
 def prepare_leg_dir(name, cfg):
     """Create/validate a leg's persistent resume directory (north-star
     legs; see :func:`prepare_stamped_dir` for the invariant)."""
@@ -236,16 +250,7 @@ def run_leg(name):
     partial, so a finished leg never warm-starts a future re-measurement.
     """
     cfg = LEGS[name]
-    # per-leg accuracy knob: resolved HERE, in the leg process, from the
-    # same cfg the resume-dir fingerprint stamps — a leg invoked
-    # directly (`north_star.py leg <name>`) must build at the stamped
-    # refine, and a leg WITHOUT the key must not inherit an ambient
-    # EWT_REFINE (a degraded reference oracle would be recorded as
-    # current, invisibly to the stale-config check)
-    if "refine" in cfg:
-        os.environ["EWT_REFINE"] = str(cfg["refine"])
-    else:
-        os.environ.pop("EWT_REFINE", None)
+    apply_refine_env(cfg)
     import numpy as np  # noqa: F401
 
     from enterprise_warp_tpu.samplers.convergence import \
@@ -530,7 +535,8 @@ def _drive_leg(name, cmd, env):
         t0 = time.time()
         while time.time() - t0 < PROBE_WAIT_S:
             if _device_reachable(env, require_accelerator=(
-                    name in ("device", "pipeline", "nested_device"))):
+                    name in ("device", "pipeline", "nested_device",
+                             "nested_device2"))):
                 break
             print(f"[{name} leg] device unreachable; retrying probe in "
                   "120s", flush=True)

@@ -28,7 +28,12 @@ from tools.north_star import LEGS, build_problem  # noqa: E402
 
 def main():
     from enterprise_warp_tpu.samplers.ptmcmc import PTSampler
+    from tools.north_star import apply_refine_env
     cfg = LEGS["pipeline"]
+    # same set-or-pop resolution as run_leg: an ambient EWT_REFINE must
+    # not bake a different accuracy into the warmed pipeline/device HLOs
+    # than the legs themselves will build
+    apply_refine_env(cfg)
     like = build_problem(cfg["gram_mode"])
     opts = dict(ntemps=cfg.get("ntemps", 2), nchains=cfg["nchains"],
                 seed=0)
@@ -46,15 +51,21 @@ def main():
                           steps_per=a["steps_per"], verbose=False)
         s.sample(cfg["block_size"], resume=False, verbose=False,
                  block_size=cfg["block_size"])
-    # the nested leg's iteration + init shapes
+    # the nested leg's iteration + init shapes — built at the LEG'S
+    # refine (the accuracy knob changes the HLO; warming the wrong one
+    # re-creates the round-4 cold-compile-inside-the-wall failure)
     ncfg = LEGS["nested_device"]
-    if ncfg["gram_mode"] == cfg["gram_mode"]:
-        from enterprise_warp_tpu.samplers.nested import run_nested
-        with tempfile.TemporaryDirectory() as d:
-            run_nested(like, outdir=d, nlive=ncfg["nlive"],
-                       dlogz=ncfg["dlogz"], nsteps=ncfg["nsteps"],
-                       kbatch=ncfg["kbatch"], seed=1, resume=False,
-                       verbose=False, max_iter=2, label="warm")
+    from enterprise_warp_tpu.samplers.nested import run_nested
+    apply_refine_env(ncfg)
+    nlike = like if ("refine" not in ncfg
+                     and ncfg["gram_mode"] == cfg["gram_mode"]) \
+        else build_problem(ncfg["gram_mode"])
+    with tempfile.TemporaryDirectory() as d:
+        run_nested(nlike, outdir=d, nlive=ncfg["nlive"],
+                   dlogz=ncfg["dlogz"], nsteps=ncfg["nsteps"],
+                   kbatch=ncfg["kbatch"], seed=1, resume=False,
+                   verbose=False, max_iter=2, label="warm")
+    apply_refine_env(LEGS["device"])   # restore for the block below
 
     # the vanilla device leg's block shape too
     dcfg = LEGS["device"]
