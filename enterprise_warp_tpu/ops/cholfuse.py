@@ -32,8 +32,8 @@ its ~eps_f32 per-product rounding is the documented ~1e-4 logdet noise
 at kappa~1e4, far below the split-Gram lnL error (see the delta_mode
 comment in ``ops.kernel``).
 
-Autodiff: the Pallas call carries a ``jax.custom_jvp`` whose rule
-differentiates the XLA implementation instead — gradient samplers (HMC,
+Autodiff: ``chol_precond`` carries a ``jax.custom_vjp`` whose backward
+pass differentiates an AD-safe XLA twin — gradient samplers (HMC,
 ADVI) stay exact at the old cost; value-only samplers get the fused
 kernel.
 
@@ -229,18 +229,6 @@ def _pallas_fused_raw(Sn_b, j1, j2, interpret=False):
     return U, V, E
 
 
-@jax.custom_jvp
-def _pallas_fused(Sn_b, j1, j2):
-    return _pallas_fused_raw(Sn_b, j1, j2)
-
-
-@_pallas_fused.defjvp
-def _pallas_fused_jvp(primals, tangents):
-    # gradient samplers differentiate the XLA implementation — exact,
-    # at the pre-fusion cost; Pallas stays value-only
-    return jax.jvp(_fused_xla, primals, tangents)
-
-
 # --------------------------------------------------------------------
 # availability probe + dispatch
 # --------------------------------------------------------------------
@@ -323,7 +311,10 @@ def _chol_precond_vmap(axis_size, in_batched, Sn32, j1, j2):
         raise NotImplementedError(
             "chol_precond expects the matrix batched and scalar jitters")
     if Sn32.shape[-1] <= _PALLAS_MAX_N and _pallas_enabled():
-        out = _pallas_fused(Sn32, j1, j2)
+        # AD never reaches this rule body: chol_precond's custom_vjp
+        # intercepts differentiation above, so the raw Pallas call
+        # needs no AD wrapper of its own
+        out = _pallas_fused_raw(Sn32, j1, j2)
     else:
         out = _fused_xla(Sn32, j1, j2)
     return out, (True, True, True)

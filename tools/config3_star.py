@@ -31,6 +31,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # the scalar leg is the 1-CORE reference-shaped baseline — pin BLAS
 # before numpy loads it (same convention as bench.py's numpy baseline)
@@ -295,7 +296,6 @@ def run_sampling_leg(name):
     # unattended chain wraps this stage in a timeout and respawns), and
     # a checkpoint from a DIFFERENT problem definition must be wiped,
     # not resumed (north_star.prepare_stamped_dir)
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from north_star import prepare_stamped_dir
     outdir = prepare_stamped_dir(
         os.path.join(REPO, ".ns_runs", f"config3_{name}"),
@@ -357,7 +357,6 @@ def run_sampling_leg(name):
 
 
 def assemble(out):
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from north_star import _posterior_match
     pm = _posterior_match(out["device"], out["cpu"])
     scalar_eps = out["scalar"]["scalar_evals_per_s"]

@@ -534,9 +534,11 @@ def _drive_leg(name, cmd, env):
                                f"{MAX_ATTEMPTS} attempts")
         t0 = time.time()
         while time.time() - t0 < PROBE_WAIT_S:
+            # device legs are exactly those NOT forced onto the CPU
+            # backend (derived from the leg's own env, not a name list
+            # that silently misses newly added legs)
             if _device_reachable(env, require_accelerator=(
-                    name in ("device", "pipeline", "nested_device",
-                             "nested_device2"))):
+                    env.get("JAX_PLATFORMS") != "cpu")):
                 break
             print(f"[{name} leg] device unreachable; retrying probe in "
                   "120s", flush=True)

@@ -57,7 +57,9 @@ def main():
     ncfg = LEGS["nested_device"]
     from enterprise_warp_tpu.samplers.nested import run_nested
     apply_refine_env(ncfg)
-    nlike = like if ("refine" not in ncfg
+    # reuse the pipeline build only when BOTH its gram mode and its
+    # baked refine match (refine is frozen at build time)
+    nlike = like if (ncfg.get("refine") == cfg.get("refine")
                      and ncfg["gram_mode"] == cfg["gram_mode"]) \
         else build_problem(ncfg["gram_mode"])
     with tempfile.TemporaryDirectory() as d:
@@ -65,17 +67,19 @@ def main():
                    dlogz=ncfg["dlogz"], nsteps=ncfg["nsteps"],
                    kbatch=ncfg["kbatch"], seed=1, resume=False,
                    verbose=False, max_iter=2, label="warm")
-    apply_refine_env(LEGS["device"])   # restore for the block below
-
-    # the vanilla device leg's block shape too
+    # the vanilla device leg's block shape too (rebuilt when its baked
+    # refine or gram mode differs from the pipeline build's)
     dcfg = LEGS["device"]
-    if dcfg["gram_mode"] == cfg["gram_mode"]:
-        dopts = dict(ntemps=dcfg.get("ntemps", 2),
-                     nchains=dcfg["nchains"], seed=0)
-        with tempfile.TemporaryDirectory() as d:
-            s = PTSampler(like, d, **dopts)
-            s.sample(dcfg["block_size"], resume=False, verbose=False,
-                     block_size=dcfg["block_size"])
+    apply_refine_env(dcfg)
+    dlike = like if (dcfg.get("refine") == cfg.get("refine")
+                     and dcfg["gram_mode"] == cfg["gram_mode"]) \
+        else build_problem(dcfg["gram_mode"])
+    dopts = dict(ntemps=dcfg.get("ntemps", 2),
+                 nchains=dcfg["nchains"], seed=0)
+    with tempfile.TemporaryDirectory() as d:
+        s = PTSampler(dlike, d, **dopts)
+        s.sample(dcfg["block_size"], resume=False, verbose=False,
+                 block_size=dcfg["block_size"])
     print("compile cache warmed")
 
 
