@@ -20,3 +20,69 @@ def test_baseline_tables_in_sync():
          "--check"],
         capture_output=True, text=True)
     assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+
+
+def _load_gen():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "gen_baseline_tables",
+        os.path.join(REPO, "tools", "gen_baseline_tables.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_north_star_table_renders_pooled_and_absent_legs():
+    g = _load_gen()
+    ns = {
+        "reference_shaped_wall_s": 563.5,
+        "scalar_loop_steps_per_s": 296.4,
+        "cpu": {"platform": "cpu", "steady_wall_s": 402.1,
+                "nchains": 4},
+        "device": {"platform": "tpu", "steady_wall_s": 2474.6,
+                   "nchains": 256},
+        "speedup_vs_reference_shape": 0.23,
+        "nested_device": {"kind": "nested", "platform": "tpu",
+                          "steady_wall_s": 30.0, "nlive": 800,
+                          "nsteps": 12, "kbatch": 400},
+        "nested_device2": {"kind": "nested", "platform": "tpu",
+                           "steady_wall_s": 31.0, "nlive": 800,
+                           "nsteps": 12, "kbatch": 400},
+        "nested_speedup_vs_reference_shape": 11.0,
+        "nested_pooled_posterior_match": True,
+        "nested_pooled_worst_std_ratio": 1.1,
+        "nested_device_seed_lnZ_agree": True,
+        "posterior_match": True,
+        "north_star_met": False,
+    }
+    text = "\n".join(g.north_star_table(ns))
+    assert "2nd seed (pooled width gate)" in text
+    assert "nested_pooled_posterior_match: True" in text
+    assert "nested_device_seed_lnZ_agree: True" in text
+    # pipeline leg absent from the artifact -> explicit absence row
+    assert "absent from committed artifact" in text
+
+
+def test_north_star_table_fails_loudly_on_missing_keys():
+    import pytest
+    g = _load_gen()
+    with pytest.raises(SystemExit):
+        g.north_star_table({"scalar_loop_steps_per_s": 1.0})
+
+
+def test_config3_section_renders():
+    g = _load_gen()
+    c3 = {
+        "reference_shaped_wall_s": 1620.0,
+        "scalar": {"scalar_evals_per_s": 284.1,
+                   "cross_check_max_diff": 7.1e-11},
+        "cpu": {"platform": "cpu", "steady_wall_s": 2305.9,
+                "steps": 58000, "rhat_max": 1.006, "ess_min": 568.8},
+        "device": {"platform": "tpu", "steady_wall_s": 111.0,
+                   "steps": 20000, "rhat_max": 1.008, "ess_min": 900.0},
+        "posterior_match": True,
+        "speedup_vs_reference_shape": 14.6,
+    }
+    text = "\n".join(g.config3_lines(c3))
+    assert "284.1 evals/s" in text and "7.1e-11" in text
+    assert "posterior_match: True" in text
