@@ -250,8 +250,19 @@ def _probe_once(interpret=False):
     ref = np.linalg.cholesky(np.asarray(S, np.float64)
                              + 1e-6 * np.eye(80)).T
     ok = np.all(np.isfinite(np.asarray(U)))
-    return bool(ok and np.allclose(np.asarray(U[0], np.float64), ref,
-                                   atol=1e-4))
+    ok = bool(ok and np.allclose(np.asarray(U[0], np.float64), ref,
+                                 atol=1e-4))
+    if not ok:
+        return False   # a second Mosaic compile cannot change the verdict
+    # the joint-PTA path runs the kernel under an OUTER vmap (walkers x
+    # pulsars): probe that composition too — vmap of pallas_call lowers
+    # through a different (batched-grid) route than the plain call
+    Un = jax.vmap(lambda s: _pallas_fused_raw(
+        s, 1e-6, 3e-5, interpret=interpret)[0])(
+            jnp.broadcast_to(Sb[:2], (2, 2, 80, 80)))
+    return bool(np.all(np.isfinite(np.asarray(Un)))
+                and np.allclose(np.asarray(Un[0, 0], np.float64), ref,
+                                atol=1e-4))
 
 
 def pallas_chol_available():
