@@ -172,6 +172,18 @@ class TestPallasInterpret:
         from enterprise_warp_tpu.ops import cholfuse
         assert cholfuse._probe_once(interpret=True) is True
 
+    def test_larger_tile_class(self):
+        # n > 128 switches to the T=4 tile (joint-PTA noise-block
+        # sizes); the tile-switch path must factor correctly too
+        from enterprise_warp_tpu.ops.cholfuse import _tile_for
+        n = 130
+        assert _tile_for(n) == 4
+        Sb = jnp.asarray(_spd_batch(5, n, seed=9))   # pads 5 -> 8
+        Up, Vp, _ = _pallas_fused_raw(Sb, 1e-6, 3e-5, interpret=True)
+        Ux, _, _ = _fused_xla(Sb, 1e-6, 3e-5)
+        np.testing.assert_allclose(np.asarray(Up), np.asarray(Ux),
+                                   atol=5e-5)
+
     def test_odd_sizes_pad(self):
         # batch not a multiple of the tile; n not a multiple of 8
         Sb = jnp.asarray(_spd_batch(3, 21, seed=8))
