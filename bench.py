@@ -299,10 +299,195 @@ def main():
                     "pipeline_posterior_match",
                     "nested_speedup_vs_reference_shape",
                     "nested_posterior_match",
+                    "nested_pooled_posterior_match",
+                    "nested_device_seed_lnZ_agree",
                     "nested_lnZ_agree",
                     "north_star_met") if k in ns}
         except ValueError:
             pass   # truncated/in-flight file must not sink the metric
+    # preconditioner-path provenance: which Cholesky stage this record
+    # was measured on, and why (a transiently-failed Pallas probe must
+    # be distinguishable from a real Mosaic regression)
+    from enterprise_warp_tpu.ops.cholfuse import probe_status
+    out["pallas_probe"] = probe_status()
+    print(json.dumps(out))
+
+
+def micro_bench():
+    """Evaluation-structure micro-benchmark (``python bench.py --micro``).
+
+    Reports evals/s on the CPU backend for the three evaluation classes
+    of the constant-subgraph / block-sparse layer:
+
+    - full recompute (the pre-layer hot path),
+    - fixed-white-noise constant-Gram cache (single-pulsar kernel with
+      noisefile-fixed efac/equad: the Gram stage is constant-folded at
+      build time),
+    - single-site update_mask on the joint-PTA Schur kernel (one pulsar
+      block re-Gramed/re-factored per eval, cached stage-1/2 reused).
+
+    Pinned to the CPU backend so the record is comparable across rounds
+    regardless of tunnel state, and writes cache-hit provenance
+    (``cache_hit_rate``) into the bench JSON + BENCH_MICRO.json.
+    """
+    force_cpu()
+    from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                            build_pulsar_likelihood)
+    from enterprise_warp_tpu.parallel import build_pta_likelihood
+    from enterprise_warp_tpu.samplers.evalproto import (BLOCK_COMMON,
+                                                        CachedEvaluator)
+    from enterprise_warp_tpu.sim.noise import make_fake_pta
+    from enterprise_warp_tpu.utils.diagnostics import cache_hit_summary
+    from __graft_entry__ import _flagship_single_pulsar
+
+    out = {"metric": "evalcache_micro", "unit": "evals/s (CPU backend)"}
+
+    # ---- fixed-white-noise constant-Gram cache (single pulsar) -------- #
+    # MSP-scale flagship (1024 TOAs) with its white noise fixed at
+    # noisefile-style values (scalar prior spec -> Constant): the
+    # standard GWB-search configuration, and the one whose Gram stage
+    # constant-folds. "Full recompute" is the kernel that must re-Gram
+    # every eval because the white parameters are RUNTIME inputs — the
+    # sampled-white model evaluated at thetas whose white dims are
+    # pinned to the same values (what a sampler pays today when white
+    # noise is effectively fixed but the kernel doesn't know). The
+    # fixed-white build WITHOUT the explicit fold is also timed: XLA
+    # constant-folds its Gram stage at compile time when its folding
+    # guards allow, so that figure bounds what the compiler recovers on
+    # its own (at recompile cost per batch shape — and only below XLA's
+    # fold-size guards).
+    ntoa_1 = 1024
+    efac0, equad0 = 1.1, -7.5
+    psr, _ = _flagship_single_pulsar(ntoa=ntoa_1)
+    m = StandardModels(psr=psr)
+    m.params.efac = efac0
+    m.params.equad = equad0
+    terms_fixed = TermList(psr, [m.efac("by_backend"),
+                                 m.equad("by_backend"),
+                                 m.spin_noise("powerlaw_20_nfreqs"),
+                                 m.dm_noise("powerlaw_20_nfreqs")])
+    m2 = StandardModels(psr=psr)
+    terms_sampled = TermList(psr, [m2.efac("by_backend"),
+                                   m2.equad("by_backend"),
+                                   m2.spin_noise("powerlaw_20_nfreqs"),
+                                   m2.dm_noise("powerlaw_20_nfreqs")])
+    lk_cached = build_pulsar_likelihood(psr, terms_fixed)
+    lk_folded = build_pulsar_likelihood(psr, terms_fixed,
+                                        const_grams=False)
+    lk_recomp = build_pulsar_likelihood(psr, terms_sampled)
+    assert lk_cached.const_grams and not lk_folded.const_grams
+    rng = np.random.default_rng(2)
+    th = lk_cached.sample_prior(rng, 256)          # red-noise dims only
+    th_full = np.empty((len(th), lk_recomp.ndim))
+    red = 0
+    for i, n in enumerate(lk_recomp.param_names):
+        if n.endswith("efac"):
+            th_full[:, i] = efac0
+        elif n.endswith("log10_equad"):
+            th_full[:, i] = equad0
+        else:
+            th_full[:, i] = th[:, red]
+            red += 1
+    assert red == lk_cached.ndim
+    eps_recomp = time_device(lk_recomp, th_full, reps=5)
+    eps_folded = time_device(lk_folded, th, reps=5)
+    eps_cached = time_device(lk_cached, th, reps=5)
+    dmax = float(np.max(np.abs(
+        np.asarray(lk_cached.loglike_batch(th[:32]))
+        - np.asarray(lk_recomp.loglike_batch(th_full[:32])))))
+    out["fixed_white"] = {
+        "full_evals_per_s": round(eps_recomp, 1),
+        "cached_evals_per_s": round(eps_cached, 1),
+        "xla_folded_evals_per_s": round(eps_folded, 1),
+        "speedup": round(eps_cached / eps_recomp, 2),
+        "lnl_max_abs_diff": dmax,
+        "shape": f"flagship noise model, {ntoa_1} TOAs, 80+tm basis, "
+                 "batch=256, white fixed at noisefile values",
+    }
+    print(f"# fixed-white cache: {eps_recomp:.1f} (recompute) -> "
+          f"{eps_cached:.1f} evals/s "
+          f"({eps_cached / eps_recomp:.2f}x; XLA-folded build: "
+          f"{eps_folded:.1f}), max |dlnL| = {dmax:.2e}", file=sys.stderr)
+
+    # ---- single-site update_mask on the joint Schur kernel ------------ #
+    npsr, nm = 8, 10
+    psrs = make_fake_pta(npsr=npsr, ntoa=334, seed=5)
+    rngp = np.random.default_rng(5)
+    for p in psrs:
+        p.residuals = p.toaerrs * rngp.standard_normal(len(p))
+    tls = []
+    for p in psrs:
+        mm = StandardModels(psr=p)
+        tls.append(TermList(p, [mm.efac("by_backend"),
+                                mm.spin_noise(f"powerlaw_{nm}_nfreqs"),
+                                mm.gwb(f"hd_vary_gamma_{nm}_nfreqs")]))
+    like = build_pta_likelihood(psrs, tls)
+    th0 = np.empty(like.ndim)
+    for i, n in enumerate(like.param_names):
+        th0[i] = (1.05 if n.endswith("efac") else
+                  -13.8 if n.endswith("log10_A") else 4.0)
+    pb = like.param_blocks
+    # a chain of single-site proposals (cycling pulsars) and matching
+    # common-block proposals, declared with update_masks
+    seq = []
+    rng2 = np.random.default_rng(7)
+    cur = th0.copy()
+    for k in range(48):
+        a = k % npsr
+        nxt = cur.copy()
+        idx = [i for i, b in enumerate(pb) if b == a]
+        nxt[idx[k % len(idx)]] += 0.003 * rng2.standard_normal()
+        seq.append((nxt, ("psr", a)))
+        cur = nxt
+    gw_idx = [i for i, b in enumerate(pb) if b == BLOCK_COMMON]
+    for k in range(16):
+        nxt = cur.copy()
+        nxt[gw_idx[k % len(gw_idx)]] += 0.003 * rng2.standard_normal()
+        seq.append((nxt, ("common",)))
+        cur = nxt
+
+    ev = CachedEvaluator(like, th0)
+    float(like.loglike(th0))                       # compile full path
+    ev.update(*seq[0])                             # compile site path
+    warm = seq[0][0].copy()                        # compile common path
+    warm[gw_idx[0]] += 1e-3
+    ev.update(warm, ("common",))
+    ev.reset(th0)
+    ev.counters = {"site": 0, "common": 0, "full": 0, "rejected": 0}
+
+    t0 = time.perf_counter()
+    lnls_masked = [ev.update(th_k, mask_k) for th_k, mask_k in seq]
+    masked_eps = len(seq) / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    lnls_full = [float(like.loglike(th_k)) for th_k, _ in seq]
+    full_eps = len(seq) / (time.perf_counter() - t0)
+    # max over the WHOLE sequence: a staleness bug at any step must
+    # show, not just one that survives to the final theta
+    dmax_j = max(abs(a - b) for a, b in zip(lnls_masked, lnls_full))
+
+    stats = cache_hit_summary(ev.counters["site"], ev.counters["common"],
+                              ev.counters["full"])
+    out["single_site"] = {
+        "full_evals_per_s": round(full_eps, 1),
+        "masked_evals_per_s": round(masked_eps, 1),
+        "speedup": round(masked_eps / full_eps, 2),
+        "lnl_max_abs_diff": float(dmax_j),
+        "shape": f"{npsr}-psr HD joint, 334 TOAs, {4 * nm} GW cols",
+    }
+    out["cache_hit_rate"] = stats["cache_hit_rate"]
+    out["mask_stats"] = stats
+    print(f"# single-site mask: {full_eps:.1f} -> {masked_eps:.1f} "
+          f"evals/s ({masked_eps / full_eps:.2f}x), max |dlnL| = "
+          f"{dmax_j:.2e}, cache_hit_rate={stats['cache_hit_rate']}",
+          file=sys.stderr)
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_MICRO.json")
+    record = dict(out, measured_at=time.strftime("%Y-%m-%dT%H:%M:%S"))
+    with open(path + ".tmp", "w") as fh:
+        json.dump(record, fh, indent=1)
+    os.replace(path + ".tmp", path)
     print(json.dumps(out))
 
 
@@ -454,9 +639,12 @@ def config_benches():
 
 if __name__ == "__main__":
     configs_mode = "--configs" in sys.argv
+    micro_mode = "--micro" in sys.argv
     try:
         if configs_mode:
             config_benches()
+        elif micro_mode:
+            micro_bench()
         else:
             main()
     except Exception as e:                              # noqa: BLE001
@@ -465,6 +653,12 @@ if __name__ == "__main__":
         # — in the schema of the mode that ran.
         import traceback
         traceback.print_exc()
+        if micro_mode:
+            print(json.dumps({"metric": "evalcache_micro",
+                              "unit": "evals/s (CPU backend)",
+                              "cache_hit_rate": None,
+                              "error": f"{type(e).__name__}: {e}"}))
+            sys.exit(1)
         if configs_mode:
             # config_benches flushes after every config — recover what
             # was already measured so the recorded artifact keeps it

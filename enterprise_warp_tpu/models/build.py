@@ -373,7 +373,8 @@ def eval_phi_T(theta, bb_static, T_w_j, cs2_j):
 def build_pulsar_likelihood(psr, terms, fixed_values=None,
                             gram_mode="split", ecorr_dt=10.0,
                             mesh=None, toa_axis="toa",
-                            tm="marginalized", tm_range=10.0):
+                            tm="marginalized", tm_range=10.0,
+                            const_grams=None):
     """Compile a TermList for one pulsar into a :class:`PulsarLikelihood`.
 
     ``fixed_values`` maps parameter names to values for Constant-prior
@@ -400,6 +401,16 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     and XLA all-reduces the small (nbasis x nbasis) partials over ICI.
     TOAs are padded (mask rows, nw=1) to a shard-divisible count; results
     are identical to the unsharded build.
+
+    ``const_grams`` — evaluation-structure layer: when every white-noise
+    parameter is fixed (Constant priors / noisefile values — the standard
+    GWB configuration), the whitened Gram stage is theta-independent and
+    is constant-folded ONCE at build time, dropping each eval from
+    O(ntoa * nbasis^2) to O(nbasis^3). ``None`` (default) auto-detects
+    (honoring ``EWT_CONST_GRAMS=0``); ``False`` forces full recompute;
+    ``True`` requires eligibility and raises if the model is not
+    fixed-white-noise. The built likelihood exposes the resolved choice
+    as ``like.const_grams``.
     """
     ntoa = len(psr)
     sigma = psr.toaerrs
@@ -499,6 +510,38 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
             and _os.environ.get("EWT_PAIR_PROGRAM", "1") != "0"):
         from ..ops.kernel import build_pair_program
         pair_prog = build_pair_program(r_w, M_w, T_w)
+    # Constant-subgraph folding (evaluation-structure layer): with every
+    # white-noise parameter fixed, ``nw`` — hence the whole Gram stage —
+    # is theta-independent, so the six Gram blocks are computed ONCE here
+    # through the exact same code path the per-eval recompute would take
+    # (bit-identical per gram mode) and closed over as constants.
+    # Eligibility mirrors the pair program's: nothing walker-dependent
+    # may touch the basis or the residuals, and the TOA axis must be
+    # unsharded (the fold happens before mesh placement).
+    wn_fixed = all(rf[0] == "const" for _, _, refs in wb_static
+                   for rf in refs)
+    cg_eligible = (mesh is None and tm != "sampled" and not det_terms
+                   and all(bb["dyn"] is None for bb in bb_static)
+                   and wn_fixed)
+    if const_grams is None:
+        const_grams = (cg_eligible
+                       and _os.environ.get("EWT_CONST_GRAMS", "1") != "0")
+    elif const_grams and not cg_eligible:
+        raise ValueError(
+            "const_grams=True requires a fixed-white-noise model with no "
+            "sampled timing model, deterministic delays, sampled "
+            "chromatic index, or TOA-axis mesh "
+            f"(white noise fixed: {wn_fixed})")
+    grams_cached = None
+    if const_grams:
+        from ..ops.kernel import gram_blocks
+        # theta never reaches eval_nw (all refs are consts) — a zero
+        # vector of the right length satisfies the gather program
+        nw0 = eval_nw(jnp.zeros(max(len(sampled), 1)), wb_static,
+                      ntoa_tot, sigma2_j)
+        grams_cached = tuple(gram_blocks(
+            nw0, r_w_j, M_w_j, T_w_j, mask=mask_j,
+            gram_mode=gram_mode, pair_program=pair_prog))
     # factorization choice is resolved at BUILD time (same convention as
     # EWT_PAIR_PROGRAM): reading env inside the traced function would be
     # frozen into the jit cache and silently ignore later toggles
@@ -522,9 +565,11 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
             lnl = marginalized_loglike(nw, phi, r_eff, sh["M"], T_mat,
                                        mask=sh["mask"],
                                        gram_mode=gram_mode,
-                                       pair_program=pair_prog,
+                                       pair_program=None if grams_cached
+                                       is not None else pair_prog,
                                        blocked_chol=use_blocked_chol,
-                                       refine=n_refine)
+                                       refine=n_refine,
+                                       grams=grams_cached)
         else:
             dp = jnp.stack([param_value(theta, rf) for rf in tm_refs])
             r_eff = r_eff - sh["M"] @ dp
@@ -544,6 +589,7 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
         return loglike_inner(theta, sharded)
 
     like = PulsarLikelihood(psr, sampled, loglike, gram_mode)
+    like.const_grams = bool(const_grams)
     # sampler evaluation protocol (samplers/evalproto.py): pure function
     # + the device-array pytree, so every jit can take the arrays as
     # arguments. For sharded builds (arrays may span processes) the

@@ -234,60 +234,166 @@ def _pallas_fused_raw(Sn_b, j1, j2, interpret=False):
 # --------------------------------------------------------------------
 
 _PROBE_RESULT = None
+_PROBE_REASON = "not probed"
+_PROBE_TRANSIENTS = 0
+# consecutive transient failures before the verdict pins False anyway —
+# bounds the per-trace probe-timeout stall of a persistently dead tunnel
+_PROBE_TRANSIENT_CAP = 3
+
+# One representative matrix size per _tile_for class (T=8/4/2/1): the
+# n=80 probe alone said nothing about whether Mosaic can still compile
+# the bigger-tile variants production shapes hit — e.g. the joint-PTA GW
+# Schur complement lands at n~200 (T=2 class) — so a lowering regression
+# there would surface inside the hot jit, exactly where the probe is
+# supposed to keep it out of. Each size is rounded up to the next lane
+# multiple internally by Mosaic; the values just need to land in the
+# right tile class and under _PALLAS_MAX_N.
+_PROBE_SHAPES = (80, 160, 256, 384)
+
+# Exception texts that indicate a RUNTIME/TRANSPORT hiccup (remote
+# device tunnel flaking, RPC timeouts) rather than a compile/lowering
+# failure. A transient error must NOT pin the probe verdict to False
+# for the process lifetime — the next call re-probes.
+_TRANSIENT_MARKERS = ("unavailable", "deadline", "timed out", "timeout",
+                      "connection", "socket", "transport", "rpc error",
+                      "disconnect", "cancelled", "heartbeat",
+                      "failed to connect")
+
+
+def _is_transient(exc):
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(m in text for m in _TRANSIENT_MARKERS)
+
+
+def _probe_matrix(n):
+    """The probe's SPD test matrix (equilibrated f32 cast) and its f64
+    reference Cholesky factor (upper, at the tier-1 jitter) — one
+    construction shared by the per-shape and outer-vmap probes so their
+    conditioning and tolerance can never drift apart."""
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((n, n)).astype(np.float64)
+    S = A @ A.T / n + np.eye(n)
+    d = np.sqrt(np.diag(S))
+    S32 = (S / d[:, None] / d[None, :]).astype(np.float32)
+    ref = np.linalg.cholesky(np.asarray(S32, np.float64)
+                             + 1e-6 * np.eye(n)).T
+    return S32, ref
+
+
+def _probe_one_shape(n, interpret=False):
+    """Compile and run the real kernel on one (T(n), n, n) tile batch and
+    check it against the float64 reference factorization. Raises on any
+    compile or execution failure; returns the accuracy verdict."""
+    S32, ref = _probe_matrix(n)
+    T = _tile_for(n)
+    Sb = jnp.broadcast_to(jnp.asarray(S32), (T, n, n))
+    U, V, E = _pallas_fused_raw(Sb, 1e-6, 3e-5, interpret=interpret)
+    ok = np.all(np.isfinite(np.asarray(U)))
+    return bool(ok and np.allclose(np.asarray(U[0], np.float64), ref,
+                                   atol=1e-4))
 
 
 def _probe_once(interpret=False):
-    """Compile and run the real kernel on an n=80 tile and check it
-    against a float64 reference factorization. Raises on any compile
-    or execution failure; returns the accuracy verdict."""
-    rng = np.random.default_rng(0)
-    A = rng.standard_normal((80, 80)).astype(np.float64)
-    S = A @ A.T / 80 + np.eye(80)
-    d = np.sqrt(np.diag(S))
-    S = (S / d[:, None] / d[None, :]).astype(np.float32)
-    Sb = jnp.broadcast_to(jnp.asarray(S), (8, 80, 80))
-    U, V, E = _pallas_fused_raw(Sb, 1e-6, 3e-5, interpret=interpret)
-    ref = np.linalg.cholesky(np.asarray(S, np.float64)
-                             + 1e-6 * np.eye(80)).T
-    ok = np.all(np.isfinite(np.asarray(U)))
-    ok = bool(ok and np.allclose(np.asarray(U[0], np.float64), ref,
-                                 atol=1e-4))
-    if not ok:
-        return False   # a second Mosaic compile cannot change the verdict
+    """Probe every tile class (see ``_PROBE_SHAPES``), then the
+    outer-vmap composition. Raises on compile/execution failure; returns
+    the combined accuracy verdict."""
+    for n in _PROBE_SHAPES:
+        if not _probe_one_shape(n, interpret=interpret):
+            return False   # a second Mosaic compile cannot change this
     # the joint-PTA path runs the kernel under an OUTER vmap (walkers x
     # pulsars): probe that composition too — vmap of pallas_call lowers
     # through a different (batched-grid) route than the plain call
+    S32, ref = _probe_matrix(80)
+    Sb = jnp.broadcast_to(jnp.asarray(S32), (2, 80, 80))
     Un = jax.vmap(lambda s: _pallas_fused_raw(
         s, 1e-6, 3e-5, interpret=interpret)[0])(
-            jnp.broadcast_to(Sb[:2], (2, 2, 80, 80)))
+            jnp.broadcast_to(Sb, (2, 2, 80, 80)))
     return bool(np.all(np.isfinite(np.asarray(Un)))
                 and np.allclose(np.asarray(Un[0, 0], np.float64), ref,
                                 atol=1e-4))
 
 
 def pallas_chol_available():
-    """One-time compile-and-run probe of the real kernel (n=80 tile) on
-    the default backend. The axon remote-compile path may not support
-    Mosaic lowering; probing here keeps that failure out of the hot jit
-    (where it could not be caught). A failed probe is reported once —
-    a silently broken probe would silently disable the fast path."""
-    global _PROBE_RESULT
+    """One-time compile-and-run probe of the real kernel — one
+    representative shape per tile class plus the outer-vmap composition
+    — on the default backend. The axon remote-compile path may not
+    support Mosaic lowering; probing here keeps that failure out of the
+    hot jit (where it could not be caught). A failed probe is reported
+    once — a silently broken probe would silently disable the fast path.
+
+    Verdict caching: a compile/lowering failure (or a wrong factor) is
+    deterministic, so ``False`` is pinned for the process. A TRANSIENT
+    failure — remote-device transport hiccup, RPC timeout — says nothing
+    about Mosaic support, so the verdict stays ``None`` and the next
+    call re-probes instead of pinning the slow path for the whole
+    process. Jits ALREADY TRACED during the transient window keep the
+    XLA path (the verdict is baked in at trace time); re-probing
+    restores the fast path for later traces only, so every transient
+    hit is counted and surfaced via ``probe_status()`` — a measurement
+    record with ``transient_failures > 0`` may mix preconditioner
+    paths. ``probe_status()`` reports verdict + reason for the
+    bench/leg provenance artifacts."""
+    global _PROBE_RESULT, _PROBE_REASON, _PROBE_TRANSIENTS
     if _PROBE_RESULT is None:
         import sys
         try:
             _PROBE_RESULT = _probe_once()
-            if not _PROBE_RESULT:
+            if _PROBE_RESULT:
+                _PROBE_REASON = "probe passed"
+            else:
                 # compiled and ran but produced a WRONG factor (Mosaic
                 # lowering regression) — as disable-worthy as a crash,
                 # and just as much in need of a visible trace
+                _PROBE_REASON = "accuracy check failed"
                 print("# cholfuse: Pallas probe compiled but failed "
                       "the accuracy check; using the XLA "
                       "preconditioner path", file=sys.stderr)
-        except Exception as exc:  # Mosaic/compile failure -> XLA path
+        except Exception as exc:
+            if _is_transient(exc):
+                # runtime/transport hiccup: leave the verdict None so a
+                # later call re-probes — THIS call falls back to XLA.
+                # Capped: a persistently dead tunnel would otherwise
+                # stall EVERY new trace on a fresh probe timeout, so
+                # after _PROBE_TRANSIENT_CAP consecutive transient
+                # failures the verdict pins False (the count stays in
+                # probe_status so the record shows why).
+                _PROBE_TRANSIENTS += 1
+                _PROBE_REASON = f"transient probe failure: {exc!r}"[:300]
+                if _PROBE_TRANSIENTS >= _PROBE_TRANSIENT_CAP:
+                    _PROBE_REASON = (
+                        f"{_PROBE_TRANSIENTS} consecutive transient "
+                        f"probe failures (cap) — last: {exc!r}")[:300]
+                    print("# cholfuse: Pallas probe transient-failure "
+                          "cap reached; pinning the XLA preconditioner "
+                          "path for this process", file=sys.stderr)
+                    _PROBE_RESULT = False
+                    return False
+                print(f"# cholfuse: Pallas probe hit a transient error "
+                      f"({exc!r}); using the XLA preconditioner path "
+                      "for this trace, will re-probe", file=sys.stderr)
+                return False
+            # Mosaic/compile/lowering failure -> XLA path, pinned
+            _PROBE_REASON = f"compile/lowering failure: {exc!r}"[:300]
             print(f"# cholfuse: Pallas probe failed ({exc!r}); "
                   "using the XLA preconditioner path", file=sys.stderr)
             _PROBE_RESULT = False
     return _PROBE_RESULT
+
+
+def probe_status():
+    """Provenance record of the Pallas availability probe for the
+    bench/leg artifacts: which preconditioner path this process is on
+    and why. ``transient_failures > 0`` flags that some traces in this
+    process fell back to XLA even if a later re-probe succeeded (their
+    executables keep the path chosen at trace time). Never triggers a
+    probe itself."""
+    return {
+        "pallas_chol": (None if _PROBE_RESULT is None
+                        else bool(_PROBE_RESULT)),
+        "reason": _PROBE_REASON,
+        "transient_failures": _PROBE_TRANSIENTS,
+        "shapes": list(_PROBE_SHAPES),
+    }
 
 
 def _pallas_enabled():

@@ -491,11 +491,81 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     return s[:, None] * Z, logdet
 
 
+def gram_blocks(nw, r_w, M_w, T_w, mask=None, gram_mode="split",
+                pair_program=None):
+    """The O(ntoa * nbasis^2) Gram stage of :func:`marginalized_loglike`,
+    as a standalone function: returns ``(G, H, P, X, q, rwr)`` for the
+    weight vector ``w = mask / nw``.
+
+    Factored out so the evaluation-structure layer can CONSTANT-FOLD it:
+    when every white-noise parameter is fixed (the noisefile-driven GWB
+    configuration) and nothing else walker-dependent touches the basis or
+    the residuals, ``nw`` is theta-independent and these six arrays are
+    build-time constants — each eval then skips straight to the
+    O(nbasis^3) factorization stage (pass the precomputed tuple as
+    ``marginalized_loglike(..., grams=...)``). Computing the constants
+    through this same function keeps the cached and recomputed paths
+    bit-identical per gram mode.
+    """
+    f64 = r_w.dtype
+    w = 1.0 / nw
+    if mask is not None:
+        w = w * mask
+    ntm = 0 if M_w is None else M_w.shape[1]
+    if pair_program is not None:
+        # Gram-as-matmul fast path: every Gram entry is linear in w, so
+        # the batched Gram stage is one (batch, ntoa) x (ntoa, nb^2)
+        # MXU matmul against static pair products — see
+        # build_pair_program for the precision layout (split (T,T),
+        # genuine-f64 M/r side).
+        return pair_program_grams(w, pair_program)
+    sqw = jnp.sqrt(w)
+    # row-scale by sqrt(w) once; every Gram then needs no weight
+    # insertion (M_w=None: sampled-TM likelihood — the TM delay was
+    # subtracted from r_w by the caller and the analytic Schur stage
+    # is skipped)
+    Ts = T_w * sqw[:, None]
+    Ms = None if M_w is None else M_w * sqw[:, None]
+    rs = r_w * sqw
+
+    # G is the FLOPs hog — O(ntoa * nbasis^2) — and tolerates
+    # split-f32 (error ~1e-4 in lnL at ntoa=1e3). The M-side
+    # products feed A = P - H^T Sigma^-1 H, a small difference of
+    # large matrices whose cancellation amplifies Gram error by up
+    # to ~1e8 when the noise covariance nearly contains the
+    # timing-model directions (strong red noise vs polynomial
+    # columns), so they stay genuine f64. They are O(ntm) skinny;
+    # on TPU a broadcast-multiply + tree-sum reduction lowers ~7x
+    # faster than the emulated-f64 dot (8 vs 59 ms on the flagship
+    # batch) at the same accuracy, so the split path fuses them as
+    # [H|X] = Ts^T [Ms|rs] and [[P,q],[q^T,rwr]] = [Ms|rs]^T [Ms|rs].
+    G = _gram_pair(Ts, Ts, gram_mode)
+    if gram_mode == "split":
+        U = (rs[:, None] if Ms is None
+             else jnp.concatenate([Ms, rs[:, None]], axis=1))
+        HX = jnp.sum(Ts[:, :, None] * U[:, None, :], axis=0)
+        Pq = jnp.sum(U[:, :, None] * U[:, None, :], axis=0)
+        H, X = HX[:, :ntm], HX[:, ntm]
+        P, q, rwr = Pq[:ntm, :ntm], Pq[:ntm, ntm], Pq[ntm, ntm]
+    else:
+        X = _gram_pair(Ts, rs[:, None], gram_mode)[:, 0]
+        rwr = jnp.sum(rs * rs)
+        if Ms is None:
+            H = jnp.zeros((Ts.shape[1], 0), dtype=f64)
+            P = jnp.zeros((0, 0), dtype=f64)
+            q = jnp.zeros((0,), dtype=f64)
+        else:
+            H = _gram_pair(Ts, Ms, gram_mode)
+            P = _gram_pair(Ms, Ms, gram_mode)
+            q = _gram_pair(Ms, rs[:, None], gram_mode)[:, 0]
+    return G, H, P, X, q, rwr
+
+
 @partial(jax.jit, static_argnames=("gram_mode", "blocked_chol",
                                    "refine"))
 def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
                          pair_program=None, blocked_chol=False,
-                         refine=3):
+                         refine=3, grams=None):
     """Marginalized GP log-likelihood for one pulsar at one parameter point.
 
     Parameters
@@ -509,64 +579,24 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
         (static per pulsar, float64).
     mask : optional (ntoa,) 0/1 padding mask (1 = real TOA).
     gram_mode : 'split' (TPU default), 'f32', or 'f64'.
+    grams : optional precomputed ``(G, H, P, X, q, rwr)`` tuple from
+        :func:`gram_blocks` — the evaluation-structure layer's
+        constant-folded Gram stage for fixed-white-noise builds. When
+        given, the O(ntoa * nbasis^2) contraction is skipped entirely and
+        the eval is O(nbasis^3).
 
     Returns lnL up to a theta-independent constant (see
     ``oracle.kernel_constant_offset`` for the exact relation to the dense
     oracle).
     """
     f64 = r_w.dtype
-    w = 1.0 / nw
-    if mask is not None:
-        w = w * mask
-    sqw = jnp.sqrt(w)
-
     ntm = 0 if M_w is None else M_w.shape[1]
-    if pair_program is not None:
-        # Gram-as-matmul fast path: every Gram entry is linear in w, so
-        # the batched Gram stage is one (batch, ntoa) x (ntoa, nb^2)
-        # MXU matmul against static pair products — see
-        # build_pair_program for the precision layout (split (T,T),
-        # genuine-f64 M/r side).
-        G, H, P, X, q, rwr = pair_program_grams(w, pair_program)
+    if grams is not None:
+        G, H, P, X, q, rwr = grams
     else:
-        # row-scale by sqrt(w) once; every Gram then needs no weight
-        # insertion (M_w=None: sampled-TM likelihood — the TM delay was
-        # subtracted from r_w by the caller and the analytic Schur stage
-        # is skipped)
-        Ts = T_w * sqw[:, None]
-        Ms = None if M_w is None else M_w * sqw[:, None]
-        rs = r_w * sqw
-
-        # G is the FLOPs hog — O(ntoa * nbasis^2) — and tolerates
-        # split-f32 (error ~1e-4 in lnL at ntoa=1e3). The M-side
-        # products feed A = P - H^T Sigma^-1 H, a small difference of
-        # large matrices whose cancellation amplifies Gram error by up
-        # to ~1e8 when the noise covariance nearly contains the
-        # timing-model directions (strong red noise vs polynomial
-        # columns), so they stay genuine f64. They are O(ntm) skinny;
-        # on TPU a broadcast-multiply + tree-sum reduction lowers ~7x
-        # faster than the emulated-f64 dot (8 vs 59 ms on the flagship
-        # batch) at the same accuracy, so the split path fuses them as
-        # [H|X] = Ts^T [Ms|rs] and [[P,q],[q^T,rwr]] = [Ms|rs]^T [Ms|rs].
-        G = _gram_pair(Ts, Ts, gram_mode)
-        if gram_mode == "split":
-            U = (rs[:, None] if Ms is None
-                 else jnp.concatenate([Ms, rs[:, None]], axis=1))
-            HX = jnp.sum(Ts[:, :, None] * U[:, None, :], axis=0)
-            Pq = jnp.sum(U[:, :, None] * U[:, None, :], axis=0)
-            H, X = HX[:, :ntm], HX[:, ntm]
-            P, q, rwr = Pq[:ntm, :ntm], Pq[:ntm, ntm], Pq[ntm, ntm]
-        else:
-            X = _gram_pair(Ts, rs[:, None], gram_mode)[:, 0]
-            rwr = jnp.sum(rs * rs)
-            if Ms is None:
-                H = jnp.zeros((Ts.shape[1], 0), dtype=f64)
-                P = jnp.zeros((0, 0), dtype=f64)
-                q = jnp.zeros((0,), dtype=f64)
-            else:
-                H = _gram_pair(Ts, Ms, gram_mode)
-                P = _gram_pair(Ms, Ms, gram_mode)
-                q = _gram_pair(Ms, rs[:, None], gram_mode)[:, 0]
+        G, H, P, X, q, rwr = gram_blocks(nw, r_w, M_w, T_w, mask=mask,
+                                         gram_mode=gram_mode,
+                                         pair_program=pair_program)
 
     G = G.astype(f64)
     H = H.astype(f64)

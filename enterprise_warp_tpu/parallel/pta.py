@@ -496,7 +496,8 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
                 refs=[mapping[p.name] for p in blk.params],
                 flat_idx=flat_idx,
                 fixed=blk.fixed_phi,
-                ncols=blk.ncols))
+                ncols=blk.ncols,
+                psr=a))
             if blk.dynamic_idx is not None:
                 dyn_blocks.append(dict(
                     psr=a, off=new_off, ncols=blk.ncols,
@@ -527,6 +528,42 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
                       idx_map=[mapping[p.name] for p in blk.params],
                       fixed_phi=None, ncols=blk.ncols)
                  for blk in corr_blocks]
+
+    # ---- parameter -> block classification (update_mask contract) ------
+    # Each sampled parameter is attributed to the pulsar block it
+    # touches, to the coupling-only common block (spatially-correlated
+    # GW params, which enter ONLY through _coupling_blocks), or to
+    # BLOCK_GLOBAL when it appears in more than one block (a shared
+    # uncorrelated common term rescales every pulsar's phi — never
+    # maskable). Unreferenced parameters default to GLOBAL: the
+    # conservative direction is always "full recompute".
+    from ..samplers.evalproto import BLOCK_COMMON, BLOCK_GLOBAL
+    param_blocks = np.full(len(sampled), BLOCK_GLOBAL, dtype=np.int64)
+    _block_seen = {}
+
+    def _mark_block(ref, blk):
+        if ref[0] != "theta":
+            return
+        i = ref[1]
+        if i not in _block_seen:
+            _block_seen[i] = blk
+            param_blocks[i] = blk
+        elif _block_seen[i] != blk:
+            _block_seen[i] = BLOCK_GLOBAL
+            param_blocks[i] = BLOCK_GLOBAL
+
+    for a, (wbs, _, _) in enumerate(lowered):
+        for wb in wbs:
+            for p in wb.params:
+                _mark_block(mapping[p.name], a)
+    for spec in noise_specs:
+        for rf in spec["refs"]:
+            _mark_block(rf, spec["psr"])
+    for db in dyn_blocks:
+        _mark_block(db["ref"], db["psr"])
+    for cb in cb_static:
+        for rf in cb["idx_map"]:
+            _mark_block(rf, BLOCK_COMMON)
 
     # scatter indices of the coupling K inside the (npsr*n_g)^2 Schur
     # system (schur path) and inside the (npsr*nb_tot)^2 Sigma (dense path)
@@ -583,7 +620,10 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
 
     def _common(theta, sh):
         """Shared front end: nw/phi evaluation, dynamic basis rescale,
-        whitened Grams. Returns (G, X, rwr, logdet_n, logphi, invphi_N)."""
+        whitened Grams. Returns (G, X, rwr_p, logdet_n, logphi,
+        invphi_N) with ``rwr_p`` the PER-PULSAR whitened-residual norms
+        (the evaluation-structure cache updates them blockwise; the full
+        paths sum them)."""
         nw = eval_white(theta, sigma2_j)                 # (npsr, ntoa_max)
         phi_N = eval_phi(theta) * cs2_N_j                # (npsr, NW)
         invphi_N = 1.0 / phi_N
@@ -603,67 +643,83 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         rs = sh["R"] * sqw
         G = _gram_batched(Ts, Ts, gram_mode).astype(jnp.float64)
         X = jnp.einsum("pik,pi->pk", Ts, rs, precision=_HIGH)
-        rwr = jnp.sum(rs * rs)
+        rwr_p = jnp.sum(rs * rs, axis=1)
         logdet_n = jnp.sum(jnp.log(nw) * sh["mask"])
-        return G, X, rwr, logdet_n, logphi, invphi_N
+        return G, X, rwr_p, logdet_n, logphi, invphi_N
 
-    def loglike_schur(theta, sh):
-        G, X, rwr, logdet_n, logphi, invphi_N = _common(theta, sh)
+    # stage 1 delta mode: the f64 oracle path keeps the tree-exact
+    # logdet; reduced-precision gram modes take the split/fused route
+    # (ops.cholfuse single-dispatch preconditioner on TPU) — its
+    # ~1e-4-class per-block logdet noise is far below the split Gram
+    # error those branches already carry, and the batched (walkers x
+    # pulsars) column sweeps it removes were the dominant latency of
+    # the joint device eval.
+    stage1_delta = "tree" if gram_mode == "f64" else "split"
 
-        Gnn = G[:, :NW, :NW] + jax.vmap(jnp.diag)(invphi_N)
-        H = G[:, :NW, NW:NW + MW]
-        P = G[:, NW:NW + MW, NW:NW + MW] + jax.vmap(jnp.diag)(tm_pad_j)
-        Cng = G[:, :NW, NW + MW:]
-        Cmg = G[:, NW:NW + MW, NW + MW:]
-        Dgg = G[:, NW + MW:, NW + MW:]
-        Xn, Xm, Xg = X[:, :NW], X[:, NW:NW + MW], X[:, NW + MW:]
+    def _stage12_single(G_a, X_a, invphi_a, tmpad_a):
+        """Stages 1+2 for ONE pulsar: mixed-precision factorization of
+        the noise block, exact timing-model marginalization, and this
+        pulsar's contributions to the GW Schur system. The full path is
+        its ``vmap`` over the pulsar axis; the evaluation-structure
+        layer's single-site update calls it once on the touched block
+        and scatters the result into the cache — that block-sparsity is
+        exactly why stages 1+2 live in per-pulsar form."""
+        Gnn = G_a[:NW, :NW] + jnp.diag(invphi_a)
+        H = G_a[:NW, NW:NW + MW]
+        P = G_a[NW:NW + MW, NW:NW + MW] + jnp.diag(tmpad_a)
+        Cng = G_a[:NW, NW + MW:]
+        Cmg = G_a[NW:NW + MW, NW + MW:]
+        Dgg = G_a[NW + MW:, NW + MW:]
+        Xn, Xm, Xg = X_a[:NW], X_a[NW:NW + MW], X_a[NW + MW:]
 
-        # stage 1: mixed-precision factorization of the noise blocks,
-        # vmapped over the (sharded) pulsar axis. The f64 oracle path
-        # keeps the tree-exact logdet; reduced-precision gram modes take
-        # the split/fused route (ops.cholfuse single-dispatch
-        # preconditioner on TPU) — its ~1e-4-class per-block logdet
-        # noise is far below the split Gram error this branch already
-        # carries, and the batched (walkers x pulsars) column sweeps it
-        # removes were the dominant latency of the joint device eval.
-        stage1_delta = "tree" if gram_mode == "f64" else "split"
-        RHS = jnp.concatenate([Xn[:, :, None], H, Cng], axis=2)
-        Z, ld_nn = jax.vmap(
-            lambda S, B: _mixed_psd_solve_logdet(
-                S, B, jitter, refine=3, delta_mode=stage1_delta)
-        )(Gnn, RHS)
-        Zx, ZH, ZC = Z[:, :, 0], Z[:, :, 1:1 + MW], Z[:, :, 1 + MW:]
+        def mm64(A, B):
+            # genuine-f64 A^T B via broadcast-multiply + tree-sum;
+            # vmapped over pulsars this lowers exactly like _bmm64
+            return jnp.sum(A[:, :, None] * B[:, None, :], axis=0)
+
+        # stage 1: mixed-precision factorization of the noise block
+        RHS = jnp.concatenate([Xn[:, None], H, Cng], axis=1)
+        Z, ld_nn = _mixed_psd_solve_logdet(Gnn, RHS, jitter, refine=3,
+                                           delta_mode=stage1_delta)
+        Zx, ZH, ZC = Z[:, 0], Z[:, 1:1 + MW], Z[:, 1 + MW:]
 
         # stage 2: exact timing-model marginalization, genuine f64
-        Atm = P - _bmm64(H, ZH)
-        ym = Xm - jnp.sum(H * Zx[:, :, None], axis=1)
-        Cmt = Cmg - _bmm64(H, ZC)
-        # the (ntm x ntm) blocks are tiny, so factor them by f64
+        Atm = P - mm64(H, ZH)
+        ym = Xm - jnp.sum(H * Zx[:, None], axis=0)
+        Cmt = Cmg - mm64(H, ZC)
+        # the (ntm x ntm) block is tiny, so factor it by f64
         # eigendecomposition with a relative eigenvalue clamp: exact at
         # normal points, and a condition-bounded PSD solve (never NaN) at
         # prior corners where the jitter-bounded noise solve leaves Atm
         # numerically indefinite — the corner class where a Cholesky
         # would poison the whole walker with a permanent -inf
-        evA, VA = jnp.linalg.eigh(Atm)                  # (P,MW), (P,MW,MW)
-        emax = jnp.max(jnp.abs(evA), axis=-1, keepdims=True)
+        evA, VA = jnp.linalg.eigh(Atm)
+        emax = jnp.max(jnp.abs(evA))
         evA_cl = jnp.maximum(evA, 1e-13 * emax + 1e-300)
-        ld_tm = jnp.sum(jnp.log(evA_cl), axis=-1)
-        rhs_m = jnp.concatenate([ym[:, :, None], Cmt], axis=2)
-        Wm = jnp.einsum("pij,pj,pkj,pkl->pil", VA, 1.0 / evA_cl, VA,
-                        rhs_m)
-        Wy, WC = Wm[:, :, 0], Wm[:, :, 1:]
+        ld_tm = jnp.sum(jnp.log(evA_cl))
+        rhs_m = jnp.concatenate([ym[:, None], Cmt], axis=1)
+        Wm = jnp.einsum("ij,j,kj,kl->il", VA, 1.0 / evA_cl, VA, rhs_m)
+        Wy, WC = Wm[:, 0], Wm[:, 1:]
 
         q1 = jnp.sum(Xn * Zx) + jnp.sum(ym * Wy)
-        if n_g == 0:
-            quad = rwr - q1
-            lnl = -0.5 * (quad + logdet_n + logphi + jnp.sum(ld_nn)
-                          + jnp.sum(ld_tm) + tm_const)
-            return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
+        Xs = Xg - jnp.sum(Cng * Zx[:, None], axis=0) \
+            - jnp.sum(Cmt * Wy[:, None], axis=0)
+        Ss = Dgg - mm64(Cng, ZC) - mm64(Cmt, WC)
+        return dict(q1=q1, ld_nn=ld_nn, ld_tm=ld_tm, Xs=Xs, Ss=Ss)
 
-        # stage 3: the GW Schur system with the ORF coupling
-        Xs = Xg - jnp.sum(Cng * Zx[:, :, None], axis=1) \
-            - jnp.sum(Cmt * Wy[:, :, None], axis=1)
-        Ss = Dgg - _bmm64(Cng, ZC) - _bmm64(Cmt, WC)
+    def _stage3(theta, cache):
+        """Final assembly from the cache pytree: the GW Schur system
+        with the ORF coupling (the only stage that depends on the
+        coupling-only common parameters) plus the scalar sums. Pure in
+        ``(theta, cache)`` so the block-sparse update paths reuse it
+        unchanged."""
+        quad_base = jnp.sum(cache["rwr"]) - jnp.sum(cache["q1"])
+        lds = (cache["ldn"] + cache["lphi"] + jnp.sum(cache["ld_nn"])
+               + jnp.sum(cache["ld_tm"]) + tm_const)
+        if n_g == 0:
+            lnl = -0.5 * (quad_base + lds)
+            return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
+        Xs, Ss = cache["Xs"], cache["Ss"]
         n_s = npsr * n_g
         S = jnp.zeros((npsr, n_g, npsr, n_g))
         S = S.at[ia, :, ia, :].set(Ss).reshape(n_s, n_s)
@@ -686,13 +742,64 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
                 S, Xs.reshape(n_s, 1), jitter, refine=3,
                 delta_mode="split")
             xsx = jnp.sum(Xs.reshape(n_s) * Zs[:, 0])
-        quad = rwr - q1 - xsx
-        lnl = -0.5 * (quad + logdet_n + logphi + logdet_b
-                      + jnp.sum(ld_nn) + jnp.sum(ld_tm) + ld_S + tm_const)
+        lnl = -0.5 * (quad_base - xsx + lds + logdet_b + ld_S)
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
 
+    # ---- evaluation-structure layer: cache build + block updates ------
+    def _cache_init(theta, sh):
+        """Full recompute; returns (lnl, cache). The cache holds every
+        per-pulsar stage-1/2 result stage 3 consumes, so a proposal that
+        touched one block re-derives only that block."""
+        G, X, rwr_p, logdet_n, logphi, invphi_N = _common(theta, sh)
+        st = jax.vmap(_stage12_single)(G, X, invphi_N, tm_pad_j)
+        cache = dict(st, rwr=rwr_p, ldn=logdet_n, lphi=logphi)
+        return _stage3(theta, cache), cache
+
+    def _cache_site(theta, psr_idx, cache, sh):
+        """Single-site update: only pulsar ``psr_idx``'s parameters
+        changed (declared by the sampler's update_mask, validated by
+        CachedEvaluator). Re-Grams and re-factors ONE pulsar block —
+        O(ntoa * nb^2 + nb^3) instead of npsr times that — then reruns
+        stage 3 (the ORF coupling ties every pulsar to the GW columns,
+        so the joint Schur solve is always redone)."""
+        nw = eval_white(theta, sigma2_j)
+        phi_N = eval_phi(theta) * cs2_N_j
+        a = psr_idx
+        w_a = sh["mask"][a] / nw[a]
+        sqw = jnp.sqrt(w_a)
+        Ts = sh["T"][a] * sqw[:, None]
+        rs = sh["R"][a] * sqw
+        G_a = _gram_pair(Ts, Ts, gram_mode).astype(jnp.float64)
+        X_a = jnp.einsum("ik,i->k", Ts, rs, precision=_HIGH)
+        st_a = _stage12_single(G_a, X_a, 1.0 / phi_N[a], tm_pad_j[a])
+        cache = dict(cache)
+        for k, v in st_a.items():
+            cache[k] = cache[k].at[a].set(v)
+        cache["rwr"] = cache["rwr"].at[a].set(jnp.sum(rs * rs))
+        # the scalar sums are O(npsr * ntoa) elementwise — recomputing
+        # them in full keeps site updates bit-consistent with the full
+        # path's summation order
+        cache["ldn"] = jnp.sum(jnp.log(nw) * sh["mask"])
+        cache["lphi"] = jnp.sum(jnp.log(phi_N))
+        return _stage3(theta, cache), cache
+
+    def _cache_common(theta, cache, sh):
+        """Common-block update: only coupling-only GW parameters changed.
+        Every per-pulsar Gram/factorization is reused; just the coupling
+        inverse and the (npsr*n_g)^2 Schur solve rerun — O(nbasis^3)
+        instead of O(npsr * ntoa * nbasis^2)."""
+        del sh
+        return _stage3(theta, cache), cache
+
+    def loglike_schur(theta, sh):
+        # the cache is dead code under this jit (only lnl is returned),
+        # so XLA prunes it — the full path pays nothing for sharing
+        # its structure with the update paths
+        return _cache_init(theta, sh)[0]
+
     def loglike_dense(theta, sh):
-        G, X, rwr, logdet_n, logphi, invphi_N = _common(theta, sh)
+        G, X, rwr_p, logdet_n, logphi, invphi_N = _common(theta, sh)
+        rwr = jnp.sum(rwr_p)
         # full diagonal prior inverse in the permuted layout: region M gets
         # the big-phi stand-in (1 on padded slots), region G none (its
         # prior lives in the coupling blocks)
@@ -720,8 +827,20 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
     inner = loglike_schur if joint_mode == "schur" else loglike_dense
     like = PTALikelihood(psrs, sampled, inner, gram_mode, mesh=mesh,
                          consts=_sh)
+    # update_mask contract (evaluation-structure layer): installed for
+    # the nested-Schur path on process-local arrays with a static basis
+    # (a sampled chromatic index makes T walker-dependent, and a psr
+    # mesh would turn the single-block gather into a cross-device
+    # collective — both keep the always-correct full path only)
+    import os as _os
+    if (joint_mode == "schur" and mesh is None and not dyn_blocks
+            and _os.environ.get("EWT_UPDATE_MASK", "1") != "0"):
+        from ..samplers.evalproto import install_masked_protocol
+        install_masked_protocol(like, _cache_init, _cache_site,
+                                _cache_common, param_blocks)
     # introspection hook for tools/ (stage profiling, corner debugging)
     like._stages = dict(common=_common, coupling=_coupling_blocks,
+                        stage12_single=_stage12_single, stage3=_stage3,
                         NW=NW, MW=MW, n_g=n_g, npsr=npsr,
                         jitter=jitter, tm_pad=tm_pad_j,
                         joint_mode=joint_mode)

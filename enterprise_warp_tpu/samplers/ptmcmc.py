@@ -174,6 +174,19 @@ class PTSampler:
         # checkpointed
         self.fam_accept = np.zeros(8)
         self.fam_propose = np.zeros(8)
+        # update_mask emission (evaluation-structure layer): when the
+        # likelihood classifies its parameters into blocks
+        # (``like.param_blocks``, samplers/evalproto.py), every proposal
+        # is tagged with the block class it touched — [site, common,
+        # full] — so the cache-hit potential of the proposal mix is a
+        # first-class diagnostic (written to mask_stats.json per block).
+        # Single-dimension (prior draw), subset (conditional-Gibbs /
+        # KDE) and noise-slide proposals are the maskable families; the
+        # dense-direction families (SCAM/AM/DE/independence) always
+        # touch every block.
+        self.use_maskstats = getattr(like, "param_blocks", None) \
+            is not None
+        self.mask_counts = np.zeros(3)
         os.makedirs(outdir, exist_ok=True)
 
     # ---------------- initialization / resume -------------------------- #
@@ -291,10 +304,29 @@ class PTSampler:
             pair_s2 = jnp.asarray([p[2] for p in self._ns_pairs])
             pair_qlo = jnp.asarray([b[0] for b in self._ns_qb])
             pair_qhi = jnp.asarray([b[1] for b in self._ns_qb])
+        use_mask = self.use_maskstats
+        if use_mask:
+            from .evalproto import BLOCK_COMMON
+            pblocks = jnp.asarray(self.like.param_blocks)
+
+            def _mask_cls(blk):
+                """Block id -> update_mask class: 0 = single pulsar
+                block ('site'), 1 = coupling-only common block, 2 =
+                full recompute required."""
+                return jnp.where(blk >= 0, 0,
+                                 jnp.where(blk == BLOCK_COMMON, 1, 2))
+
+            def _mask_cls_subset(S):
+                """(W, k) proposal subsets -> class per walker: a subset
+                is maskable only when every touched dimension lives in
+                the same block."""
+                bS = pblocks[S]
+                same = jnp.all(bS == bS[:, :1], axis=1)
+                return jnp.where(same, _mask_cls(bS[:, 0]), 2)
 
         def one_step(carry, step_idx):
             x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop, \
-                fam_acc, fam_prop, \
+                fam_acc, fam_prop, mask_counts, \
                 eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL, \
                 lam, cg_rows, kde_pts, kde_bw, temps, consts = carry
             key, k1, k2, k3, k4, k5, k6, k7, k8, k9, k10, k11 = \
@@ -375,8 +407,8 @@ class PTSampler:
                     # UNCHANGED coordinates, so they are shared)
                     r_old = Lk.T @ (x_w[S] - m)
                     qc = 0.5 * (jnp.sum(z ** 2) - jnp.sum(r_old ** 2))
-                    return x_w.at[S].set(xs), qc
-                cg_prop, cg_qc = jax.vmap(cg_one)(
+                    return x_w.at[S].set(xs), qc, S
+                cg_prop, cg_qc, cg_S = jax.vmap(cg_one)(
                     x, jax.random.split(k10, W),
                     jax.random.split(k11, W))
                 prop = jnp.where((choice == 5)[:, None], cg_prop, prop)
@@ -405,8 +437,8 @@ class PTSampler:
                     xs = kde_pts[m, S] + kde_bw[S] * \
                         jax.random.normal(zkey, (kdims,))
                     qc = kde_logq(x_w[S], S) - kde_logq(xs, S)
-                    return x_w.at[S].set(xs), qc
-                kde_prop, kde_qc = jax.vmap(kde_one)(
+                    return x_w.at[S].set(xs), qc, S
+                kde_prop, kde_qc, kde_S = jax.vmap(kde_one)(
                     x, jax.random.split(ks, W),
                     jax.random.split(km, W),
                     jax.random.split(kz, W))
@@ -469,8 +501,8 @@ class PTSampler:
                     qc_loc = 0.5 * jnp.log1p(-f) \
                         - 0.5 * jnp.log1p(-f_old)
                     qc = jnp.where(is_glob, qc_glob, qc_loc)
-                    return x_w.at[ie].set(e_new).at[iq].set(q_new), qc
-                ns_prop, ns_qc = jax.vmap(ns_one)(
+                    return x_w.at[ie].set(e_new).at[iq].set(q_new), qc, ie
+                ns_prop, ns_qc, ns_ie = jax.vmap(ns_one)(
                     x, jax.random.split(kb, W),
                     jax.random.split(kf, W))
                 prop = jnp.where((choice == 7)[:, None], ns_prop, prop)
@@ -515,6 +547,26 @@ class PTSampler:
             fam_prop = fam_prop + jnp.zeros(8).at[cold_ch].add(1.0)
             fam_acc = fam_acc + jnp.zeros(8).at[cold_ch].add(
                 accept[:nchains].astype(jnp.float32))
+            if use_mask:
+                # update_mask emission: tag each walker's proposal with
+                # the block class it touched (site / common / full) so
+                # the cache-hit potential of the proposal mix lands in
+                # the diagnostics artifacts
+                cls = jnp.full((W,), 2, dtype=jnp.int32)
+                cls = jnp.where(choice == 3, _mask_cls(pblocks[jp]), cls)
+                if use_cg:
+                    cls = jnp.where(choice == 5,
+                                    _mask_cls_subset(cg_S), cls)
+                if use_kde:
+                    cls = jnp.where(choice == 6,
+                                    _mask_cls_subset(kde_S), cls)
+                if use_ns:
+                    # a noise-slide pair is two white params of ONE
+                    # backend — classify by its efac dimension
+                    cls = jnp.where(choice == 7,
+                                    _mask_cls(pblocks[ns_ie]), cls)
+                mask_counts = mask_counts + jnp.zeros(3).at[
+                    cls[:nchains]].add(1.0)
 
             # --- parallel-tempering swaps every swap_every steps ------
             def do_swap(args):
@@ -567,17 +619,17 @@ class PTSampler:
             else:
                 ys = (x[:nchains], lnl[:nchains], lnp[:nchains])
             return ((x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                     fam_acc, fam_prop,
+                     fam_acc, fam_prop, mask_counts,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
                      lam, cg_rows, kde_pts, kde_bw, temps, consts), ys)
 
         @partial(jax.jit, static_argnames=())
         def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                  fam_acc, fam_prop,
+                  fam_acc, fam_prop, mask_counts,
                   eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
                   lam, cg_rows, kde_pts, kde_bw, temps, consts):
             carry = (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-                     fam_acc, fam_prop,
+                     fam_acc, fam_prop, mask_counts,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
                      lam, cg_rows, kde_pts, kde_bw, temps, consts)
             carry, ys = jax.lax.scan(
@@ -660,16 +712,18 @@ class PTSampler:
             jnp.asarray(st.accepted), jnp.asarray(st.swaps_accepted),
             jnp.asarray(st.swaps_proposed),
             jnp.asarray(self.fam_accept),
-            jnp.asarray(self.fam_propose), jnp.asarray(eigvecs),
+            jnp.asarray(self.fam_propose),
+            jnp.asarray(self.mask_counts), jnp.asarray(eigvecs),
             jnp.asarray(eigvals), jnp.asarray(chol),
             jnp.asarray(ind_mean), jnp.asarray(ind_L),
             jnp.asarray(ind_iL), jnp.asarray(lam),
             jnp.asarray(cg_rows), jnp.asarray(kde_pts),
             jnp.asarray(kde_bw), jnp.asarray(temps), self._consts)
         (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
-         fam_acc, fam_prop, *_unused) = carry
+         fam_acc, fam_prop, mask_counts, *_unused) = carry
         self.fam_accept = np.asarray(fam_acc)
         self.fam_propose = np.asarray(fam_prop)
+        self.mask_counts = np.asarray(mask_counts)
         st.x = np.asarray(x)
         st.lnl = np.asarray(lnl)
         st.lnp = np.asarray(lnp)
@@ -743,6 +797,7 @@ class PTSampler:
         st.step = 0
         self.fam_accept = np.zeros(8)
         self.fam_propose = np.zeros(8)
+        self.mask_counts = np.zeros(3)
         self._anneal_state = st
         return st
 
@@ -867,6 +922,20 @@ class PTSampler:
                 st.cov = (1 - w) * st.cov + w * new_cov
             if _is_primary():
                 np.save(os.path.join(self.outdir, "cov.npy"), st.cov)
+                if self.use_maskstats:
+                    # update_mask emission record: what fraction of the
+                    # cold-rung proposal mix a block-sparse evaluator
+                    # could serve from cache (diagnostics artifact,
+                    # refreshed per block like cov.npy)
+                    import json as _json
+                    from ..utils.diagnostics import cache_hit_summary
+                    tmp = os.path.join(self.outdir,
+                                       "mask_stats.json.tmp")
+                    with open(tmp, "w") as fh:
+                        _json.dump(cache_hit_summary(*self.mask_counts),
+                                   fh, indent=1)
+                    os.replace(tmp, os.path.join(self.outdir,
+                                                 "mask_stats.json"))
             self._save_state(st)
             if verbose:
                 fam = " ".join(
@@ -874,8 +943,13 @@ class PTSampler:
                         ("scam", "am", "de", "pd", "ind", "cg", "kde",
                          "ns"),
                         self.fam_accept, self.fam_propose))
+                mask = ""
+                if self.use_maskstats:
+                    tot = max(self.mask_counts.sum(), 1.0)
+                    mask = (" maskable="
+                            f"{self.mask_counts[:2].sum() / tot:.2f}")
                 print(f"step {st.step}/{nsamp} acc={acc_rate:.3f} "
-                      f"swap={swap_rate:.3f} [{fam}] "
+                      f"swap={swap_rate:.3f} [{fam}]{mask} "
                       f"maxlnl={np.max(st.lnl):.2f}")
         return st
 

@@ -94,6 +94,25 @@ def effective_sample_size(chains):
     return float(m * n / tau)
 
 
+def cache_hit_summary(site, common, full):
+    """Cache-hit record of the evaluation-structure layer (JSON-ready).
+
+    ``site``/``common``/``full`` count evaluations (or emitted proposal
+    masks) by update_mask class — see ``samplers/evalproto.py``. The
+    ``cache_hit_rate`` is the fraction that reused cached per-pulsar
+    factorizations; it is the provenance field the bench and sampler
+    artifacts carry so the block-sparse win is visible per run.
+    """
+    site, common, full = float(site), float(common), float(full)
+    total = site + common + full
+    rate = (site + common) / total if total else 0.0
+    return {
+        "proposals": {"site": site, "common": common, "full": full},
+        "total": total,
+        "cache_hit_rate": round(rate, 4),
+    }
+
+
 def summarize_chains(chains, names=None):
     """Per-parameter diagnostics table.
 

@@ -172,6 +172,49 @@ class TestPallasInterpret:
         from enterprise_warp_tpu.ops import cholfuse
         assert cholfuse._probe_once(interpret=True) is True
 
+    def test_probe_verdict_caching(self, monkeypatch):
+        # transient (transport) probe failures must NOT pin the verdict
+        # — the next call re-probes; compile/lowering failures pin False
+        from enterprise_warp_tpu.ops import cholfuse
+        monkeypatch.setattr(cholfuse, "_PROBE_RESULT", None)
+        monkeypatch.setattr(cholfuse, "_PROBE_REASON", "not probed")
+        monkeypatch.setattr(cholfuse, "_PROBE_TRANSIENTS", 0)
+
+        def _transient(interpret=False):
+            raise RuntimeError("DEADLINE_EXCEEDED: socket closed")
+
+        monkeypatch.setattr(cholfuse, "_probe_once", _transient)
+        assert cholfuse.pallas_chol_available() is False  # this trace
+        assert cholfuse._PROBE_RESULT is None             # re-probes
+        st = cholfuse.probe_status()
+        assert st["pallas_chol"] is None
+        assert "transient" in st["reason"]
+        # the degradation is counted even if a later re-probe succeeds
+        assert st["transient_failures"] == 1
+        # persistent transience pins False at the cap (bounds the
+        # per-trace probe-timeout stall of a dead tunnel)
+        for _ in range(cholfuse._PROBE_TRANSIENT_CAP - 1):
+            cholfuse.pallas_chol_available()
+        assert cholfuse._PROBE_RESULT is False
+        assert "cap" in cholfuse.probe_status()["reason"]
+
+        monkeypatch.setattr(cholfuse, "_PROBE_RESULT", None)
+        monkeypatch.setattr(cholfuse, "_PROBE_TRANSIENTS", 0)
+
+        def _mosaic(interpret=False):
+            raise RuntimeError("Mosaic lowering failed: unsupported op")
+
+        monkeypatch.setattr(cholfuse, "_probe_once", _mosaic)
+        assert cholfuse.pallas_chol_available() is False
+        assert cholfuse._PROBE_RESULT is False            # pinned
+        assert "compile/lowering" in cholfuse.probe_status()["reason"]
+
+        # a later success after a transient failure re-enables the path
+        monkeypatch.setattr(cholfuse, "_PROBE_RESULT", None)
+        monkeypatch.setattr(cholfuse, "_probe_once",
+                            lambda interpret=False: True)
+        assert cholfuse.pallas_chol_available() is True
+
     def test_larger_tile_class(self):
         # n > 128 switches to the T=4 tile (joint-PTA noise-block
         # sizes); the tile-switch path must factor correctly too

@@ -1,0 +1,256 @@
+"""Evaluation-structure layer tests: cached vs full-recompute equality.
+
+Two caching mechanisms, one correctness contract each:
+
+- **constant-Gram folding** (fixed-white-noise single-pulsar kernel,
+  ``models/build.py``): the build-time-folded Gram blocks must reproduce
+  the per-eval recompute — to f64 tightness in ``gram_mode='f64'`` (the
+  fold evaluates the identical computation once) and to the
+  split-refinement noise class in ``'split'`` (batched vs unbatched
+  lowering of the same hi/lo products);
+- **block-sparse recomputation** (joint-PTA Schur kernel,
+  ``parallel/pta.py`` + the update_mask contract in
+  ``samplers/evalproto.py``): any sequence of masked updates must land on
+  the same lnL as a from-scratch recompute at the final theta, and a
+  STALE mask — declaring a block the proposal did not stay inside — must
+  raise instead of silently corrupting the chain.
+"""
+
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                        build_pulsar_likelihood)
+from enterprise_warp_tpu.parallel import build_pta_likelihood
+from enterprise_warp_tpu.samplers.evalproto import (BLOCK_COMMON,
+                                                    CachedEvaluator,
+                                                    derive_update_mask)
+from enterprise_warp_tpu.sim.noise import make_fake_pta, make_fake_pulsar
+
+NTOA, NMODES = 120, 4
+
+
+def fixed_white_terms(psr, efac=1.1, equad=-7.5):
+    """Flagship-vocabulary terms with white noise noisefile-fixed
+    (scalar prior spec -> Constant)."""
+    m = StandardModels(psr=psr)
+    m.params.efac = efac
+    m.params.equad = equad
+    return TermList(psr, [m.efac("by_backend"), m.equad("by_backend"),
+                          m.spin_noise(f"powerlaw_{NMODES}_nfreqs")])
+
+
+def one_pulsar(seed=3):
+    psr = make_fake_pulsar(name="J0000", ntoa=NTOA, backends=("X", "Y"),
+                           freqs_mhz=(1400.0,), seed=seed)
+    psr.residuals = psr.toaerrs * \
+        np.random.default_rng(seed).standard_normal(NTOA)
+    return psr
+
+
+class TestConstGrams:
+    def test_auto_detection_and_force(self):
+        psr = one_pulsar()
+        like = build_pulsar_likelihood(psr, fixed_white_terms(psr))
+        assert like.const_grams            # all-Constant white -> folded
+        like_off = build_pulsar_likelihood(psr, fixed_white_terms(psr),
+                                           const_grams=False)
+        assert not like_off.const_grams
+        m = StandardModels(psr=psr)        # sampled white -> not eligible
+        sampled = TermList(psr, [m.efac("by_backend"),
+                                 m.spin_noise(f"powerlaw_{NMODES}_nfreqs")])
+        assert not build_pulsar_likelihood(psr, sampled).const_grams
+        with pytest.raises(ValueError, match="fixed-white-noise"):
+            build_pulsar_likelihood(psr, sampled, const_grams=True)
+
+    @pytest.mark.parametrize("gram_mode,tol", [("f64", 1e-8),
+                                               ("split", 2e-3)])
+    def test_cached_matches_uncached(self, gram_mode, tol):
+        """Folded vs per-eval Gram recompute over prior draws: f64
+        tight; split to the documented refinement/lowering noise."""
+        psr = one_pulsar()
+        terms = fixed_white_terms(psr)
+        lc = build_pulsar_likelihood(psr, terms, gram_mode=gram_mode)
+        lu = build_pulsar_likelihood(psr, terms, gram_mode=gram_mode,
+                                     const_grams=False)
+        th = lc.sample_prior(np.random.default_rng(1), 6)
+        a = np.asarray(lc.loglike_batch(th))
+        b = np.asarray(lu.loglike_batch(th))
+        finite = np.isfinite(a) & np.isfinite(b)
+        assert finite.any()
+        np.testing.assert_allclose(a[finite], b[finite], atol=tol,
+                                   rtol=0)
+        # non-finite corners must agree on WHICH points they reject
+        assert np.array_equal(np.isfinite(a), np.isfinite(b))
+
+    def test_matches_sampled_kernel_at_pinned_values(self):
+        """The fixed-white cached kernel is the SAME likelihood as the
+        sampled-white kernel evaluated with its white dims pinned to the
+        fixed values — the recompute path the cache replaces."""
+        psr = one_pulsar()
+        lc = build_pulsar_likelihood(psr, fixed_white_terms(psr),
+                                     gram_mode="f64")
+        m = StandardModels(psr=psr)
+        ls = build_pulsar_likelihood(
+            psr, TermList(psr, [m.efac("by_backend"),
+                                m.equad("by_backend"),
+                                m.spin_noise(f"powerlaw_{NMODES}_nfreqs")]),
+            gram_mode="f64")
+        rng = np.random.default_rng(2)
+        th_red = lc.sample_prior(rng, 4)
+        th_full = np.empty((4, ls.ndim))
+        red = 0
+        for i, n in enumerate(ls.param_names):
+            if n.endswith("efac"):
+                th_full[:, i] = 1.1
+            elif n.endswith("log10_equad"):
+                th_full[:, i] = -7.5
+            else:
+                th_full[:, i] = th_red[:, red]
+                red += 1
+        assert red == lc.ndim
+        a = np.asarray(lc.loglike_batch(th_red))
+        b = np.asarray(ls.loglike_batch(th_full))
+        np.testing.assert_allclose(a, b, atol=1e-8, rtol=0)
+
+
+def joint_like(gram_mode, npsr=3, seed=3):
+    psrs = make_fake_pta(npsr=npsr, ntoa=80, seed=seed)
+    rng = np.random.default_rng(seed)
+    for p in psrs:
+        p.residuals = p.toaerrs * rng.standard_normal(len(p))
+    tls = []
+    for p in psrs:
+        m = StandardModels(psr=p)
+        tls.append(TermList(p, [m.efac("by_backend"),
+                                m.spin_noise("powerlaw_3_nfreqs"),
+                                m.gwb("hd_vary_gamma_3_nfreqs")]))
+    # joint_mode='schur' forced so the f64 oracle mode exercises the
+    # SAME path the cache decomposes (its default would be 'dense')
+    return build_pta_likelihood(psrs, tls, gram_mode=gram_mode,
+                                joint_mode="schur")
+
+
+def moderate_theta(like):
+    th = np.empty(like.ndim)
+    for i, n in enumerate(like.param_names):
+        th[i] = (1.05 if n.endswith("efac") else
+                 -13.5 if n.endswith("log10_A") else 3.5)
+    return th
+
+
+class TestJointUpdateMask:
+    def test_param_blocks_classification(self):
+        like = joint_like("split")
+        for name, blk in zip(like.param_names, like.param_blocks):
+            if name.startswith("gw_"):
+                assert blk == BLOCK_COMMON
+            else:
+                # per-pulsar params carry their pulsar's index
+                assert blk >= 0
+                assert name.startswith(like.psrs[blk].name)
+
+    @pytest.mark.parametrize("gram_mode,tol", [("f64", 1e-8),
+                                               ("split", 1e-6)])
+    def test_randomized_masked_sequence(self, gram_mode, tol):
+        """A randomized site/common/full update sequence must track the
+        full recompute at every step."""
+        like = joint_like(gram_mode)
+        pb = np.asarray(like.param_blocks)
+        npsr = int(pb.max()) + 1
+        rng = np.random.default_rng(11)
+        th = moderate_theta(like)
+        ev = CachedEvaluator(like, th)
+        assert ev.lnl == pytest.approx(float(like.loglike(th)), abs=tol)
+        for step in range(10):
+            kind = rng.integers(0, 3)
+            nxt = th.copy()
+            if kind == 0:                          # single pulsar block
+                a = int(rng.integers(0, npsr))
+                idx = np.nonzero(pb == a)[0]
+                nxt[rng.choice(idx, size=rng.integers(1, len(idx) + 1),
+                               replace=False)] += \
+                    0.01 * rng.standard_normal()
+                lnl = ev.update(nxt, ("psr", a))
+            elif kind == 1:                        # common GW block
+                idx = np.nonzero(pb == BLOCK_COMMON)[0]
+                nxt[idx] += 0.01 * rng.standard_normal(len(idx))
+                lnl = ev.update(nxt, ("common",))
+            else:                                  # cross-block: full
+                nxt += 0.002 * rng.standard_normal(like.ndim)
+                lnl = ev.update(nxt, None)
+            assert lnl == pytest.approx(float(like.loglike(nxt)),
+                                        abs=tol), (step, kind)
+            th = nxt
+        assert ev.counters["site"] + ev.counters["common"] > 0
+        assert 0.0 < ev.cache_hit_rate <= 1.0
+
+    def test_auto_mask_derivation(self):
+        like = joint_like("split")
+        pb = np.asarray(like.param_blocks)
+        th = moderate_theta(like)
+        site_i = np.nonzero(pb == 0)[0][0]
+        gw_i = np.nonzero(pb == BLOCK_COMMON)[0][0]
+        t1 = th.copy()
+        t1[site_i] += 0.01
+        assert derive_update_mask(pb, th, t1) == ("psr", 0)
+        t2 = th.copy()
+        t2[gw_i] += 0.01
+        assert derive_update_mask(pb, th, t2) == ("common",)
+        t3 = th.copy()
+        t3[[site_i, gw_i]] += 0.01
+        assert derive_update_mask(pb, th, t3) is None
+        # "auto" dispatches through the derivation and stays correct
+        ev = CachedEvaluator(like, th)
+        for nxt in (t1, t2, t3):
+            assert ev.update(nxt, "auto") == pytest.approx(
+                float(like.loglike(nxt)), abs=1e-6)
+            ev.reset(th)
+
+    def test_stale_mask_raises(self):
+        """Misuse guard: declaring a block the transition did not stay
+        inside must raise, not silently reuse invalid factorizations."""
+        like = joint_like("split")
+        pb = np.asarray(like.param_blocks)
+        th = moderate_theta(like)
+        ev = CachedEvaluator(like, th)
+        other = th.copy()
+        other[np.nonzero(pb == 1)[0][0]] += 0.1    # pulsar 1 touched
+        with pytest.raises(ValueError, match="stale update_mask"):
+            ev.update(other, ("psr", 0))
+        gw = th.copy()
+        gw[np.nonzero(pb == BLOCK_COMMON)[0][0]] += 0.1
+        with pytest.raises(ValueError, match="stale update_mask"):
+            ev.update(gw, ("psr", 0))
+        both = th.copy()
+        both[np.nonzero(pb == 0)[0][0]] += 0.1
+        with pytest.raises(ValueError, match="stale update_mask"):
+            ev.update(both, ("common",))
+        # the failed updates must not have corrupted the held state
+        assert ev.update(th.copy(), "auto") == pytest.approx(
+            float(like.loglike(th)), abs=1e-6)
+
+    def test_reject_restores_state(self):
+        """MH rejection: reject() must restore the pre-update state in
+        O(1) so later masked updates validate against — and compute
+        from — the retained theta, not the rejected proposal."""
+        like = joint_like("split")
+        pb = np.asarray(like.param_blocks)
+        th = moderate_theta(like)
+        ev = CachedEvaluator(like, th)
+        lnl0 = ev.lnl
+        prop = th.copy()
+        prop[np.nonzero(pb == 0)[0][0]] += 0.05
+        ev.update(prop, ("psr", 0))
+        assert ev.reject() == lnl0
+        np.testing.assert_array_equal(ev.theta, th)
+        # a second reject has nothing to revert
+        with pytest.raises(RuntimeError, match="no update to revert"):
+            ev.reject()
+        # post-rejection updates evaluate correctly from the restored
+        # cache (would be wrong if the rejected factorization leaked)
+        nxt = th.copy()
+        nxt[np.nonzero(pb == 1)[0][0]] += 0.02
+        assert ev.update(nxt, ("psr", 1)) == pytest.approx(
+            float(like.loglike(nxt)), abs=1e-6)
+        assert ev.counters["rejected"] == 1
