@@ -101,7 +101,15 @@ def kernel_flops_per_eval(ntoa, nb, ntm):
     return gram + chol + solves
 
 
-def time_device(like, thetas, reps=REPS, trials=3):
+# eval-rate timeline: every timed trial lands here as
+# {t_s, evals_per_s, label}, and the whole list is embedded in the
+# bench JSON so perf records carry their own measurement trajectory
+# (warm-up drift, contention dips) instead of a single opaque number
+_BENCH_T0 = time.perf_counter()
+_RATE_TIMELINE = []
+
+
+def time_device(like, thetas, reps=REPS, trials=3, label=None):
     """Best-of-``trials`` batched throughput (guards against transient
     device contention skewing a single timing window)."""
     import jax
@@ -113,8 +121,29 @@ def time_device(like, thetas, reps=REPS, trials=3):
         for _ in range(reps):
             out = like.loglike_batch(thetas)
         jax.block_until_ready(out)
-        best = max(best, len(thetas) * reps / (time.perf_counter() - t0))
+        rate = len(thetas) * reps / (time.perf_counter() - t0)
+        _RATE_TIMELINE.append({
+            "t_s": round(time.perf_counter() - _BENCH_T0, 2),
+            "evals_per_s": round(rate, 1),
+            "label": label or f"batch={len(thetas)}"})
+        best = max(best, rate)
     return best
+
+
+def telemetry_snapshot():
+    """Compile/retrace provenance + the eval-rate timeline for the
+    bench JSON: future perf PRs can tell a recompiling run (inflated
+    wall time, retraces > expected) from a genuine regression without
+    re-running anything."""
+    from enterprise_warp_tpu.utils.telemetry import registry
+    snap = registry().snapshot()
+    return {
+        "retraces": {k: v for k, v in snap["counters"].items()
+                     if k.startswith("retraces")},
+        "counters": {k: v for k, v in snap["counters"].items()
+                     if not k.startswith("retraces")},
+        "eval_rate_timeline": list(_RATE_TIMELINE),
+    }
 
 
 def main():
@@ -230,7 +259,8 @@ def main():
                               m.dm_noise(f"powerlaw_{nfreq_s}_nfreqs")])
             lk = build_pulsar_likelihood(p, tl)
             th = lk.sample_prior(np.random.default_rng(4), batch_s)
-            eps = time_device(lk, th, reps=5)
+            eps = time_device(lk, th, reps=5,
+                              label=f"sweep_ntoa{ntoa_s}_b{batch_s}")
         except Exception as e:   # noqa: BLE001 — tunnel drop mid-sweep
             # the sweep is diagnostics; a dropped tunnel here must not
             # forfeit the already-measured headline record (round-3
@@ -260,14 +290,13 @@ def main():
     if device_ok:
         # persist the device measurement so a later tunnel-down bench
         # can still echo a real device number (flagged stale)
-        with open(cache_path + ".tmp", "w") as fh:
-            json.dump({"value": out["value"],
-                       "vs_baseline": out["vs_baseline"],
-                       "baseline": out["baseline"],
-                       "measured_at":
-                           time.strftime("%Y-%m-%dT%H:%M:%S")}, fh,
-                      indent=1)
-        os.replace(cache_path + ".tmp", cache_path)
+        from enterprise_warp_tpu.io.writers import atomic_write_json
+        atomic_write_json(cache_path,
+                          {"value": out["value"],
+                           "vs_baseline": out["vs_baseline"],
+                           "baseline": out["baseline"],
+                           "measured_at":
+                               time.strftime("%Y-%m-%dT%H:%M:%S")})
     else:
         # The value above is the jax-CPU figure, NOT a device number.
         # Flag it so the record can never be misread as a TPU result.
@@ -310,6 +339,9 @@ def main():
     # be distinguishable from a real Mosaic regression)
     from enterprise_warp_tpu.ops.cholfuse import probe_status
     out["pallas_probe"] = probe_status()
+    # telemetry provenance: compile counts + the eval-rate timeline
+    # (see telemetry_snapshot) ride along in every headline record
+    out["telemetry"] = telemetry_snapshot()
     print(json.dumps(out))
 
 
@@ -389,9 +421,12 @@ def micro_bench():
             th_full[:, i] = th[:, red]
             red += 1
     assert red == lk_cached.ndim
-    eps_recomp = time_device(lk_recomp, th_full, reps=5)
-    eps_folded = time_device(lk_folded, th, reps=5)
-    eps_cached = time_device(lk_cached, th, reps=5)
+    eps_recomp = time_device(lk_recomp, th_full, reps=5,
+                             label="fixed_white_full")
+    eps_folded = time_device(lk_folded, th, reps=5,
+                             label="fixed_white_xla_folded")
+    eps_cached = time_device(lk_cached, th, reps=5,
+                             label="fixed_white_cached")
     dmax = float(np.max(np.abs(
         np.asarray(lk_cached.loglike_batch(th[:32]))
         - np.asarray(lk_recomp.loglike_batch(th_full[:32])))))
@@ -482,12 +517,12 @@ def micro_bench():
           f"{dmax_j:.2e}, cache_hit_rate={stats['cache_hit_rate']}",
           file=sys.stderr)
 
+    out["telemetry"] = telemetry_snapshot()
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_MICRO.json")
     record = dict(out, measured_at=time.strftime("%Y-%m-%dT%H:%M:%S"))
-    with open(path + ".tmp", "w") as fh:
-        json.dump(record, fh, indent=1)
-    os.replace(path + ".tmp", path)
+    from enterprise_warp_tpu.io.writers import atomic_write_json
+    atomic_write_json(path, record)
     print(json.dumps(out))
 
 
@@ -520,11 +555,12 @@ def config_benches():
                         "CONFIGS_BENCH.json")
 
     def flush():
+        from enterprise_warp_tpu.io.writers import atomic_write_json
         record = {"device_unavailable": not device_ok, "configs": out,
                   "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                  "platform": "device" if device_ok else "cpu-fallback"}
-        with open(path, "w") as fh:
-            json.dump(record, fh, indent=1)
+                  "platform": "device" if device_ok else "cpu-fallback",
+                  "telemetry": telemetry_snapshot()}
+        atomic_write_json(path, record)
         return record
 
     def moderate_theta(like, seed=3, spread=0.01, batch=1):
@@ -554,7 +590,7 @@ def config_benches():
             jax.block_until_ready(o)
             compile_s = time.perf_counter() - t0
             eps = time_device(like, th, reps=5 if device_ok else 2,
-                              trials=3 if device_ok else 1)
+                              trials=3 if device_ok else 1, label=name)
         except Exception as e:   # noqa: BLE001 — tunnel drop mid-config
             # record the blocker and keep going: later configs may be
             # cheap enough to survive a flaky tunnel, and the artifact

@@ -14,6 +14,7 @@ Outputs follow the reference directory contract so
 from __future__ import annotations
 
 import importlib.util
+import os
 import sys
 
 from .config import Params
@@ -79,41 +80,59 @@ def main(argv=None):
 
     resume = not bool(opts.wipe_old_output)
     first_id = min(likes)
-    if params.sampler == "ptmcmcsampler":
-        like = (HyperModelLikelihood(likes) if len(likes) >= 2
-                else likes[first_id])
-        nsamp = int(getattr(params, "nsamp",
-                            params.sampler_kwargs.get("nsamp", 1000000)))
-        run_ptmcmc(like, params.output_dir, nsamp,
-                   params=params, resume=resume)
-    elif params.sampler == "hmc":
-        like = likes[first_id]
-        if len(likes) > 1:
-            print("note: HMC has no gradient for the discrete nmodel "
-                  "index; using model 0 (use ptmcmcsampler for "
-                  "product-space selection)")
-        kw = params.sampler_kwargs
-        run_hmc(like, params.output_dir,
-                int(getattr(params, "nsamp", kw.get("nsamp", 10000))),
-                params=params, resume=resume)
-    elif params.sampler in ("emcee", "ptemcee"):
-        like = (HyperModelLikelihood(likes) if len(likes) >= 2
-                else likes[first_id])
-        kw = params.sampler_kwargs
-        run_ptmcmc(like, params.output_dir, int(kw.get("nsteps", 10000)),
-                   params=params, resume=resume,
-                   ntemps=int(kw.get("ntemps", 1)),
-                   nchains=int(kw.get("nwalkers", 64)))
-    else:
-        like = likes[first_id]
-        if len(likes) > 1:
-            print(f"note: nested sampling uses model {first_id}; run "
-                  "per-model for evidences (reference Bilby branch "
-                  "behavior)")
-        kw = params.sampler_kwargs
-        run_nested(like, outdir=params.output_dir, label=params.label,
-                   nlive=int(kw.get("nlive", 500)),
-                   dlogz=float(kw.get("dlogz", 0.1)), resume=resume)
+
+    # run-level telemetry scope (utils/telemetry.py): the whole
+    # sampling stage — warm starts included — shares one events.jsonl
+    # under the run's output directory, keyed by the paramfile hash so
+    # a report can tie the event stream back to its exact config
+    import hashlib
+
+    from .utils import telemetry
+    with open(opts.prfile, "rb") as fh:
+        config_hash = hashlib.sha256(fh.read()).hexdigest()[:16]
+    with telemetry.run_scope(params.output_dir, sampler=params.sampler,
+                             config_hash=config_hash,
+                             prfile=os.path.abspath(opts.prfile),
+                             label=getattr(params, "label", None)):
+        if params.sampler == "ptmcmcsampler":
+            like = (HyperModelLikelihood(likes) if len(likes) >= 2
+                    else likes[first_id])
+            nsamp = int(getattr(
+                params, "nsamp",
+                params.sampler_kwargs.get("nsamp", 1000000)))
+            run_ptmcmc(like, params.output_dir, nsamp,
+                       params=params, resume=resume)
+        elif params.sampler == "hmc":
+            like = likes[first_id]
+            if len(likes) > 1:
+                print("note: HMC has no gradient for the discrete "
+                      "nmodel index; using model 0 (use ptmcmcsampler "
+                      "for product-space selection)")
+            kw = params.sampler_kwargs
+            run_hmc(like, params.output_dir,
+                    int(getattr(params, "nsamp", kw.get("nsamp",
+                                                        10000))),
+                    params=params, resume=resume)
+        elif params.sampler in ("emcee", "ptemcee"):
+            like = (HyperModelLikelihood(likes) if len(likes) >= 2
+                    else likes[first_id])
+            kw = params.sampler_kwargs
+            run_ptmcmc(like, params.output_dir,
+                       int(kw.get("nsteps", 10000)),
+                       params=params, resume=resume,
+                       ntemps=int(kw.get("ntemps", 1)),
+                       nchains=int(kw.get("nwalkers", 64)))
+        else:
+            like = likes[first_id]
+            if len(likes) > 1:
+                print(f"note: nested sampling uses model {first_id}; "
+                      "run per-model for evidences (reference Bilby "
+                      "branch behavior)")
+            kw = params.sampler_kwargs
+            run_nested(like, outdir=params.output_dir,
+                       label=params.label,
+                       nlive=int(kw.get("nlive", 500)),
+                       dlogz=float(kw.get("dlogz", 0.1)), resume=resume)
     return 0
 
 

@@ -66,7 +66,9 @@ def get_noise_dict(psrlist, noisefiles: str) -> dict:
         path = os.path.join(noisefiles, f"{name}_noise.json")
         matches = glob.glob(path)
         if not matches:
-            print(f"warning: no noisefile for {name} in {noisefiles}")
+            from ..utils.logging import get_logger
+            get_logger("ewt.config").warning(
+                "no noisefile for %s in %s", name, noisefiles)
             continue
         with open(matches[0]) as fh:
             d = json.load(fh)

@@ -226,7 +226,10 @@ class Params:
                 if opt in self.models[key].__dict__ and val is not None:
                     self.models[key].__dict__[opt] = val
                     self.label += f"_{opt}_{val}"
-                    print(f"Model {key}: overriding {opt} = {val}")
+                    from ..utils.logging import get_logger
+                    get_logger("ewt.config").info(
+                        "Model %s: overriding %s = %s", key, opt,
+                        val)
 
     def set_default_params(self):
         """Defaults (reference ``enterprise_warp.py:221-270``)."""
@@ -348,7 +351,9 @@ class Params:
                 if self.opts is not None and \
                         getattr(self.opts, "drop", 0) and \
                         getattr(self.opts, "num", None) == num:
-                    print(f"Dropping pulsar {pname} (jackknife)")
+                    from ..utils.logging import get_logger
+                    get_logger("ewt.config").info(
+                        "Dropping pulsar %s (jackknife)", pname)
                     self.output_dir = os.path.join(
                         prefix, f"{num}_{pname}") + "/"
                     continue

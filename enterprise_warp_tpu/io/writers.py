@@ -1,4 +1,4 @@
-"""tempo2 .par / .tim writers.
+"""tempo2 .par / .tim writers, plus the shared atomic JSON writer.
 
 The reference never writes timing files — simulated datasets are produced by
 mutating libstempo pulsar objects in place and saving through tempo2
@@ -14,6 +14,7 @@ and tests.
 from __future__ import annotations
 
 import copy
+import json
 import math
 import os
 
@@ -23,6 +24,36 @@ from .. import constants as const
 from .par import ParFile
 from .pulsar import Pulsar
 from .tim import TimFile
+
+
+def atomic_write_json(path: str, obj, indent: int = 1, sort_keys=False,
+                      default=None):
+    """Write ``obj`` as JSON to ``path`` atomically (tmp file + rename).
+
+    The shared write path for every run artifact refreshed while a run
+    is live (``mask_stats.json``, nested result JSON, ``run_report.json``,
+    bench records): a kill mid-write must never leave a truncated file
+    where a consumer — a resumed run, a results process tailing the
+    directory — expects valid JSON. ``os.replace`` is atomic on POSIX
+    within one filesystem, which the same-directory tmp name guarantees.
+
+    ``default`` falls back to ``float`` coercion for numpy scalars (the
+    dominant non-JSON type in run artifacts) when not given.
+    """
+    if default is None:
+        default = float
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(obj, fh, indent=indent, sort_keys=sort_keys,
+                      default=default)
+        os.replace(tmp, path)
+    except BaseException:
+        # a failed dump must not leave a stray tmp next to the artifact
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+    return path
 
 
 def _rad_to_hms(rad: float) -> str:

@@ -81,7 +81,7 @@ class MultiPulsarLikelihood(PriorMixin):
             return out
 
         from ..samplers.evalproto import install_protocol
-        install_protocol(self, _eval, self.consts)
+        install_protocol(self, _eval, self.consts, name="multipulsar")
 
 
 

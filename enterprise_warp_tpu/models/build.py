@@ -74,14 +74,20 @@ class PulsarLikelihood(PriorMixin):
     """
 
     def __init__(self, psr, sampled, loglike_fn, gram_mode):
+        from ..utils.telemetry import traced
+
         self.psr = psr
         self.params = sampled
         self.param_names = [p.name for p in sampled]
         self.ndim = len(sampled)
         self._fn = loglike_fn
         self.gram_mode = gram_mode
-        self.loglike = jax.jit(loglike_fn)
-        self.loglike_batch = jax.jit(jax.vmap(loglike_fn))
+        # traced jits (utils/telemetry.py): retraces of the kernel —
+        # a new walker-batch shape per call site — are counted in the
+        # registry and surface in bench/run compile provenance
+        self.loglike = traced(loglike_fn, name="pulsar.eval")
+        self.loglike_batch = traced(jax.vmap(loglike_fn),
+                                    name="pulsar.eval_batch")
         self.noise_pairs = _noise_slide_pairs(psr, self.param_names)
 
 
@@ -598,5 +604,5 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     # composition path through _fn stays valid).
     from ..samplers.evalproto import install_protocol
     install_protocol(like, loglike_inner, sharded,
-                     public=mesh is not None)
+                     public=mesh is not None, name="pulsar")
     return like

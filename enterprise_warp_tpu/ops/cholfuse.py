@@ -335,7 +335,8 @@ def pallas_chol_available():
     bench/leg provenance artifacts."""
     global _PROBE_RESULT, _PROBE_REASON, _PROBE_TRANSIENTS
     if _PROBE_RESULT is None:
-        import sys
+        from ..utils.logging import get_logger
+        _log = get_logger("ewt.cholfuse")
         try:
             _PROBE_RESULT = _probe_once()
             if _PROBE_RESULT:
@@ -345,9 +346,9 @@ def pallas_chol_available():
                 # lowering regression) — as disable-worthy as a crash,
                 # and just as much in need of a visible trace
                 _PROBE_REASON = "accuracy check failed"
-                print("# cholfuse: Pallas probe compiled but failed "
-                      "the accuracy check; using the XLA "
-                      "preconditioner path", file=sys.stderr)
+                _log.warning("Pallas probe compiled but failed the "
+                             "accuracy check; using the XLA "
+                             "preconditioner path")
         except Exception as exc:
             if _is_transient(exc):
                 # runtime/transport hiccup: leave the verdict None so a
@@ -363,19 +364,19 @@ def pallas_chol_available():
                     _PROBE_REASON = (
                         f"{_PROBE_TRANSIENTS} consecutive transient "
                         f"probe failures (cap) — last: {exc!r}")[:300]
-                    print("# cholfuse: Pallas probe transient-failure "
-                          "cap reached; pinning the XLA preconditioner "
-                          "path for this process", file=sys.stderr)
+                    _log.warning("Pallas probe transient-failure cap "
+                                 "reached; pinning the XLA "
+                                 "preconditioner path for this process")
                     _PROBE_RESULT = False
                     return False
-                print(f"# cholfuse: Pallas probe hit a transient error "
-                      f"({exc!r}); using the XLA preconditioner path "
-                      "for this trace, will re-probe", file=sys.stderr)
+                _log.warning("Pallas probe hit a transient error "
+                             "(%r); using the XLA preconditioner path "
+                             "for this trace, will re-probe", exc)
                 return False
             # Mosaic/compile/lowering failure -> XLA path, pinned
             _PROBE_REASON = f"compile/lowering failure: {exc!r}"[:300]
-            print(f"# cholfuse: Pallas probe failed ({exc!r}); "
-                  "using the XLA preconditioner path", file=sys.stderr)
+            _log.warning("Pallas probe failed (%r); using the XLA "
+                         "preconditioner path", exc)
             _PROBE_RESULT = False
     return _PROBE_RESULT
 

@@ -127,8 +127,12 @@ class PTALikelihood(PriorMixin):
                             for p in _noise_slide_pairs(
                                 psr, self.param_names)]
         from ..samplers.evalproto import install_protocol
+        # telemetry name "pta_joint": the joint kernel's retraces are
+        # the expensive ones (multi-minute XLA compiles at npsr=45), so
+        # they must be attributable in the compile event stream
         install_protocol(self, loglike_fn,
-                         consts if consts is not None else {})
+                         consts if consts is not None else {},
+                         name="pta_joint")
         self._fn = lambda theta: loglike_fn(theta, self.consts)
 
 
@@ -837,7 +841,8 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
             and _os.environ.get("EWT_UPDATE_MASK", "1") != "0"):
         from ..samplers.evalproto import install_masked_protocol
         install_masked_protocol(like, _cache_init, _cache_site,
-                                _cache_common, param_blocks)
+                                _cache_common, param_blocks,
+                                name="pta_joint")
     # introspection hook for tools/ (stage profiling, corner debugging)
     like._stages = dict(common=_common, coupling=_coupling_blocks,
                         stage12_single=_stage12_single, stage3=_stage3,

@@ -54,6 +54,8 @@ class BilbyWarpResult(EnterpriseWarpResult):
         r = getattr(self, "last_result", None)
         if r is None:
             return None
-        print(f"   {psr_dir}: log_evidence = "
-              f"{r['log_evidence']:.3f} +- {r['log_evidence_err']:.3f}")
+        from ..utils.logging import get_logger
+        get_logger("ewt.results").info(
+            "%s: log_evidence = %.3f +- %.3f", psr_dir,
+            r["log_evidence"], r["log_evidence_err"])
         return r["log_evidence"]

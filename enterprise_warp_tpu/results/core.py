@@ -15,11 +15,14 @@ NameError bugs (reference ``results.py:213,992-998``) do not exist here.
 
 from __future__ import annotations
 
-import json
 import os
 import re
 
 import numpy as np
+
+from ..utils.logging import get_logger
+
+_log = get_logger("ewt.results")
 
 _PSR_DIR_RE = re.compile(r"^\d+_[JB]\d{2,}")
 _N_DIAG_COLS = 4           # lnpost, lnlike, acceptance, PT-swap rate
@@ -161,10 +164,10 @@ def make_noise_files(psrname, chain, pars, outdir, method="mode"):
     est = {p: estimate_from_distribution(chain[:, i], method=method)
            for i, p in enumerate(pars)}
     os.makedirs(outdir, exist_ok=True)
-    path = os.path.join(outdir, f"{psrname}_noise.json")
-    with open(path, "w") as fh:
-        json.dump(est, fh, sort_keys=True, indent=2)
-    return path
+    from ..io.writers import atomic_write_json
+    return atomic_write_json(os.path.join(outdir,
+                                          f"{psrname}_noise.json"),
+                             est, sort_keys=True, indent=2)
 
 
 class EnterpriseWarpResult:
@@ -260,13 +263,13 @@ class EnterpriseWarpResult:
             if self.opts.name != "all" and self.opts.name not in psr_dir:
                 continue
             if self.opts.info:
-                print(f"== {psr_dir or self.outdir_all} ==")
+                _log.info("== %s ==", psr_dir or self.outdir_all)
             if self.opts.separate_earliest:
                 self._separate_earliest(psr_dir)
             loaded = self.load_chains(psr_dir)
             if loaded is None:
                 if self.opts.info:
-                    print("   (no chain found)")
+                    _log.info("(no chain found)")
                     # nested runs publish a Bilby-schema result JSON
                     # instead of PTMCMC chain files (same contract
                     # split as the reference's --bilby flag at
@@ -274,21 +277,21 @@ class EnterpriseWarpResult:
                     import glob as _glob
                     d = os.path.join(self.outdir_all, psr_dir)
                     if _glob.glob(os.path.join(d, "*_result.json")):
-                        print("   found a *_result.json here — "
-                              "rerun with --bilby 1 to load nested-"
-                              "sampling output")
+                        _log.info("found a *_result.json here — "
+                                  "rerun with --bilby 1 to load "
+                                  "nested-sampling output")
                 continue
             chain, diag, pars = loaded
             if self.opts.info:
-                print(f"   {len(chain)} post-burn samples, "
-                      f"{len(pars)} parameters")
+                _log.info("%d post-burn samples, %d parameters",
+                          len(chain), len(pars))
             psrname = psr_dir.split("_", 1)[1] if "_" in psr_dir \
                 else (psr_dir or self._psrname_from_pars(pars))
             if self.opts.noisefiles:
                 path = make_noise_files(
                     psrname, chain, pars,
                     os.path.join(self.outdir_all, "noisefiles"))
-                print(f"   noise file: {path}")
+                _log.info("noise file: %s", path)
             if self.opts.credlevels:
                 self._make_credlevels(psrname, chain, pars)
             if self.opts.logbf:
@@ -342,28 +345,37 @@ class EnterpriseWarpResult:
         nch = self._infer_nchains(psr_dir)
         nsteps = len(chain) // max(nch, 1)
         if nsteps < 4:
-            print("   (chain too short for diagnostics)")
+            _log.info("(chain too short for diagnostics)")
             return
         c = chain[:nsteps * nch].reshape(nsteps, nch, len(pars))
         c = np.transpose(c, (1, 0, 2))
         summ = summarize_chains(c, pars)
         worst = summ["_worst"]
-        worst_par = max(pars, key=lambda p: summ[p]["rhat"])
-        print(f"   diagnostics ({nch} chains x {nsteps} post-burn "
-              f"steps): worst R-hat={worst['rhat']:.4f} at {worst_par} "
-              f"(its ESS={summ[worst_par]['ess']:.0f}; "
-              f"min ESS={worst['ess']:.0f})")
+
+        def _f(v, spec="{:.4f}"):
+            # summarize_chains clamps un-computable estimates to None
+            # (its JSON contract); render those as n/a
+            return "n/a" if v is None else spec.format(v)
+
+        worst_par = max(pars, key=lambda p: (
+            summ[p]["rhat"] if summ[p]["rhat"] is not None
+            else float("inf")))
+        _log.info("diagnostics (%d chains x %d post-burn steps): "
+                  "worst R-hat=%s at %s (its ESS=%s; min ESS=%s)",
+                  nch, nsteps, _f(worst["rhat"]), worst_par,
+                  _f(summ[worst_par]["ess"], "{:.0f}"),
+                  _f(worst["ess"], "{:.0f}"))
         for p in pars:
             s = summ[p]
-            print(f"     {p:40s} rhat={s['rhat']:.4f} "
-                  f"ess={s['ess']:8.0f}")
+            _log.info("  %-40s rhat=%s ess=%s", p, _f(s["rhat"]),
+                      _f(s["ess"], "{:8.0f}"))
         outdir = os.path.join(self.outdir_all, "diagnostics")
         os.makedirs(outdir, exist_ok=True)
         name = psr_dir or "run"
-        path = os.path.join(outdir, f"{name}_diagnostics.json")
-        with open(path, "w") as fh:
-            json.dump(summ, fh, indent=1, default=float)
-        print(f"   diagnostics json: {path}")
+        from ..io.writers import atomic_write_json
+        path = atomic_write_json(
+            os.path.join(outdir, f"{name}_diagnostics.json"), summ)
+        _log.info("diagnostics json: %s", path)
 
     # ------------------------ products -------------------------------- #
     def _make_credlevels(self, psrname, chain, pars):
@@ -378,16 +390,18 @@ class EnterpriseWarpResult:
             rows[p] = lv
         outdir = os.path.join(self.outdir_all, "credlevels")
         os.makedirs(outdir, exist_ok=True)
-        path = os.path.join(outdir, f"{psrname}_credlvl.json")
-        with open(path, "w") as fh:
-            json.dump(rows, fh, sort_keys=True, indent=2)
-        print(f"   credible levels: {path}")
+        from ..io.writers import atomic_write_json
+        path = atomic_write_json(
+            os.path.join(outdir, f"{psrname}_credlvl.json"), rows,
+            sort_keys=True, indent=2)
+        _log.info("credible levels: %s", path)
 
     def _print_logbf(self, psr_dir, chain, pars):
         """Product-space Bayes factors from the nmodel histogram
         (reference ``results.py:482-491,585-596``)."""
         if "nmodel" not in pars:
-            print(f"   {psr_dir}: no nmodel column (single-model run)")
+            _log.info("%s: no nmodel column (single-model run)",
+                      psr_dir)
             return None
         idx = pars.index("nmodel")
         nmodel = np.rint(chain[:, idx]).astype(int)
@@ -395,8 +409,8 @@ class EnterpriseWarpResult:
         if len(ids) == 1:
             # np.unique only reports visited models: a missing competitor
             # means the sampler never hopped there
-            print(f"   logBF: only model {ids[0]} was ever visited "
-                  "(increase nsamp)")
+            _log.info("logBF: only model %s was ever visited "
+                      "(increase nsamp)", ids[0])
             return dict(zip(ids.tolist(), counts.tolist()))
         for i in ids:
             for j in ids:
@@ -405,8 +419,8 @@ class EnterpriseWarpResult:
                 ci = counts[ids == i][0]
                 cj = counts[ids == j][0]
                 logbf = np.log(cj / ci)
-                print(f"   logBF[{j}/{i}] = {logbf:.3f} "
-                      f"(visits {cj}:{ci})")
+                _log.info("logBF[%s/%s] = %.3f (visits %s:%s)",
+                          j, i, logbf, cj, ci)
         return dict(zip(ids.tolist(), counts.tolist()))
 
     def _select_pars(self, pars):
@@ -461,7 +475,7 @@ class EnterpriseWarpResult:
         path = os.path.join(self.outdir_all, psr_dir, "corner.png")
         fig.savefig(path, dpi=120)
         plt.close(fig)
-        print(f"   corner plot: {path}")
+        _log.info("corner plot: %s", path)
         if self.opts.corner == 2:
             tab = os.path.join(self.outdir_all, psr_dir,
                                "posterior_table.txt")
@@ -499,7 +513,7 @@ class EnterpriseWarpResult:
         path = os.path.join(self.outdir_all, psr_dir, "chains.png")
         fig.savefig(path, dpi=120)
         plt.close(fig)
-        print(f"   trace plot: {path}")
+        _log.info("trace plot: %s", path)
 
     # ------------------------ chain surgery --------------------------- #
     def _separate_earliest(self, psr_dir):
@@ -520,7 +534,7 @@ class EnterpriseWarpResult:
                               f"{stamp}_chain_1.txt")
         np.savetxt(backup, chain[:ncut])
         np.savetxt(chain_file, chain[ncut:])
-        print(f"   separated {ncut} earliest samples -> {backup}")
+        _log.info("separated %d earliest samples -> %s", ncut, backup)
 
     # ------------------------ covariance collection ------------------- #
     def _collect_covm(self, psr_dir, pars):
@@ -548,4 +562,5 @@ class EnterpriseWarpResult:
         pkl = os.path.join(self.outdir_all, "covm_all.pkl")
         df.to_csv(csv)
         df.to_pickle(pkl)
-        print(f"block-diagonal covariance: {csv} ({n} parameters)")
+        _log.info("block-diagonal covariance: %s (%d parameters)",
+                  csv, n)

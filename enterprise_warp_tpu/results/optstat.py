@@ -27,6 +27,10 @@ import pickle
 
 import numpy as np
 
+from ..utils.logging import get_logger
+
+_log = get_logger("ewt.results")
+
 import jax
 import jax.numpy as jnp
 
@@ -242,9 +246,9 @@ class OptimalStatisticWarp(EnterpriseWarpResult):
                 orf, xi, rho, sig, a2, a2e, snr,
                 marginalized=(np.asarray(a2_d), np.asarray(snr_d)))
             self.os_results[orf] = res
-            print(f"OS[{orf}]: A^2 = {a2:.3e} +- {a2e:.3e}  "
-                  f"S/N = {snr:.2f}  (marginalized mean S/N = "
-                  f"{np.mean(snr_d):.2f} over {nmarg} draws)")
+            _log.info("OS[%s]: A^2 = %.3e +- %.3e  S/N = %.2f  "
+                      "(marginalized mean S/N = %.2f over %d draws)",
+                      orf, a2, a2e, snr, np.mean(snr_d), nmarg)
 
         self.dump_results()
         self.plot_os_orf()
@@ -260,7 +264,7 @@ class OptimalStatisticWarp(EnterpriseWarpResult):
                    for orf, r in self.os_results.items()}
         with open(path, "wb") as fh:
             pickle.dump(payload, fh)
-        print(f"optimal statistic results: {path}")
+        _log.info("optimal statistic results: %s", path)
 
     def plot_os_orf(self):
         import matplotlib
@@ -290,7 +294,7 @@ class OptimalStatisticWarp(EnterpriseWarpResult):
         path = os.path.join(self.outdir_all, "os_orf.png")
         fig.savefig(path, dpi=130)
         plt.close(fig)
-        print(f"ORF overlay plot: {path}")
+        _log.info("ORF overlay plot: %s", path)
 
     def plot_noisemarg_os(self):
         import matplotlib
@@ -309,4 +313,4 @@ class OptimalStatisticWarp(EnterpriseWarpResult):
         path = os.path.join(self.outdir_all, "os_noisemarg.png")
         fig.savefig(path, dpi=130)
         plt.close(fig)
-        print(f"noise-marginalized OS plot: {path}")
+        _log.info("noise-marginalized OS plot: %s", path)

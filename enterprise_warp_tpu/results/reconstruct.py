@@ -178,8 +178,10 @@ def get_tempo2_prediction(parfile, timfile, noise_dict, output=None,
     unused = [k for k in noise_dict
               if k not in rec.param_names and psr.name in k]
     if unused:
-        print(f"warning: noisefile entries outside the reconstruction "
-              f"model (efac/equad/red/DM) are ignored: {unused}")
+        from ..utils.logging import get_logger
+        get_logger("ewt.results").warning(
+            "noisefile entries outside the reconstruction model "
+            "(efac/equad/red/DM) are ignored: %s", unused)
     defaults.update(noise_dict)
     real = rec.realizations(rec.theta_from_dict(defaults))
 

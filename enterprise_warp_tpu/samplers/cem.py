@@ -43,6 +43,11 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..utils import telemetry
+from ..utils.logging import get_logger
+
+_log = get_logger("ewt.cem")
+
 
 def _lnq_gauss(x, mean, L):
     """Normalized log-density of N(mean, L L^T) at rows of x."""
@@ -161,7 +166,11 @@ def fit_cem(like, rounds=None, batch=256, inflate=1.5, seed=0,
             mean = (1 - smooth) * mean + smooth * new_mean
             cov = (1 - smooth) * cov + smooth * new_cov
         if verbose:
-            print(f"  cem search {r}: best={best:.2f}", flush=True)
+            _log.info("cem search %d: best=%.2f", r, best)
+        _rec = telemetry.active_recorder()
+        if _rec is not None:
+            _rec.heartbeat(phase="cem_search", round=r,
+                           best_lnpost=round(best, 2))
         L, cov = _chol(cov, nd)
         x = mean + rng.standard_normal((batch, nd)) @ L.T
         lnq = _lnq_gauss(x, mean, L)
@@ -220,8 +229,13 @@ def fit_cem(like, rounds=None, batch=256, inflate=1.5, seed=0,
         # already an average over rounds
         mean, cov = new_mean, new_cov
         if verbose:
-            print(f"  cem refine {r}: best={best:.2f} "
-                  f"is_ess={ess_is:.0f}", flush=True)
+            _log.info("cem refine %d: best=%.2f is_ess=%.0f",
+                      r, best, ess_is)
+        _rec = telemetry.active_recorder()
+        if _rec is not None:
+            _rec.heartbeat(phase="cem_refine", round=r,
+                           best_lnpost=round(best, 2),
+                           is_ess=round(ess_is, 1))
         if (prev_mean is not None
                 and ess_is >= ess_target_factor * (nd + 2)
                 and np.all(np.abs(mean - prev_mean)

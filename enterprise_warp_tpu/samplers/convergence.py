@@ -16,7 +16,11 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..utils import telemetry
 from ..utils.diagnostics import summarize_chains
+from ..utils.logging import get_logger
+
+_log = get_logger("ewt.convergence")
 
 
 @dataclass
@@ -153,9 +157,9 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
                     # nsteps keeps the chain file contract (rows ==
                     # steps*nchains) at the cost of re-counting the
                     # lost steps.
-                    print(f"  resume: chain file holds {nsteps} complete "
-                          f"steps < checkpoint step {ckpt_step}; "
-                          "rewinding checkpoint counter", flush=True)
+                    _log.info("resume: chain file holds %d complete "
+                              "steps < checkpoint step %d; rewinding "
+                              "checkpoint counter", nsteps, ckpt_step)
                     z = dict(np.load(sampler._ckpt_path))
                     z["step"] = nsteps
                     tmp = sampler._ckpt_path + ".tmp.npz"
@@ -194,7 +198,7 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
                                         sampler.ndim).astype(np.float32))
                 steps = nsteps
                 if verbose:
-                    print(f"  resuming at step {steps}", flush=True)
+                    _log.info("resuming at step %d", steps)
     def _diag(chains):
         # R-hat is thinning-invariant; the Geyer ESS of the thinned
         # chain is only a LOWER bound on total ESS while the stride is
@@ -205,48 +209,69 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
         return summarize_chains(chains[:, ::stride],
                                 sampler.like.param_names)
 
+    def _worst_floats(s):
+        """``_worst`` with the None clamp (summarize_chains' JSON
+        contract) undone for numeric gating: an un-computable R-hat is
+        +inf (never converged) and an un-computable ESS is 0."""
+        rh, es = s["_worst"]["rhat"], s["_worst"]["ess"]
+        return (np.inf if rh is None else rh,
+                0.0 if es is None else es)
+
     t_start = time.perf_counter()
     t_after_first = None
     report = None
-    while steps < max_steps:
-        todo = max(check_every,
-                   int(steps * (check_growth - 1.0)))
-        # round to a block_size multiple: a remainder-sized final chunk
-        # would force a fresh jit trace of the scan block at nearly
-        # every geometric check
-        todo = -(-todo // block_size) * block_size
-        sampler.sample(min(steps + todo, max_steps), resume=steps > 0,
-                       verbose=False, block_size=block_size,
-                       collect=blocks)
-        if t_after_first is None:
-            t_after_first = time.perf_counter()
-        steps = min(steps + todo, max_steps)
-        chains = _chains_from_blocks(blocks, burn_frac)
-        s = _diag(chains)
-        worst = s["_worst"]
-        if verbose:
-            print(f"  step {steps}: rhat_max={worst['rhat']:.4f} "
-                  f"ess_min={worst['ess']:.0f}", flush=True)
-        if on_check is not None:
-            # lets drivers persist attempt progress (steps, wall so far,
-            # steady wall so far) so a killed run loses nothing
-            on_check(steps, time.perf_counter() - t_start,
-                     time.perf_counter() - t_after_first)
-        if worst["rhat"] <= rhat_max and worst["ess"] >= target_ess:
+    # the run-level scope: the inner sampler.sample() calls join this
+    # event stream (block heartbeats), and each convergence check adds
+    # a heartbeat carrying the gate diagnostics it already computed
+    with telemetry.run_scope(
+            sampler.outdir, sampler="convergence",
+            target_ess=float(target_ess), rhat_max=float(rhat_max),
+            max_steps=int(max_steps)) as rec:
+        while steps < max_steps:
+            todo = max(check_every,
+                       int(steps * (check_growth - 1.0)))
+            # round to a block_size multiple: a remainder-sized final
+            # chunk would force a fresh jit trace of the scan block at
+            # nearly every geometric check
+            todo = -(-todo // block_size) * block_size
+            sampler.sample(min(steps + todo, max_steps),
+                           resume=steps > 0, verbose=False,
+                           block_size=block_size, collect=blocks)
+            if t_after_first is None:
+                t_after_first = time.perf_counter()
+            steps = min(steps + todo, max_steps)
+            chains = _chains_from_blocks(blocks, burn_frac)
+            s = _diag(chains)
+            rh, es = _worst_floats(s)
+            rec.heartbeat(phase="convergence_check", step=int(steps),
+                          rhat=s["_worst"]["rhat"],
+                          ess=s["_worst"]["ess"],
+                          wall_s=round(time.perf_counter() - t_start, 2))
+            if verbose:
+                _log.info("step %d: rhat_max=%.4f ess_min=%.0f",
+                          steps, rh, es)
+            if on_check is not None:
+                # lets drivers persist attempt progress (steps, wall so
+                # far, steady wall so far) so a killed run loses nothing
+                on_check(steps, time.perf_counter() - t_start,
+                         time.perf_counter() - t_after_first)
+            if rh <= rhat_max and es >= target_ess:
+                report = ConvergenceReport(
+                    converged=True, steps=steps,
+                    wall_s=time.perf_counter() - t_start,
+                    steady_wall_s=time.perf_counter() - t_after_first,
+                    rhat_max=rh, ess_min=es,
+                    summary=s, chains=chains)
+                break
+        if report is None:
+            chains = _chains_from_blocks(blocks, burn_frac)
+            s = _diag(chains)
+            rh, es = _worst_floats(s)
             report = ConvergenceReport(
-                converged=True, steps=steps,
+                converged=False, steps=steps,
                 wall_s=time.perf_counter() - t_start,
-                steady_wall_s=time.perf_counter() - t_after_first,
-                rhat_max=worst["rhat"], ess_min=worst["ess"],
+                steady_wall_s=time.perf_counter()
+                - (t_after_first or t_start),
+                rhat_max=rh, ess_min=es,
                 summary=s, chains=chains)
-            break
-    if report is None:
-        chains = _chains_from_blocks(blocks, burn_frac)
-        s = _diag(chains)
-        report = ConvergenceReport(
-            converged=False, steps=steps,
-            wall_s=time.perf_counter() - t_start,
-            steady_wall_s=time.perf_counter() - (t_after_first or t_start),
-            rhat_max=s["_worst"]["rhat"], ess_min=s["_worst"]["ess"],
-            summary=s, chains=chains)
     return report

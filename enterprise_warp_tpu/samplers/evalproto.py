@@ -67,21 +67,32 @@ def eval_protocol(like):
             ())
 
 
-def install_protocol(like, eval_fn, consts, public=True):
+def install_protocol(like, eval_fn, consts, public=True, name=None):
     """Install the protocol attributes on ``like`` from a pure
     ``eval_fn(theta, consts)``: sets ``consts``/``_eval``/``_eval_batch``
     and, with ``public`` (default), protocol-built ``loglike``/
     ``loglike_batch`` whose jits take the arrays as arguments. The one
     place the contract's plumbing lives — every likelihood class calls
-    this instead of repeating it."""
+    this instead of repeating it.
+
+    ``name`` labels this likelihood's jits in the telemetry registry
+    (``retraces{fn=<name>.eval_batch}``) and the compile event stream —
+    every jit here goes through :func:`utils.telemetry.traced`, so a
+    silent retrace (new walker-batch shape, new consts structure)
+    becomes a counted, timestamped event instead of an unexplained
+    multi-second stall."""
     import jax
 
+    from ..utils.telemetry import traced
+
+    label = name or type(like).__name__
     like.consts = consts
     like._eval = eval_fn
     like._eval_batch = jax.vmap(eval_fn, in_axes=(0, None))
     if public:
-        jit_single = jax.jit(eval_fn)
-        jit_batch = jax.jit(like._eval_batch)
+        jit_single = traced(eval_fn, name=f"{label}.eval")
+        jit_batch = traced(like._eval_batch,
+                           name=f"{label}.eval_batch")
         like.loglike = lambda theta: jit_single(theta, like.consts)
         like.loglike_batch = lambda thetas: jit_batch(thetas,
                                                       like.consts)
@@ -89,19 +100,21 @@ def install_protocol(like, eval_fn, consts, public=True):
 
 
 def install_masked_protocol(like, init_fn, site_fn, common_fn,
-                            param_blocks):
+                            param_blocks, name=None):
     """Install the update_mask contract (see module docstring) from pure
     cache-building functions: ``init_fn(theta, consts)``,
     ``site_fn(theta, psr_idx, cache, consts)``,
     ``common_fn(theta, cache, consts)`` — each returning
     ``(lnl, cache)``. ``psr_idx`` is a traced integer so one jit serves
-    every pulsar block."""
-    import jax
+    every pulsar block. ``name`` labels the three jits for the
+    compile/retrace telemetry (see :func:`install_protocol`)."""
+    from ..utils.telemetry import traced
 
+    label = name or type(like).__name__
     like.param_blocks = np.asarray(param_blocks, dtype=np.int64)
-    like._cache_init = jax.jit(init_fn)
-    like._cache_site = jax.jit(site_fn)
-    like._cache_common = jax.jit(common_fn)
+    like._cache_init = traced(init_fn, name=f"{label}.cache_init")
+    like._cache_site = traced(site_fn, name=f"{label}.cache_site")
+    like._cache_common = traced(common_fn, name=f"{label}.cache_common")
     return like
 
 
@@ -156,10 +169,18 @@ class CachedEvaluator:
                 "likelihood does not implement the update_mask contract "
                 "(no masked protocol installed — see "
                 "samplers/evalproto.py)")
+        from ..utils.telemetry import registry
+
         self.like = like
         self.param_blocks = np.asarray(like.param_blocks)
         self.counters = {"site": 0, "common": 0, "full": 0,
                          "rejected": 0}
+        # registry counters resolved ONCE: update() is the host-driven
+        # hot path (one call per proposal), so the per-eval telemetry
+        # cost must be a bare attribute increment, not a registry lookup
+        self._reg_evals = {
+            cls: registry().counter("likelihood_evals", mask_class=cls)
+            for cls in ("site", "common", "full")}
         self.theta = None
         self._cache = None
         self.lnl = None
@@ -226,6 +247,7 @@ class CachedEvaluator:
                                              self.theta, theta)
         if update_mask is None:
             self.counters["full"] += 1
+            self._reg_evals["full"].inc()
             return self.reset(theta)
         self._validate(theta, update_mask)
         th_j = jnp.asarray(theta)
@@ -235,10 +257,12 @@ class CachedEvaluator:
                 th_j, jnp.asarray(int(update_mask[1])), self._cache,
                 self.like.consts)
             self.counters["site"] += 1
+            self._reg_evals["site"].inc()
         else:
             lnl, self._cache = self.like._cache_common(
                 th_j, self._cache, self.like.consts)
             self.counters["common"] += 1
+            self._reg_evals["common"].inc()
         self.theta = theta
         self.lnl = float(lnl)
         return self.lnl

@@ -42,6 +42,10 @@ import numpy as np
 from ..native import write_table
 from .transform import make_logp_z
 from ..parallel.distributed import is_primary as _is_primary
+from ..utils import telemetry
+from ..utils.logging import EvalRateMeter, get_logger
+
+_log = get_logger("ewt.hmc")
 
 
 @dataclass
@@ -272,7 +276,6 @@ class HMCSampler:
             return (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar,
                     mass, acc, ndiv, mu, ngrad, consts), (z, lnl, p_acc)
 
-        @jax.jit
         def block(z, key, log_eps, log_eps_bar, h_bar, mass, acc, ndiv,
                   iter0, mu, ngrad, consts):
             (lp, lnl), g = vgrad(z, consts)
@@ -286,16 +289,41 @@ class HMCSampler:
             return (z, key, log_eps, log_eps_bar, h_bar, acc, ndiv, zs,
                     lnls, jnp.mean(p_accs), ngrad)
 
-        return block
+        # traced jit: each (block size, adapt) pair is a separate trace;
+        # the telemetry makes that retrace pattern visible per run
+        return telemetry.traced(
+            block, name=f"hmc_block_{'adapt' if adapt else 'sample'}")
 
     # ---------------- public API --------------------------------------- #
     def sample(self, nsamp, resume=True, verbose=True, block_size=100,
                collect=None):
+        """Telemetry mirrors :meth:`PTSampler.sample`: ``run_scope`` on
+        the output directory, one ``heartbeat`` per block (step, eps,
+        acceptance, divergences, gradient-evals/s, worst R-hat/ESS) at
+        the existing host-sync point, ``checkpoint`` per state save."""
+        with telemetry.run_scope(
+                self.outdir, sampler="hmc", ndim=self.ndim,
+                nchains=self.W, nsamp=int(nsamp), warmup=self.warmup,
+                param_names=list(self.like.param_names)) as rec:
+            return self._sample_impl(nsamp, resume, verbose, block_size,
+                                     collect, rec)
+
+    def _block_diag(self, thetas_block, diag_t):
+        """Worst R-hat/ESS of one block's emissions (throttled — see
+        :func:`utils.diagnostics.throttled_block_worst`)."""
+        from ..utils.diagnostics import throttled_block_worst
+        return throttled_block_worst(thetas_block,
+                                     self.like.param_names, diag_t)
+
+    def _sample_impl(self, nsamp, resume, verbose, block_size, collect,
+                     rec):
+        meter = EvalRateMeter()
+        diag_t = [0.0]
         chain_path0 = os.path.join(self.outdir, "chain_1.txt")
         if resume and os.path.exists(self._ckpt_path):
             st = self._load_state()
             if verbose:
-                print(f"resuming from step {st.step}")
+                _log.info("resuming from step %d", st.step)
             # a kill between the chain append and the (atomic) state
             # save leaves rows past the checkpoint that the resumed run
             # will regenerate — truncate the file to the checkpointed
@@ -326,6 +354,7 @@ class HMCSampler:
 
         while st.step < nsamp:
             todo = int(min(block_size, nsamp - st.step))
+            ngrad_before = st.ngrad
             # never straddle the warmup or mass boundaries in one block
             for edge in (mass_at, self.warmup):
                 if st.step < edge:
@@ -391,9 +420,33 @@ class HMCSampler:
                 collect.append(thetas.reshape(todo, self.W, self.ndim)
                                .astype(np.float32))
             self._save_state(st)
+            rec.checkpoint(step=int(st.step))
+
+            # --- heartbeat (block just synced to host) ---------------- #
+            # gated on rec.enabled so EWT_TELEMETRY=0 pays zero
+            # diagnostics cost; likelihood evals this block: one
+            # value+grad per leapfrog step per chain (ngrad counts
+            # per-chain gradient evals)
+            if rec.enabled:
+                meter.add(self.W * (st.ngrad - ngrad_before))
+                hb = dict(step=int(st.step), nsamp=int(nsamp),
+                          accept=round(mean_acc, 4),
+                          eps=round(float(np.exp(st.log_eps)), 6),
+                          divergences=int(st.divergences),
+                          evals_per_s=round(meter.window_rate(), 1),
+                          evals_total=int(meter.total),
+                          cache_hit_rate=0.0,
+                          warmup=bool(adapt))
+                worst = self._block_diag(
+                    thetas.reshape(todo, self.W, self.ndim), diag_t)
+                if worst is not None:
+                    hb["rhat"] = worst["rhat"]
+                    hb["ess"] = worst["ess"]
+                rec.heartbeat(**hb)
             if verbose:
-                print(f"step {st.step}/{nsamp} eps={np.exp(st.log_eps):.4f}"
-                      f" acc={mean_acc:.3f} div={st.divergences}")
+                _log.info("step %d/%d eps=%.4f acc=%.3f div=%d",
+                          st.step, nsamp, np.exp(st.log_eps), mean_acc,
+                          st.divergences)
         return st
 
     @property

@@ -96,5 +96,5 @@ class HyperModelLikelihood(PriorMixin):
             return jax.lax.switch(k, ebranches, theta[:-1])
 
         from .evalproto import install_protocol
-        install_protocol(self, _eval, self.consts)
+        install_protocol(self, _eval, self.consts, name="hypermodel")
 

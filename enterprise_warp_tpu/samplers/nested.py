@@ -19,12 +19,17 @@ staging protocol is needed.
 
 from __future__ import annotations
 
-import json
 import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..io.writers import atomic_write_json
+from ..utils import telemetry
+from ..utils.logging import EvalRateMeter, get_logger
+
+_log = get_logger("ewt.nested")
 
 
 def slide_effective(like, slide_moves=None):
@@ -102,7 +107,6 @@ def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
         [jnp.zeros(1), jnp.cumsum(_dlnx_per)[:-1]])
     _dlnx_batch = jnp.sum(_dlnx_per)
 
-    @jax.jit
     def iteration(u, lnl, key, scale, lnz, ln_x, consts):
         order = jnp.argsort(lnl)
         u = u[order]
@@ -198,7 +202,9 @@ def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
         return (u, lnl, key, dead_u, dead_lnl, nacc / nsteps,
                 lnz, ln_x, delta)
 
-    return iteration
+    # traced jit: one trace per (nlive, kbatch, nsteps) geometry — a
+    # retrace mid-run means the configuration changed under the sampler
+    return telemetry.traced(iteration, name="nested_iteration")
 
 
 def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
@@ -259,9 +265,10 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
                     params_fp=_params_fingerprint(like))
         for k, v in want.items():
             if k not in z.files or str(z[k]) != str(v):
-                print(f"NS checkpoint incompatible ({k}: "
-                      f"{z[k] if k in z.files else 'missing'} != {v}); "
-                      "starting fresh")
+                _log.warning(
+                    "NS checkpoint incompatible (%s: %s != %s); "
+                    "starting fresh", k,
+                    z[k] if k in z.files else "missing", v)
                 return False
         return True
 
@@ -280,7 +287,7 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
         dead_lnx = [z["dead_lnx"]] if len(z["dead_lnx"]) else []
         dead_dlnx = [z["dead_dlnx"]] if len(z["dead_dlnx"]) else []
         if verbose:
-            print(f"NS resuming from iteration {it}")
+            _log.info("NS resuming from iteration %d", it)
     else:
         rng_key = jax.random.PRNGKey(seed)
         rng_key, k0 = jax.random.split(rng_key)
@@ -325,36 +332,56 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
         os.replace(tmp, ckpt_path)
 
     converged = False
-    while it < max_iter:
-        u, lnl, rng_key, du, dl, acc, lnz_d, lnx_d, delta_d = iteration(
-            u, lnl, rng_key, jnp.float64(scale),
-            jnp.float64(lnz), jnp.float64(ln_x), _consts)
-        dead_u.append(np.asarray(du))
-        dead_lnl.append(np.asarray(dl))
-        dead_lnx.append(ln_x - lnx_offsets)
-        dead_dlnx.append(dlnx_per)
-        lnz = float(lnz_d)
-        ln_x = float(lnx_d)
-        delta = float(delta_d)
-        it += 1
+    with telemetry.run_scope(outdir, sampler="nested", label=label,
+                             nlive=int(nlive), kbatch=int(kbatch),
+                             nsteps=int(nsteps), ndim=int(nd),
+                             dlogz=float(dlogz),
+                             param_names=list(like.param_names)) as rec:
+        meter = EvalRateMeter()
+        while it < max_iter:
+            u, lnl, rng_key, du, dl, acc, lnz_d, lnx_d, delta_d = \
+                iteration(u, lnl, rng_key, jnp.float64(scale),
+                          jnp.float64(lnz), jnp.float64(ln_x), _consts)
+            dead_u.append(np.asarray(du))
+            dead_lnl.append(np.asarray(dl))
+            dead_lnx.append(ln_x - lnx_offsets)
+            dead_dlnx.append(dlnx_per)
+            lnz = float(lnz_d)
+            ln_x = float(lnx_d)
+            delta = float(delta_d)
+            it += 1
+            meter.add(kbatch * nsteps)
 
-        # adapt the walk scale toward ~40% acceptance
-        a = float(acc)
-        if a < 0.15:
-            scale *= 0.7
-        elif a > 0.6:
-            scale *= 1.3
-        scale = min(max(scale, 1e-3), 2.0)
+            # adapt the walk scale toward ~40% acceptance
+            a = float(acc)
+            if a < 0.15:
+                scale *= 0.7
+            elif a > 0.6:
+                scale *= 1.3
+            scale = min(max(scale, 1e-3), 2.0)
 
-        # termination: remaining prior mass can't move lnZ by > dlogz
-        if verbose and it % 20 == 0:
-            print(f"NS it={it} lnZ={lnz:.3f} dlogz={delta:.4f} "
-                  f"acc={a:.2f} scale={scale:.3f}")
-        if it % checkpoint_every == 0:
-            _write_ckpt()
-        if delta < dlogz:
-            converged = True
-            break
+            # termination: remaining prior mass can't move lnZ by > dlogz
+            if it % 20 == 0:
+                # heartbeat at the existing host-sync point (the
+                # iteration results just landed as numpy above)
+                rec.heartbeat(iteration=it, lnz=round(lnz, 3),
+                              dlogz=round(delta, 4),
+                              accept=round(a, 3), scale=round(scale, 4),
+                              evals_per_s=round(meter.window_rate(), 1),
+                              evals_total=int(meter.total))
+                if verbose:
+                    _log.info("NS it=%d lnZ=%.3f dlogz=%.4f acc=%.2f "
+                              "scale=%.3f", it, lnz, delta, a, scale)
+            if it % checkpoint_every == 0:
+                _write_ckpt()
+                rec.checkpoint(iteration=it)
+            if delta < dlogz:
+                converged = True
+                break
+        rec.heartbeat(iteration=it, lnz=round(lnz, 3),
+                      converged=bool(converged),
+                      evals_per_s=round(meter.rate(), 1),
+                      evals_total=int(meter.total))
 
     if converged and ckpt_path is not None and is_primary() \
             and os.path.exists(ckpt_path):
@@ -407,8 +434,8 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
     )
     if outdir is not None and is_primary():
         os.makedirs(outdir, exist_ok=True)
-        with open(os.path.join(outdir, f"{label}_result.json"), "w") as fh:
-            json.dump(result, fh)
+        atomic_write_json(os.path.join(outdir, f"{label}_result.json"),
+                          result, indent=None)
         np.savez(os.path.join(outdir, f"{label}_nested.npz"),
                  samples=theta_all, log_weights=logw_norm,
                  log_likelihoods=lnl_all)

@@ -33,14 +33,18 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..io.writers import atomic_write_json
 from ..native import write_table
 from ..parallel.distributed import is_primary as _is_primary
+from ..utils import telemetry
+from ..utils.logging import EvalRateMeter, get_logger
+
+_log = get_logger("ewt.ptmcmc")
 
 _HISTORY = 1000     # DE history ring length (per walker)
 
@@ -623,7 +627,6 @@ class PTSampler:
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
                      lam, cg_rows, kde_pts, kde_bw, temps, consts), ys)
 
-        @partial(jax.jit, static_argnames=())
         def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                   fam_acc, fam_prop, mask_counts,
                   eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
@@ -636,7 +639,10 @@ class PTSampler:
                 one_step, carry, jnp.arange(nsteps))
             return (carry,) + tuple(ys)
 
-        return block
+        # traced jit: a block retrace (new block size, new walker
+        # count) is the dominant stall of a PT run — count it and emit
+        # a compile event instead of stalling silently
+        return telemetry.traced(block, name="ptmcmc_block")
 
     # ---------------- block execution ---------------------------------- #
     def _run_block(self, st, todo, temps=None):
@@ -787,9 +793,9 @@ class PTSampler:
                     st.lnl = st.lnl[idx]
                     st.lnp = st.lnp[idx]
                 if verbose:
-                    print(f"  anneal T={T:g}: acc_ess={ess:.0f}/"
-                          f"{self.W} maxlnl={st.lnl.max():.1f}",
-                          flush=True)
+                    _log.info("anneal T=%g: acc_ess=%.0f/%d "
+                              "maxlnl=%.1f", T, ess, self.W,
+                              st.lnl.max())
         # the measurement starts here: reset counters and step count
         st.accepted = np.zeros(self.W)
         st.swaps_accepted = np.zeros(self.ntemps - 1)
@@ -801,6 +807,21 @@ class PTSampler:
         self._anneal_state = st
         return st
 
+    # ---------------- telemetry ---------------------------------------- #
+    def _block_diag(self, cs, diag_t):
+        """Worst R-hat/ESS of one block's cold emission (throttled —
+        see :func:`utils.diagnostics.throttled_block_worst`)."""
+        from ..utils.diagnostics import throttled_block_worst
+        return throttled_block_worst(cs, self.like.param_names, diag_t)
+
+    def _cache_hit_rate(self):
+        """Cache-hit potential of the proposal mix so far (0.0 when the
+        likelihood declares no parameter blocks)."""
+        if not self.use_maskstats:
+            return 0.0
+        from ..utils.diagnostics import cache_hit_summary
+        return cache_hit_summary(*self.mask_counts)["cache_hit_rate"]
+
     # ---------------- public API --------------------------------------- #
     def sample(self, nsamp, resume=True, verbose=True, thin=1,
                block_size=None, collect=None):
@@ -811,12 +832,32 @@ class PTSampler:
         also appended to it as float32 ``(steps//thin, nchains, ndim)``
         arrays, so
         convergence drivers can compute diagnostics incrementally without
-        re-parsing the text chain file (O(steps^2) for long runs)."""
+        re-parsing the text chain file (O(steps^2) for long runs).
+
+        Telemetry (``utils.telemetry``): the run is wrapped in a
+        ``run_scope`` on the output directory — ``run_start``/``run_end``
+        plus one ``heartbeat`` per block at the existing host-sync point
+        (step, acceptance, temperature ladder, evals/s, cache_hit_rate,
+        worst R-hat/ESS) and a ``checkpoint`` event per state save.
+        Nested inside a convergence driver's scope, the heartbeats join
+        the driver's event stream instead of opening a second one."""
         block_size = block_size or self.cov_update
+        with telemetry.run_scope(
+                self.outdir, sampler="ptmcmc", ndim=self.ndim,
+                ntemps=self.ntemps, nchains=self.nchains,
+                nsamp=int(nsamp),
+                param_names=list(self.like.param_names)) as rec:
+            return self._sample_impl(nsamp, resume, verbose, thin,
+                                     block_size, collect, rec)
+
+    def _sample_impl(self, nsamp, resume, verbose, thin, block_size,
+                     collect, rec):
+        meter = EvalRateMeter()
+        diag_t = [0.0]
         if resume and os.path.exists(self._ckpt_path):
             st = self._load_state()
             if verbose:
-                print(f"resuming from step {st.step}")
+                _log.info("resuming from step %d", st.step)
         else:
             st = self._fresh_state()
             # fresh run: truncate the cold chain and any stale hot-rung
@@ -927,16 +968,33 @@ class PTSampler:
                     # cold-rung proposal mix a block-sparse evaluator
                     # could serve from cache (diagnostics artifact,
                     # refreshed per block like cov.npy)
-                    import json as _json
                     from ..utils.diagnostics import cache_hit_summary
-                    tmp = os.path.join(self.outdir,
-                                       "mask_stats.json.tmp")
-                    with open(tmp, "w") as fh:
-                        _json.dump(cache_hit_summary(*self.mask_counts),
-                                   fh, indent=1)
-                    os.replace(tmp, os.path.join(self.outdir,
-                                                 "mask_stats.json"))
+                    atomic_write_json(
+                        os.path.join(self.outdir, "mask_stats.json"),
+                        cache_hit_summary(*self.mask_counts))
             self._save_state(st)
+            rec.checkpoint(step=int(st.step))
+
+            # --- heartbeat (host-sync point: the block just landed) --- #
+            # everything inside the rec.enabled gate exists only for
+            # the event stream, so EWT_TELEMETRY=0 (or a disabled-on-
+            # write-error recorder) pays zero diagnostics cost
+            if rec.enabled:
+                meter.add(self.W * todo)
+                hb = dict(step=int(st.step), nsamp=int(nsamp),
+                          accept=round(acc_rate, 4),
+                          swap=round(swap_rate, 4),
+                          ladder=[round(float(T), 4)
+                                  for T in st.ladder],
+                          evals_per_s=round(meter.window_rate(), 1),
+                          evals_total=int(meter.total),
+                          cache_hit_rate=self._cache_hit_rate(),
+                          max_lnl=round(float(np.max(st.lnl)), 3))
+                worst = self._block_diag(cs, diag_t)
+                if worst is not None:
+                    hb["rhat"] = worst["rhat"]
+                    hb["ess"] = worst["ess"]
+                rec.heartbeat(**hb)
             if verbose:
                 fam = " ".join(
                     f"{n}={a / max(p, 1.0):.2f}" for n, a, p in zip(
@@ -948,9 +1006,9 @@ class PTSampler:
                     tot = max(self.mask_counts.sum(), 1.0)
                     mask = (" maskable="
                             f"{self.mask_counts[:2].sum() / tot:.2f}")
-                print(f"step {st.step}/{nsamp} acc={acc_rate:.3f} "
-                      f"swap={swap_rate:.3f} [{fam}]{mask} "
-                      f"maxlnl={np.max(st.lnl):.2f}")
+                _log.info("step %d/%d acc=%.3f swap=%.3f [%s]%s "
+                          "maxlnl=%.2f", st.step, nsamp, acc_rate,
+                          swap_rate, fam, mask, np.max(st.lnl))
         return st
 
     def __init_subclass__(cls):
@@ -1002,7 +1060,7 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
             # resume: a loaded checkpoint ignores init_x entirely
             from .vi import fit_advi
             if verbose:
-                print("advi_init: fitting variational warm start")
+                _log.info("advi_init: fitting variational warm start")
             fit = fit_advi(like, steps=int(skw.get("advi_steps", 800)),
                            mc=8, seed=seed)
             opts["init_x"] = fit["samples"]
@@ -1016,7 +1074,7 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
         # mode) from the paramfile: no-op on resume (checkpoint
         # present), counters reset so the measurement starts clean
         if verbose:
-            print("anneal_init: tempered warm start")
+            _log.info("anneal_init: tempered warm start")
         sampler.anneal_init(verbose=verbose)
     sampler.sample(nsamp, resume=resume, verbose=verbose, thin=thin)
     return sampler

@@ -25,6 +25,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import telemetry
+from ..utils.logging import get_logger
+
+_log = get_logger("ewt.vi")
+
 
 def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
     """Fit a mean-field Gaussian in unconstrained space.
@@ -95,12 +100,23 @@ def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
     # keep ELBO values on device during the loop — a per-step float()
     # would force a host sync every iteration and serialize dispatch
     vals = []
+    rec = telemetry.active_recorder()
     for i in range(steps):
         key, k = jax.random.split(key)
         params, opt_state, val = step(params, opt_state, k, _consts)
         vals.append(val)
-        if verbose and (i + 1) % max(steps // 10, 1) == 0:
-            print(f"  advi step {i + 1}/{steps} elbo={float(val):.2f}")
+        if (i + 1) % max(steps // 10, 1) == 0:
+            hb = dict(phase="advi", step=i + 1, steps=steps)
+            if verbose:
+                # float(val) is a host sync — only the verbose path
+                # pays it (matching the old print), so the quiet path
+                # stays sync-free per the telemetry contract
+                hb["elbo"] = round(float(val), 2)
+                _log.info("advi step %d/%d elbo=%.2f", i + 1, steps,
+                          hb["elbo"])
+            if rec is not None:
+                rec.heartbeat(**hb)
+    telemetry.registry().counter("advi_fits").inc()
     trace = np.asarray(jax.device_get(vals))
 
     mu, log_sig = params

@@ -194,7 +194,9 @@ def add_noise(psr: Pulsar, noise_dict: dict, components=30, seed=0,
                 equad[v] = val
     unused = [v for v in vals if v not in efac and v not in equad]
     if unused:
-        print(f"warning: backends with no noise-dict entry: {unused}")
+        from ..utils.logging import get_logger
+        get_logger("ewt.sim").warning(
+            "backends with no noise-dict entry: %s", unused)
 
     if inc_efac and efac:
         inject_white(psr, efac=efac, flag=flag, rng=rng)

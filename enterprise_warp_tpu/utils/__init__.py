@@ -1,16 +1,19 @@
 """Observability utilities: structured logging, phase timers, throughput
-meters, and JAX profiler hooks.
+meters, JAX profiler hooks, and the run-telemetry subsystem.
 
 The reference has no tracing/profiling subsystem at all — observability is
 bare ``print()`` calls throughout (e.g.
 ``/root/reference/enterprise_warp/enterprise_warp.py:199-201,213-251``).
 This package is the SURVEY.md §5 replacement: structured logs, per-phase
-timers, an evals/s counter (the north-star metric of BASELINE.json), and
-optional ``jax.profiler`` trace capture.
+timers, an evals/s counter (the north-star metric of BASELINE.json),
+optional ``jax.profiler`` trace capture, and — in :mod:`.telemetry` —
+the process-wide metrics registry, the ``events.jsonl`` run recorder,
+and compile/retrace tracking (see ``docs/observability.md``).
 """
 
+from . import telemetry
 from .logging import (EvalRateMeter, PhaseTimer, get_logger, log_phase,
                       profiler_trace)
 
 __all__ = ["get_logger", "PhaseTimer", "EvalRateMeter", "log_phase",
-           "profiler_trace"]
+           "profiler_trace", "telemetry"]

@@ -94,6 +94,40 @@ def effective_sample_size(chains):
     return float(m * n / tau)
 
 
+def throttled_block_worst(block, param_names, last_t, max_kept=256):
+    """Worst R-hat/ESS of one sampler block's emissions, throttled —
+    the shared heartbeat-diagnostics path of the PT and HMC samplers.
+
+    ``block`` — (steps, nchains, ndim) cold-chain emissions (the
+    host-side array the sampler just synced); ``last_t`` — a one-item
+    mutable list holding the perf-counter time of the last computation
+    (0.0 forces one). Returns the ``_worst`` dict, or None when inside
+    the throttle window.
+
+    Strided to <= ``max_kept`` steps per chain so the per-heartbeat
+    host cost is bounded (R-hat is thinning-invariant; the thinned
+    Geyer ESS lower-bounds the total — the honest direction for
+    telemetry). Recomputed at most every ``EWT_TELEMETRY_DIAG_S``
+    seconds (default 20; the first heartbeat of a run always
+    computes), keeping heartbeats off the hot path on fast device
+    blocks."""
+    import os
+    import time
+
+    now = time.perf_counter()
+    try:
+        interval = float(os.environ.get("EWT_TELEMETRY_DIAG_S", "20"))
+    except ValueError:
+        interval = 20.0     # telemetry must never kill a run
+
+    if last_t[0] and now - last_t[0] < interval:
+        return None
+    last_t[0] = now
+    c = np.transpose(np.asarray(block, dtype=np.float64), (1, 0, 2))
+    stride = max(1, -(-c.shape[1] // max_kept))
+    return summarize_chains(c[:, ::stride], param_names)["_worst"]
+
+
 def cache_hit_summary(site, common, full):
     """Cache-hit record of the evaluation-structure layer (JSON-ready).
 
@@ -123,6 +157,13 @@ def summarize_chains(chains, names=None):
 
     Returns a dict ``{name: {"rhat": ..., "ess": ..., "mean": ...,
     "std": ...}}`` plus ``"_worst"`` with the max R-hat / min ESS.
+
+    JSON contract: every value is either a finite float or ``None``.
+    Empty chain sets (``d == 0``) and chains too short for the
+    estimators (``gelman_rubin`` returns ``inf`` below 4 steps) clamp
+    to ``None`` instead of leaking ``inf`` — ``json.dump`` serializes
+    ``inf`` as the non-standard token ``Infinity``, which breaks every
+    strict reader of the diagnostics/telemetry artifacts downstream.
     """
     c = np.asarray(chains, dtype=np.float64)
     if c.ndim == 2:
@@ -135,10 +176,16 @@ def summarize_chains(chains, names=None):
     for i, name in enumerate(names):
         r = gelman_rubin(c[:, :, i])
         e = effective_sample_size(c[:, :, i])
-        out[name] = {"rhat": r, "ess": e,
+        out[name] = {"rhat": float(r) if np.isfinite(r) else None,
+                     "ess": float(e) if np.isfinite(e) else None,
                      "mean": float(c[:, :, i].mean()),
                      "std": float(c[:, :, i].std())}
         worst_rhat = max(worst_rhat, r)
         worst_ess = min(worst_ess, e)
-    out["_worst"] = {"rhat": worst_rhat, "ess": worst_ess}
+    out["_worst"] = {
+        "rhat": float(worst_rhat) if names and np.isfinite(worst_rhat)
+        else None,
+        "ess": float(worst_ess) if names and np.isfinite(worst_ess)
+        else None,
+    }
     return out

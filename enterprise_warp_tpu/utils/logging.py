@@ -18,19 +18,41 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import sys
 import time
 
 _FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
 _configured = False
 
 
+class _DynamicStderrHandler(logging.Handler):
+    """Writes to the CURRENT ``sys.stderr`` at emit time (a plain
+    ``StreamHandler`` binds the stream object at construction, so
+    anything that swaps ``sys.stderr`` afterwards — pytest capture,
+    output redirection — would silently lose the log)."""
+
+    def emit(self, record):
+        try:
+            sys.stderr.write(self.format(record) + "\n")
+        except Exception:   # noqa: BLE001 — logging must never raise
+            self.handleError(record)
+
+
 def get_logger(name: str = "ewt") -> logging.Logger:
     """Process-wide logger; level from ``EWT_LOG`` (default INFO)."""
     global _configured
     if not _configured:
-        level = os.environ.get("EWT_LOG", "INFO").upper()
-        logging.basicConfig(level=getattr(logging, level, logging.INFO),
-                            format=_FORMAT)
+        root = logging.getLogger()
+        if not root.handlers:
+            # basicConfig semantics: a host application that already
+            # configured the root logger keeps its handlers AND its
+            # level — a library must not double-print or clobber a
+            # WARNING threshold the app chose
+            handler = _DynamicStderrHandler()
+            handler.setFormatter(logging.Formatter(_FORMAT))
+            root.addHandler(handler)
+            level = os.environ.get("EWT_LOG", "INFO").upper()
+            root.setLevel(getattr(logging, level, logging.INFO))
         _configured = True
     return logging.getLogger(name)
 

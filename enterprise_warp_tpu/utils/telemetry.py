@@ -1,0 +1,520 @@
+"""Run-telemetry subsystem: metrics registry, structured event stream,
+compile/retrace tracking.
+
+The reference stack's observability is ``print()``-based (SURVEY.md §5)
+and accelerator-resident sampling makes that blindness expensive: a
+silent XLA retrace costs minutes, evals/s is THE north-star metric
+(BASELINE.json), and convergence trajectory decides when a run is done.
+This module makes all three first-class, off the hot path:
+
+- :func:`registry` — a process-wide metrics registry of counters,
+  gauges, and streaming histograms with label support
+  (``likelihood_evals{mask_class=site}``, ``retraces{fn=stage2}``),
+  snapshot-able to JSON. All increments are host-side Python; nothing
+  here ever touches a device array.
+- :func:`traced` — a ``jax.jit`` wrapper that turns silent retraces
+  into counted events: every (re)trace increments
+  ``retraces{fn=<name>}`` and, when a run recorder is active, emits a
+  ``compile`` event with the wall time of the triggering call and the
+  argument shapes.
+- :class:`RunRecorder` / :func:`run_scope` — a structured JSONL event
+  stream (``<run_dir>/events.jsonl``; atomic appends, periodic flush)
+  with typed events: ``run_start`` (config hash, jax/backend versions,
+  devices), ``compile``, ``heartbeat`` (step, acceptance, evals/s,
+  cache_hit_rate, worst R-hat/ESS), ``checkpoint``, ``run_end``.
+  ``tools/report.py`` folds the stream into ``run_report.json``.
+
+Everything is disabled by ``EWT_TELEMETRY=0``: recorders become
+no-ops, the registry hands out no-op metrics, and :func:`traced`
+degrades to a bare ``jax.jit``.
+
+Instrumentation contract (enforced by construction): heartbeats are
+emitted only at existing host-sync points (sampler block boundaries),
+registry increments are plain host-side arithmetic, and no code path
+here introduces a device synchronization.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+
+__all__ = ["enabled", "registry", "MetricsRegistry", "Counter", "Gauge",
+           "Histogram", "traced", "RunRecorder", "run_scope",
+           "active_recorder"]
+
+
+def enabled() -> bool:
+    """Telemetry master switch: ``EWT_TELEMETRY=0`` disables everything."""
+    return os.environ.get("EWT_TELEMETRY", "1") != "0"
+
+
+# ------------------------------------------------------------------ #
+#  metrics registry                                                   #
+# ------------------------------------------------------------------ #
+
+def _metric_key(name: str, labels: dict) -> str:
+    """``name{k=v,...}`` with sorted label keys (stable snapshot keys)."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotone host-side counter."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1):
+        self.value += n
+
+
+class Gauge:
+    """Last-value-wins gauge."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def set(self, v):
+        self.value = float(v)
+
+
+class Histogram:
+    """Streaming histogram: exact count/sum/min/max plus quantiles from
+    a bounded deterministic reservoir (every k-th observation once the
+    buffer is full — unbiased enough for progress telemetry, O(1) per
+    ``observe`` and bounded memory on million-step runs)."""
+
+    __slots__ = ("count", "sum", "min", "max", "_buf", "_cap", "_stride")
+
+    def __init__(self, cap: int = 4096):
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self._buf = []
+        self._cap = cap
+        self._stride = 1
+
+    def observe(self, v):
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        if self.count % self._stride == 0:
+            self._buf.append(v)
+            if len(self._buf) >= self._cap:
+                # decimate: keep every other sample, double the stride
+                self._buf = self._buf[::2]
+                self._stride *= 2
+
+    def quantile(self, q: float):
+        if not self._buf:
+            return None
+        s = sorted(self._buf)
+        idx = min(int(q * len(s)), len(s) - 1)
+        return s[idx]
+
+    def summary(self) -> dict:
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "p50": self.quantile(0.5), "p90": self.quantile(0.9),
+                "p99": self.quantile(0.99)}
+
+
+class _NoopMetric:
+    """Stands in for every metric type when telemetry is disabled."""
+
+    __slots__ = ()
+    value = None
+    count = 0
+
+    def inc(self, n=1):
+        pass
+
+    def set(self, v):
+        pass
+
+    def observe(self, v):
+        pass
+
+    def quantile(self, q):
+        return None
+
+    def summary(self):
+        return {}
+
+
+_NOOP_METRIC = _NoopMetric()
+
+
+class MetricsRegistry:
+    """Process-wide named metrics with labels; JSON-snapshot-able."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def _get(self, store, cls, name, labels):
+        if not enabled():
+            return _NOOP_METRIC
+        key = _metric_key(name, labels)
+        with self._lock:
+            m = store.get(key)
+            if m is None:
+                m = store[key] = cls()
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(self._counters, Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(self._gauges, Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(self._histograms, Histogram, name, labels)
+
+    def snapshot(self) -> dict:
+        """JSON-ready snapshot of every metric in the registry."""
+        with self._lock:
+            return {
+                "counters": {k: c.value
+                             for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+                "histograms": {k: h.summary()
+                               for k, h in self._histograms.items()},
+            }
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide metrics registry."""
+    return _REGISTRY
+
+
+# ------------------------------------------------------------------ #
+#  compile / retrace tracking                                         #
+# ------------------------------------------------------------------ #
+
+def _arg_shapes(args, limit: int = 24):
+    """Compact shape signature of a call's positional args: one entry
+    per pytree leaf — ``[d0, d1, ...]`` for arrays, the type name for
+    everything else — truncated to ``limit`` leaves."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(args)
+    out = []
+    for leaf in leaves[:limit]:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            out.append(list(shape))
+        else:
+            out.append(type(leaf).__name__)
+    if len(leaves) > limit:
+        out.append(f"...+{len(leaves) - limit}")
+    return out
+
+
+def traced(fn, *, name: str | None = None, **jit_kwargs):
+    """``jax.jit`` with compile/retrace telemetry.
+
+    Returns a jitted callable semantically identical to
+    ``jax.jit(fn, **jit_kwargs)``. Each time XLA (re)traces ``fn`` —
+    first call, new argument shapes/dtypes, new static values — the
+    call that triggered it increments ``retraces{fn=<name>}`` in the
+    registry and, when a run recorder is active, emits a ``compile``
+    event carrying the fn name, the wall time of the triggering call
+    (trace + XLA compile + first dispatch), and the argument shapes.
+
+    The retrace detection is a host-side flag set inside the traced
+    Python body — no private jax API, no extra device work, and the
+    steady-state (cache-hit) overhead is one flag check per call.
+
+    With ``EWT_TELEMETRY=0`` this returns the bare jitted function.
+    """
+    import jax
+
+    label = name or getattr(fn, "__name__", "fn")
+    tracing = [False]
+
+    def _inner(*args, **kwargs):
+        tracing[0] = True
+        return fn(*args, **kwargs)
+
+    jitted = jax.jit(_inner, **jit_kwargs)
+    if not enabled():
+        return jitted
+
+    @functools.wraps(fn)
+    def call(*args, **kwargs):
+        if not enabled():
+            return jitted(*args, **kwargs)
+        tracing[0] = False
+        t0 = time.perf_counter()
+        out = jitted(*args, **kwargs)
+        # under jax.disable_jit() the Python body runs EVERY call —
+        # that is eager debugging, not a retrace; counting it would
+        # flood the stream with bogus compile events
+        if tracing[0] and not jax.config.jax_disable_jit:
+            wall = time.perf_counter() - t0
+            _REGISTRY.counter("retraces", fn=label).inc()
+            rec = active_recorder()
+            if rec is not None:
+                rec.event("compile", fn=label, wall_s=round(wall, 4),
+                          arg_shapes=_arg_shapes(args))
+        return out
+
+    call._jitted = jitted
+    call._telemetry_name = label
+    return call
+
+
+# ------------------------------------------------------------------ #
+#  run recorder: structured JSONL event stream                        #
+# ------------------------------------------------------------------ #
+
+def _json_default(o):
+    """Last-resort JSON encoding: numpy scalars/arrays and everything
+    else degrade to floats/lists/strings rather than crashing a run."""
+    tolist = getattr(o, "tolist", None)
+    if tolist is not None:
+        return tolist()
+    try:
+        return float(o)
+    except (TypeError, ValueError):
+        return str(o)
+
+
+_INF = float("inf")
+_NINF = float("-inf")
+
+
+def _sanitize(v):
+    """Strict-JSON field cleanup: numpy scalars/arrays normalize to
+    plain Python values and non-finite floats become None — the schema
+    promises 'null, never Infinity', while bare ``json.dumps`` would
+    emit the non-standard ``Infinity`` token (e.g. ``max_lnl`` while
+    every walker still sits at lnl=-inf)."""
+    tolist = getattr(v, "tolist", None)
+    if tolist is not None and not isinstance(v, (str, bytes)):
+        v = tolist()                   # numpy scalar/array -> python
+    if isinstance(v, float):
+        return v if v == v and v not in (_INF, _NINF) else None
+    if isinstance(v, dict):
+        return {k: _sanitize(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_sanitize(x) for x in v]
+    return v
+
+
+def _sanitize_dumps(rec) -> str:
+    return json.dumps(_sanitize(rec), default=_json_default)
+
+
+class RunRecorder:
+    """Structured JSONL event stream for one run directory.
+
+    Events are buffered host-side and flushed to
+    ``<run_dir>/events.jsonl`` every ``flush_every`` events or
+    ``flush_interval`` seconds, whichever comes first. Each flush is a
+    single ``write`` on a file opened with ``O_APPEND``, so concurrent
+    appends (a results process tailing a live run, an overlapping
+    flush) never interleave mid-line.
+
+    Every event is one JSON object per line with at least ``t`` (unix
+    epoch seconds) and ``type``.
+    """
+
+    def __init__(self, run_dir: str, flush_every: int = 20,
+                 flush_interval: float = 5.0):
+        self.run_dir = run_dir
+        self.path = os.path.join(run_dir, "events.jsonl")
+        self.enabled = enabled()
+        self._buf: list[str] = []
+        self._flush_every = flush_every
+        self._flush_interval = flush_interval
+        self._last_flush = time.time()
+        if self.enabled:
+            os.makedirs(run_dir, exist_ok=True)
+            self._heal_torn_tail()
+
+    def _heal_torn_tail(self):
+        """A process killed mid-write leaves the stream without a
+        trailing newline; a new session appending onto that torn tail
+        would weld its first event (the ``run_start``) onto the partial
+        line, losing both. Terminate the tail before appending."""
+        try:
+            with open(self.path, "rb+") as fh:
+                fh.seek(0, os.SEEK_END)
+                if fh.tell() > 0:
+                    fh.seek(-1, os.SEEK_END)
+                    if fh.read(1) != b"\n":
+                        fh.write(b"\n")
+        except FileNotFoundError:
+            pass
+        except OSError:
+            pass    # flush() handles (and reports) unwritable dirs
+
+    # -------------------------- core ------------------------------ #
+    def event(self, type: str, **fields):
+        """Append one typed event (buffered; see class docstring)."""
+        if not self.enabled:
+            return
+        rec = {"t": round(time.time(), 3), "type": type}
+        rec.update(fields)
+        self._buf.append(_sanitize_dumps(rec))
+        now = time.time()
+        if (len(self._buf) >= self._flush_every
+                or now - self._last_flush >= self._flush_interval):
+            self.flush()
+
+    def flush(self):
+        if not self._buf or not self.enabled:
+            return
+        payload = "\n".join(self._buf) + "\n"
+        self._buf = []
+        self._last_flush = time.time()
+        try:
+            with open(self.path, "a") as fh:
+                fh.write(payload)
+        except OSError as exc:
+            # telemetry must never kill a run: a full disk / dead mount
+            # under the run dir degrades the recorder to a no-op (events
+            # from here on are dropped) instead of aborting sampling
+            self.enabled = False
+            from .logging import get_logger
+
+            get_logger("ewt.telemetry").warning(
+                "event-stream write to %s failed (%s); disabling "
+                "telemetry recording for this run", self.path, exc)
+
+    def close(self):
+        self.flush()
+
+    # -------------------------- typed events ---------------------- #
+    def run_start(self, **fields):
+        """``run_start``: environment fingerprint + caller fields."""
+        if not self.enabled:
+            return
+        info = dict(fields)
+        try:
+            import jax
+
+            info.setdefault("jax_version", jax.__version__)
+            info.setdefault("backend", jax.default_backend())
+            devs = jax.devices()
+            info.setdefault("device_count", len(devs))
+            info.setdefault("devices", sorted({d.platform for d in devs}))
+        except Exception:   # noqa: BLE001 — fingerprint is best-effort
+            pass
+        self.event("run_start", **info)
+        self.flush()        # the header must survive an early crash
+
+    def heartbeat(self, **fields):
+        self.event("heartbeat", **fields)
+
+    def checkpoint(self, **fields):
+        self.event("checkpoint", **fields)
+
+    def run_end(self, **fields):
+        """``run_end``: status + final metrics-registry snapshot."""
+        if not self.enabled:
+            return
+        fields.setdefault("metrics", _REGISTRY.snapshot())
+        self.event("run_end", **fields)
+        self.flush()
+
+
+class _NoopRecorder:
+    """Inert recorder handed out when telemetry is off (or on non-primary
+    distributed processes) so call sites never need a None check."""
+
+    enabled = False
+    run_dir = None
+    path = None
+
+    def event(self, *args, **fields):
+        pass
+
+    run_start = heartbeat = checkpoint = run_end = event
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+_NOOP_RECORDER = _NoopRecorder()
+_ACTIVE: list[RunRecorder] = []
+
+
+def active_recorder() -> RunRecorder | None:
+    """The innermost live recorder (None outside any run scope)."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def _is_primary() -> bool:
+    try:
+        from ..parallel.distributed import is_primary
+
+        return is_primary()
+    except Exception:   # noqa: BLE001 — never let telemetry kill a run
+        return True
+
+
+@contextlib.contextmanager
+def run_scope(run_dir: str | None, **start_fields):
+    """Open (or join) the run-level event stream for ``run_dir``.
+
+    The OUTERMOST scope owns the stream: it creates the recorder,
+    emits ``run_start`` on entry and ``run_end`` (status ``ok`` or
+    ``error``, with a metrics snapshot) on exit. Nested scopes — a
+    sampler's ``sample()`` running inside a convergence driver or the
+    CLI — reuse the active recorder and emit neither, so one run
+    produces exactly one ``run_start``/``run_end`` pair.
+
+    Yields a recorder (a no-op one when telemetry is disabled,
+    ``run_dir`` is None, or this is a non-primary distributed
+    process); callers use it unconditionally.
+    """
+    if _ACTIVE:
+        yield _ACTIVE[-1]
+        return
+    if not enabled() or run_dir is None or not _is_primary():
+        yield _NOOP_RECORDER
+        return
+    rec = RunRecorder(run_dir)
+    rec.run_start(**start_fields)
+    _ACTIVE.append(rec)
+    status = "ok"
+    try:
+        yield rec
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        _ACTIVE.remove(rec)
+        rec.run_end(status=status)
+        rec.close()

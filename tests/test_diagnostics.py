@@ -46,3 +46,26 @@ def test_summarize_shape_and_worst():
 def test_constant_chain_degenerate():
     c = np.ones((2, 100))
     assert gelman_rubin(c) == 1.0
+
+
+def test_short_and_empty_chains_clamp_to_none():
+    import json
+
+    # 2 steps: gelman_rubin would return inf — the JSON contract clamps
+    s = summarize_chains(np.zeros((2, 2, 3)), ["a", "b", "c"])
+    assert s["_worst"]["rhat"] is None
+    assert s["a"]["rhat"] is None
+    json.dumps(s, allow_nan=False)        # strictly valid JSON
+
+    # empty parameter set: no estimates at all -> both None (the seed
+    # code emitted rhat=0.0 / ess=inf here)
+    s0 = summarize_chains(np.zeros((2, 100, 0)), [])
+    assert s0["_worst"] == {"rhat": None, "ess": None}
+    json.dumps(s0, allow_nan=False)
+
+    # healthy chains keep plain finite floats
+    rng = np.random.default_rng(5)
+    s1 = summarize_chains(rng.standard_normal((4, 400, 2)))
+    assert isinstance(s1["_worst"]["rhat"], float)
+    assert isinstance(s1["_worst"]["ess"], float)
+    json.dumps(s1, allow_nan=False)

@@ -123,14 +123,18 @@ class TestCore:
         assert chain.shape[1] == 3        # 4 diag cols stripped
         assert diag.shape[1] == 4
 
-    def test_logbf_from_nmodel(self, tmp_path, capsys):
+    def test_logbf_from_nmodel(self, tmp_path, caplog):
+        import logging
+
         out = str(tmp_path)
         write_fake_run(out, nmodel=True, nsamp=4000)
         r = EnterpriseWarpResult(opts_for(out, logbf=1))
         chain, _, pars = r.load_chains("0_J0000+0000")
-        counts = r._print_logbf("0_J0000+0000", chain, pars)
-        printed = capsys.readouterr().out
-        assert "logBF[1/0]" in printed
+        # results-layer output goes through get_logger now — the
+        # print-lint test bans bare print() in library code
+        with caplog.at_level(logging.INFO, logger="ewt.results"):
+            counts = r._print_logbf("0_J0000+0000", chain, pars)
+        assert "logBF[1/0]" in caplog.text
         # 3:1 visit ratio -> logBF ~ ln 3
         logbf = np.log(counts[1] / counts[0])
         assert abs(logbf - np.log(3)) < 0.3
@@ -156,15 +160,18 @@ class TestCore:
         with open(path) as fh:
             assert "J1832-0836_efac" in json.load(fh)
 
-    def test_diagnostics_option(self, tmp_path, capsys):
+    def test_diagnostics_option(self, tmp_path, caplog):
+        import logging
+
         out = str(tmp_path)
         d, pars, _ = write_fake_run(out, nsamp=800)
         # a 4-chain PT checkpoint so nchains inference kicks in
         np.savez(os.path.join(d, "state.npz"),
                  x=np.zeros((8, len(pars))), ladder=np.array([1.0, 1.7]))
         r = EnterpriseWarpResult(opts_for(out, diagnostics=1))
-        r.main_pipeline()
-        text = capsys.readouterr().out
+        with caplog.at_level(logging.INFO, logger="ewt.results"):
+            r.main_pipeline()
+        text = caplog.text
         assert "worst R-hat=" in text and "4 chains" in text
         path = os.path.join(out, "diagnostics",
                             "0_J0000+0000_diagnostics.json")

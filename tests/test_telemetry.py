@@ -1,0 +1,355 @@
+"""Run-telemetry subsystem tests (utils/telemetry.py + tools/report.py).
+
+Covers the ISSUE-2 acceptance surface: event schema round-trip,
+histogram quantiles, retrace counting under shape change, the
+``EWT_TELEMETRY=0`` no-op, the report CLI on a recorded run, the
+print-lint gate, and the end-to-end PTMCMC + nested run producing a
+valid ``events.jsonl`` + ``run_report.json``.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.models.priors import Parameter, Uniform
+from enterprise_warp_tpu.utils import telemetry
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+PKG_DIR = REPO_ROOT / "enterprise_warp_tpu"
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on(monkeypatch):
+    """Default every test to telemetry ON with a clean registry."""
+    monkeypatch.setenv("EWT_TELEMETRY", "1")
+    telemetry.registry().reset()
+    yield
+    telemetry.registry().reset()
+
+
+def _load_report_cli():
+    spec = importlib.util.spec_from_file_location(
+        "ewt_report_cli", str(REPO_ROOT / "tools" / "report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class BoxGaussianLike:
+    """Minimal analytic likelihood satisfying the sampler interface."""
+
+    def __init__(self, mu=(0.0, 1.0), sigma=(0.5, 0.3)):
+        self.mu = jnp.asarray(mu, dtype=jnp.float64)
+        self.sigma = jnp.asarray(sigma, dtype=jnp.float64)
+        self.ndim = len(mu)
+        self.params = [Parameter(f"p{i}", Uniform(-10.0, 10.0))
+                       for i in range(self.ndim)]
+        self.param_names = [p.name for p in self.params]
+
+        def ll(theta):
+            z = (theta - self.mu) / self.sigma
+            return -0.5 * jnp.sum(z * z)
+
+        self.loglike = jax.jit(ll)
+        self.loglike_batch = jax.jit(jax.vmap(ll))
+
+    def log_prior(self, theta):
+        theta = jnp.atleast_1d(theta)
+        out = 0.0
+        for i, p in enumerate(self.params):
+            out = out + p.prior.logpdf(theta[..., i])
+        return out
+
+    def from_unit(self, u):
+        return jnp.stack([p.prior.from_unit(u[..., i])
+                          for i, p in enumerate(self.params)], axis=-1)
+
+    def sample_prior(self, rng, n=1):
+        return rng.uniform(-10.0, 10.0, size=(n, self.ndim))
+
+
+# ------------------------------------------------------------------ #
+#  metrics registry                                                   #
+# ------------------------------------------------------------------ #
+
+def test_registry_counters_gauges_labels():
+    reg = telemetry.registry()
+    reg.counter("evals", mask_class="site").inc()
+    reg.counter("evals", mask_class="site").inc(2)
+    reg.counter("evals", mask_class="full").inc()
+    reg.gauge("scale").set(0.25)
+    snap = reg.snapshot()
+    assert snap["counters"]["evals{mask_class=site}"] == 3
+    assert snap["counters"]["evals{mask_class=full}"] == 1
+    assert snap["gauges"]["scale"] == 0.25
+    # snapshot is JSON-serializable (strict: no inf/nan tokens)
+    json.dumps(snap, allow_nan=False)
+
+
+def test_histogram_quantiles():
+    reg = telemetry.registry()
+    h = reg.histogram("lat")
+    for v in np.random.default_rng(0).permutation(1000):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 1000
+    assert s["min"] == 0.0 and s["max"] == 999.0
+    assert abs(s["p50"] - 500) < 60
+    assert abs(s["p90"] - 900) < 60
+    assert s["p99"] >= s["p90"] >= s["p50"]
+    # decimating reservoir keeps memory bounded past the cap
+    for v in range(20000):
+        h.observe(float(v % 1000))
+    assert len(h._buf) <= h._cap
+
+
+# ------------------------------------------------------------------ #
+#  event schema round-trip                                            #
+# ------------------------------------------------------------------ #
+
+def test_event_schema_roundtrip(tmp_path):
+    rec = telemetry.RunRecorder(str(tmp_path), flush_every=2)
+    rec.run_start(sampler="test", config_hash="abc123")
+    rec.heartbeat(step=10, evals_per_s=123.4, cache_hit_rate=0.5,
+                  rhat=1.01, ess=np.float64(250.0),
+                  ladder=np.array([1.0, 1.7]))
+    rec.checkpoint(step=10)
+    rec.run_end(status="ok")
+    rec.close()
+
+    lines = (tmp_path / "events.jsonl").read_text().splitlines()
+    events = [json.loads(ln) for ln in lines]
+    types = [e["type"] for e in events]
+    assert types == ["run_start", "heartbeat", "checkpoint", "run_end"]
+    for e in events:
+        assert isinstance(e["t"], float)
+    start = events[0]
+    assert start["config_hash"] == "abc123"
+    assert start["jax_version"] == jax.__version__
+    assert start["backend"] == "cpu"
+    hb = events[1]
+    # numpy scalars/arrays degrade to plain JSON numbers/lists
+    assert hb["ess"] == 250.0 and hb["ladder"] == [1.0, 1.7]
+    assert hb["evals_per_s"] == 123.4 and hb["cache_hit_rate"] == 0.5
+    end = events[-1]
+    assert end["status"] == "ok" and "metrics" in end
+
+
+def test_run_scope_nesting_single_start_end(tmp_path):
+    with telemetry.run_scope(str(tmp_path), sampler="outer") as rec:
+        with telemetry.run_scope(str(tmp_path / "inner"),
+                                 sampler="inner") as rec2:
+            assert rec2 is rec          # nested scope joins the stream
+            rec2.heartbeat(step=1)
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    assert [e["type"] for e in events] == \
+        ["run_start", "heartbeat", "run_end"]
+    assert events[0]["sampler"] == "outer"
+    assert not (tmp_path / "inner").exists()
+
+
+# ------------------------------------------------------------------ #
+#  compile / retrace tracking                                         #
+# ------------------------------------------------------------------ #
+
+def test_retrace_counting_under_shape_change(tmp_path):
+    reg = telemetry.registry()
+    with telemetry.run_scope(str(tmp_path)) as rec:
+        fn = telemetry.traced(lambda x: 2.0 * x, name="t_shape")
+        fn(jnp.ones(3))
+        fn(jnp.ones(3))                     # cache hit: no retrace
+        assert reg.counter("retraces", fn="t_shape").value == 1
+        fn(jnp.ones(4))                     # new shape -> retrace
+        fn(jnp.ones(4))
+        assert reg.counter("retraces", fn="t_shape").value == 2
+        rec.flush()
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    compiles = [e for e in events if e["type"] == "compile"]
+    assert len(compiles) == 2
+    assert all(e["fn"] == "t_shape" for e in compiles)
+    assert compiles[0]["arg_shapes"] == [[3]]
+    assert compiles[1]["arg_shapes"] == [[4]]
+    assert all(e["wall_s"] >= 0 for e in compiles)
+    # numerics unchanged by the wrapper
+    np.testing.assert_allclose(np.asarray(fn(jnp.ones(4))), 2.0)
+
+
+def test_disabled_is_noop(tmp_path, monkeypatch):
+    monkeypatch.setenv("EWT_TELEMETRY", "0")
+    reg = telemetry.registry()
+    reg.counter("x").inc()
+    assert reg.snapshot() == {"counters": {}, "gauges": {},
+                              "histograms": {}}
+    fn = telemetry.traced(lambda x: x + 1, name="t_off")
+    with telemetry.run_scope(str(tmp_path), sampler="off") as rec:
+        assert float(fn(jnp.float64(1.0))) == 2.0
+        rec.heartbeat(step=1)
+        rec.event("anything", a=1)
+    assert not (tmp_path / "events.jsonl").exists()
+    assert reg.snapshot()["counters"] == {}
+
+
+# ------------------------------------------------------------------ #
+#  print lint: library code must log, not print                       #
+# ------------------------------------------------------------------ #
+
+def test_no_print_outside_cli():
+    """Statement-level ``print(`` is banned in the package outside the
+    two user-facing CLIs (``cli.py``, ``results/__main__.py``) — all
+    library output goes through ``utils.logging.get_logger`` or the
+    telemetry event stream."""
+    allowed = {PKG_DIR / "cli.py", PKG_DIR / "results" / "__main__.py"}
+    pattern = re.compile(r"^\s*print\(")
+    offenders = []
+    for path in sorted(PKG_DIR.rglob("*.py")):
+        if path in allowed:
+            continue
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1):
+            if pattern.match(line):
+                offenders.append(f"{path.relative_to(REPO_ROOT)}:"
+                                 f"{lineno}: {line.strip()}")
+    assert not offenders, (
+        "bare print() in library code (use get_logger or telemetry "
+        "events):\n" + "\n".join(offenders))
+
+
+# ------------------------------------------------------------------ #
+#  report CLI                                                         #
+# ------------------------------------------------------------------ #
+
+def test_report_cli_on_fixture(tmp_path, capsys):
+    rec = telemetry.RunRecorder(str(tmp_path))
+    rec.run_start(sampler="ptmcmc", config_hash="deadbeef")
+    rec.event("compile", fn="ptmcmc_block", wall_s=2.5,
+              arg_shapes=[[8, 2]])
+    rec.event("compile", fn="pulsar.eval_batch", wall_s=0.5,
+              arg_shapes=[[256, 2]])
+    for k in range(3):
+        rec.heartbeat(step=100 * (k + 1), evals_per_s=1000.0 + k,
+                      evals_total=800 * (k + 1), cache_hit_rate=0.4,
+                      rhat=1.05 - 0.01 * k, ess=100.0 * (k + 1))
+    rec.checkpoint(step=300)
+    rec.run_end(status="ok")
+    rec.close()
+    # a torn trailing line (kill mid-append) must be tolerated
+    with open(rec.path, "a") as fh:
+        fh.write('{"t": 1.0, "type": "heart')
+
+    report_cli = _load_report_cli()
+    assert report_cli.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "sampler=ptmcmc" in out and "compiles: 2" in out
+
+    rpt = json.load(open(tmp_path / "run_report.json"))
+    assert rpt["run"]["sampler"] == "ptmcmc"
+    assert rpt["status"] == "ok"
+    assert rpt["dropped_lines"] == 1
+    assert rpt["compiles"]["total"] == 2
+    assert rpt["compiles"]["per_fn"]["ptmcmc_block"]["wall_s"] == 2.5
+    assert rpt["wall_clock"]["compile_s"] == 3.0
+    assert len(rpt["eval_rate"]["timeline"]) == 3
+    assert rpt["eval_rate"]["peak_evals_per_s"] == 1002.0
+    assert rpt["eval_rate"]["evals_total"] == 2400
+    traj = rpt["convergence"]["trajectory"]
+    assert [c["rhat"] for c in traj] == [1.05, 1.04, 1.03]
+    assert rpt["cache_hit_rate"] == 0.4
+    assert rpt["checkpoints"] == 1
+    assert rpt["sessions_in_stream"] == 1
+    json.dumps(rpt, allow_nan=False)
+
+    # events.jsonl is append-only: a second session into the same dir
+    # must fold to the LATEST run_start..run_end segment, not a
+    # frankenstein of both
+    rec2 = telemetry.RunRecorder(str(tmp_path))
+    rec2.run_start(sampler="nested", config_hash="cafe0002")
+    rec2.heartbeat(iteration=20, evals_per_s=50.0, evals_total=1000)
+    rec2.run_end(status="ok")
+    rec2.close()
+    assert report_cli.main([str(tmp_path), "-q"]) == 0
+    rpt2 = json.load(open(tmp_path / "run_report.json"))
+    assert rpt2["sessions_in_stream"] == 2
+    assert rpt2["run"]["sampler"] == "nested"
+    assert rpt2["run"]["config_hash"] == "cafe0002"
+    assert rpt2["compiles"]["total"] == 0       # prior session's only
+    assert rpt2["eval_rate"]["evals_total"] == 1000
+
+
+# ------------------------------------------------------------------ #
+#  end-to-end: PTMCMC + nested produce a foldable event stream        #
+# ------------------------------------------------------------------ #
+
+def test_e2e_ptmcmc_nested_events_and_report(tmp_path):
+    from enterprise_warp_tpu.samplers import PTSampler, run_nested
+
+    like = BoxGaussianLike()
+    ptdir = tmp_path / "pt"
+    s = PTSampler(like, str(ptdir), ntemps=2, nchains=4, seed=0,
+                  cov_update=200)
+    s.sample(400, resume=False, verbose=False, block_size=200)
+
+    events = [json.loads(ln) for ln in
+              (ptdir / "events.jsonl").read_text().splitlines()]
+    types = [e["type"] for e in events]
+    assert types[0] == "run_start" and types[-1] == "run_end"
+    assert sum(t == "compile" for t in types) >= 1
+    hbs = [e for e in events if e["type"] == "heartbeat"]
+    assert len(hbs) >= 1
+    # the acceptance fields: evals/s, cache_hit_rate, rhat
+    gated = [h for h in hbs if "rhat" in h]
+    assert gated, "no heartbeat carried convergence diagnostics"
+    h0 = gated[0]
+    assert h0["evals_per_s"] > 0
+    assert h0["cache_hit_rate"] == 0.0      # no param_blocks declared
+    assert h0["rhat"] is None or h0["rhat"] > 0.9
+    assert all("evals_per_s" in h for h in hbs)
+    assert events[-1]["status"] == "ok"
+    compile_ev = next(e for e in events if e["type"] == "compile")
+    assert compile_ev["fn"] == "ptmcmc_block"
+
+    # nested sampling on the same likelihood, separate run dir
+    nsdir = tmp_path / "ns"
+    run_nested(like, outdir=str(nsdir), nlive=64, dlogz=1.0,
+               nsteps=10, seed=1, max_iter=100, verbose=False,
+               label="tel")
+    nev = [json.loads(ln) for ln in
+           (nsdir / "events.jsonl").read_text().splitlines()]
+    ntypes = [e["type"] for e in nev]
+    assert ntypes[0] == "run_start" and ntypes[-1] == "run_end"
+    assert "nested_iteration" in [e.get("fn") for e in nev
+                                  if e["type"] == "compile"]
+    nhb = [e for e in nev if e["type"] == "heartbeat"]
+    assert nhb and nhb[-1]["evals_per_s"] > 0
+    assert "lnz" in nhb[-1]
+
+    # the report CLI folds the PTMCMC stream into a valid report
+    report_cli = _load_report_cli()
+    assert report_cli.main([str(ptdir), "-q"]) == 0
+    rpt = json.load(open(ptdir / "run_report.json"))
+    json.dumps(rpt, allow_nan=False)        # strictly valid JSON
+    assert rpt["status"] == "ok"
+    assert rpt["run"]["sampler"] == "ptmcmc"
+    assert rpt["compiles"]["total"] >= 1
+    assert rpt["eval_rate"]["evals_total"] >= 400 * 8
+    assert rpt["convergence"]["trajectory"]
+    assert rpt["wall_clock"]["sample_s"] >= 0
+
+
+def test_sampler_disabled_no_stream(tmp_path, monkeypatch):
+    monkeypatch.setenv("EWT_TELEMETRY", "0")
+    from enterprise_warp_tpu.samplers import PTSampler
+
+    like = BoxGaussianLike()
+    s = PTSampler(like, str(tmp_path), ntemps=1, nchains=4, seed=0)
+    s.sample(60, resume=False, verbose=False, block_size=60)
+    assert not (tmp_path / "events.jsonl").exists()
+    assert os.path.exists(tmp_path / "chain_1.txt")

@@ -2,6 +2,9 @@
 recovers the injected noise as phase residuals (the fixture-generation path
 for the example corpus), plus utils observability smoke tests."""
 
+import json
+import os
+
 import numpy as np
 import pytest
 
@@ -88,3 +91,25 @@ def test_utils_observability():
 
     with profiler_trace(None):   # no-op path
         pass
+
+
+def test_atomic_write_json(tmp_path):
+    from enterprise_warp_tpu.io.writers import atomic_write_json
+
+    path = str(tmp_path / "artifact.json")
+    # numpy scalars serialize through the float default
+    out = atomic_write_json(path, {"a": np.float64(1.5),
+                                   "n": np.int64(3), "s": "x"})
+    assert out == path
+    assert json.load(open(path)) == {"a": 1.5, "n": 3.0, "s": "x"}
+    # overwrite is atomic: the tmp file never survives, content replaced
+    atomic_write_json(path, {"b": 2})
+    assert json.load(open(path)) == {"b": 2}
+    assert not os.path.exists(path + ".tmp")
+    # a failed dump must not clobber the existing artifact
+    with pytest.raises(TypeError):
+        atomic_write_json(path, {"bad": object()},
+                          default=lambda o: (_ for _ in ()).throw(
+                              TypeError("nope")))
+    assert json.load(open(path)) == {"b": 2}
+    assert not os.path.exists(path + ".tmp")

@@ -102,11 +102,14 @@ def _ess_report(blocks, like, nsamp, burn_frac, **extra):
     chains = np.transpose(c[-keep:], (1, 0, 2)).astype(np.float64)
     summ = summarize_chains(chains, like.param_names)
     worst = summ["_worst"]
+    # summarize_chains clamps un-computable estimates to None (its
+    # strict-JSON contract); keep the record explicit in that case
+    es, rh = worst["ess"], worst["rhat"]
     return dict(
         steps=nsamp,
-        ess_min=round(worst["ess"], 1),
-        ess_per_step=round(worst["ess"] / nsamp, 4),
-        rhat_max=round(worst["rhat"], 4),
+        ess_min=round(es, 1) if es is not None else None,
+        ess_per_step=round(es / nsamp, 4) if es is not None else None,
+        rhat_max=round(rh, 4) if rh is not None else None,
         means={k: round(v["mean"], 3) for k, v in summ.items()
                if not k.startswith("_")},
         **extra)

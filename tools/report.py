@@ -1,0 +1,239 @@
+"""Fold a run's telemetry event stream into a run report.
+
+Usage::
+
+    python tools/report.py <run_dir | events.jsonl> [-o run_report.json]
+    python tools/report.py out/examp_1_t1/0_J1832-0836/
+
+Reads ``events.jsonl`` (written by ``utils/telemetry.py`` — see
+``docs/observability.md`` for the event schema), folds it into
+``run_report.json`` next to the stream (override with ``-o``), and
+prints a human-readable summary:
+
+- run identity (sampler, config hash, jax/backend versions, devices);
+- phase breakdown: compile wall-clock vs sampling wall-clock;
+- compile events per traced function (count, total wall, shapes);
+- the eval-rate timeline and the convergence trajectory (worst
+  R-hat/ESS per heartbeat);
+- cache-hit provenance (the block-sparse evaluation layer's
+  ``cache_hit_rate``) and the final metrics-registry snapshot.
+
+Tolerates an in-flight run (no ``run_end`` yet) and skips corrupt
+lines (a kill mid-append leaves at most one partial line, which the
+atomic-append contract confines to the tail).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _atomic_write_json(path, obj):
+    """Same tmp-file + rename contract as
+    ``enterprise_warp_tpu.io.writers.atomic_write_json``, inlined so
+    this standalone CLI never imports the package (whose ``__init__``
+    pulls in jax) just to write one file."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(obj, fh, indent=1, default=float)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+    return path
+
+
+def load_events(path):
+    """Parse an events.jsonl file, dropping unparseable lines."""
+    events, dropped = [], 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                dropped += 1
+                continue
+            if isinstance(ev, dict) and "type" in ev and "t" in ev:
+                events.append(ev)
+            else:
+                dropped += 1
+    return events, dropped
+
+
+def build_report(events, dropped=0):
+    """Fold a list of event dicts into the run-report structure.
+
+    ``events.jsonl`` is append-only, so a directory that hosted several
+    process sessions (resumes, fresh re-runs into the same outdir)
+    holds several ``run_start``..``run_end`` segments. The report
+    describes the LATEST segment — identity, wall clock, compiles, and
+    heartbeats all come from it — and records how many sessions the
+    stream holds, so a re-run's report never spans the idle gap
+    between sessions.
+    """
+    sessions = sum(1 for ev in events if ev["type"] == "run_start")
+    for i in range(len(events) - 1, -1, -1):
+        if events[i]["type"] == "run_start":
+            events = events[i:]
+            break
+    by_type = {}
+    for ev in events:
+        by_type.setdefault(ev["type"], []).append(ev)
+
+    starts = by_type.get("run_start", [])
+    ends = by_type.get("run_end", [])
+    compiles = by_type.get("compile", [])
+    heartbeats = by_type.get("heartbeat", [])
+    checkpoints = by_type.get("checkpoint", [])
+
+    t0 = starts[0]["t"] if starts else (events[0]["t"] if events
+                                        else None)
+    t_last = events[-1]["t"] if events else None
+    total_wall = (t_last - t0) if (t0 is not None
+                                   and t_last is not None) else None
+
+    # ---- compile phase: per-fn breakdown ---------------------------- #
+    per_fn = {}
+    for ev in compiles:
+        d = per_fn.setdefault(ev.get("fn", "?"),
+                              {"count": 0, "wall_s": 0.0})
+        d["count"] += 1
+        d["wall_s"] = round(d["wall_s"] + float(ev.get("wall_s", 0.0)),
+                            4)
+    compile_wall = round(sum(d["wall_s"] for d in per_fn.values()), 3)
+
+    # ---- heartbeat folds: eval-rate timeline + convergence ---------- #
+    rate_timeline, convergence, cache_hit = [], [], None
+    for hb in heartbeats:
+        t_rel = round(hb["t"] - t0, 2) if t0 is not None else None
+        if hb.get("evals_per_s") is not None:
+            rate_timeline.append(
+                {"t_s": t_rel, "step": hb.get("step", hb.get(
+                    "iteration")), "evals_per_s": hb["evals_per_s"]})
+        if hb.get("rhat") is not None or hb.get("ess") is not None:
+            convergence.append({"t_s": t_rel, "step": hb.get("step"),
+                                "rhat": hb.get("rhat"),
+                                "ess": hb.get("ess")})
+        if hb.get("cache_hit_rate") is not None:
+            cache_hit = hb["cache_hit_rate"]
+
+    rates = [r["evals_per_s"] for r in rate_timeline
+             if r["evals_per_s"] is not None]
+    evals_total = max((hb.get("evals_total", 0) for hb in heartbeats),
+                      default=0)
+
+    report = {
+        "run": dict(starts[0], t=None) if starts else {},
+        "status": (ends[-1].get("status") if ends else "in_flight"),
+        "sessions_in_stream": max(sessions, 1),
+        "events": {k: len(v) for k, v in sorted(by_type.items())},
+        "dropped_lines": dropped,
+        "wall_clock": {
+            "total_s": round(total_wall, 2) if total_wall is not None
+            else None,
+            "compile_s": compile_wall,
+            "sample_s": (round(total_wall - compile_wall, 2)
+                         if total_wall is not None else None),
+        },
+        "compiles": {"total": sum(d["count"] for d in per_fn.values()),
+                     "per_fn": per_fn},
+        "eval_rate": {
+            "timeline": rate_timeline,
+            "peak_evals_per_s": max(rates) if rates else None,
+            "last_evals_per_s": rates[-1] if rates else None,
+            "evals_total": evals_total,
+        },
+        "convergence": {
+            "trajectory": convergence,
+            "final_rhat": (convergence[-1]["rhat"] if convergence
+                           else None),
+            "final_ess": (convergence[-1]["ess"] if convergence
+                          else None),
+        },
+        "cache_hit_rate": cache_hit,
+        "checkpoints": len(checkpoints),
+        "metrics": (ends[-1].get("metrics") if ends else None),
+    }
+    report["run"].pop("t", None)
+    report["run"].pop("type", None)
+    return report
+
+
+def _human_summary(report, out=sys.stdout):
+    run = report["run"]
+    w = report["wall_clock"]
+
+    def p(msg):
+        print(msg, file=out)
+
+    p(f"run: sampler={run.get('sampler', '?')} "
+      f"backend={run.get('backend', '?')} "
+      f"jax={run.get('jax_version', '?')} "
+      f"config={run.get('config_hash', '-')} "
+      f"status={report['status']}")
+    if w["total_s"] is not None:
+        p(f"wall-clock: total {w['total_s']}s = compile "
+          f"{w['compile_s']}s + sample {w['sample_s']}s")
+    c = report["compiles"]
+    p(f"compiles: {c['total']}")
+    for fn, d in sorted(c["per_fn"].items(),
+                        key=lambda kv: -kv[1]["wall_s"]):
+        p(f"  {fn:32s} x{d['count']}  {d['wall_s']}s")
+    er = report["eval_rate"]
+    if er["timeline"]:
+        p(f"eval rate: last {er['last_evals_per_s']} evals/s "
+          f"(peak {er['peak_evals_per_s']}; "
+          f"{er['evals_total']} total evals)")
+    conv = report["convergence"]
+    if conv["trajectory"]:
+        p(f"convergence: final rhat={conv['final_rhat']} "
+          f"ess={conv['final_ess']} over "
+          f"{len(conv['trajectory'])} checks")
+    if report["cache_hit_rate"] is not None:
+        p(f"cache_hit_rate: {report['cache_hit_rate']}")
+    p(f"checkpoints: {report['checkpoints']}, heartbeats: "
+      f"{report['events'].get('heartbeat', 0)}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fold a telemetry events.jsonl into run_report.json")
+    ap.add_argument("path", help="run directory or events.jsonl file")
+    ap.add_argument("-o", "--output", default=None,
+                    help="report path (default <run_dir>/"
+                         "run_report.json)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="write the JSON report only, no summary")
+    opts = ap.parse_args(argv)
+
+    path = opts.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    if not os.path.exists(path):
+        print(f"no event stream at {path}", file=sys.stderr)
+        return 1
+    events, dropped = load_events(path)
+    if not events:
+        print(f"{path}: no parseable events", file=sys.stderr)
+        return 1
+    report = build_report(events, dropped)
+
+    out_path = opts.output or os.path.join(os.path.dirname(path),
+                                           "run_report.json")
+    _atomic_write_json(out_path, report)
+    if not opts.quiet:
+        _human_summary(report)
+        print(f"report: {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
