@@ -526,6 +526,150 @@ def micro_bench():
     print(json.dumps(out))
 
 
+def pipeline_bench():
+    """Device-resident sampler-state benchmark (``python bench.py
+    --pipeline``; writes BENCH_PIPELINE.json).
+
+    Measures the PT sampler's block-boundary cost on the CPU backend at
+    the flagship single-pulsar shape (334 TOAs, fixed-white GWB-style
+    config, nchains=64 x ntemps=2 = 128 walkers) in two modes sharing
+    one seed and block size:
+
+    - ``host_roundtrip`` — the seed path: full PTState crosses
+      host<->device every block, all host work (chain append,
+      checkpoint serialization, R-hat diagnostics, heartbeats) sits
+      serially in the device's idle window;
+    - ``device_resident`` — the devicestate layer: state stays on
+      device with ``donate_argnums``, host work runs double-buffered
+      behind the next dispatched block.
+
+    Small blocks on purpose: this leg prices the BLOCK BOUNDARY, so the
+    boundary must be a visible fraction of the block. Both modes run
+    the production telemetry cadence, so the comparison is the same
+    workload scheduled differently. Steady-state excludes the first
+    (compile) block; chains of the two modes are asserted bit-equal
+    (same proposals, same accepts — the refactor changes scheduling,
+    never sampling).
+    """
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                            build_pulsar_likelihood)
+    from enterprise_warp_tpu.samplers.ptmcmc import PTSampler
+    from __graft_entry__ import _flagship_single_pulsar
+
+    NCH, NT = 64, 2
+    BLOCK = int(os.environ.get("EWT_PIPELINE_BLOCK", "4"))
+    NBLOCKS = int(os.environ.get("EWT_PIPELINE_NBLOCKS", "40"))
+    nsamp = BLOCK * NBLOCKS
+
+    # fixed-white flagship (the standard GWB-search configuration,
+    # PR-1 const-Gram path): eval cost low enough that the block
+    # boundary is the measured quantity, at the flagship data shape
+    psr, _ = _flagship_single_pulsar()
+    m = StandardModels(psr=psr)
+    m.params.efac = 1.1
+    m.params.equad = -7.5
+    terms = TermList(psr, [m.efac("by_backend"), m.equad("by_backend"),
+                           m.spin_noise("powerlaw_20_nfreqs"),
+                           m.dm_noise("powerlaw_20_nfreqs")])
+
+    TRIALS = int(os.environ.get("EWT_PIPELINE_TRIALS", "2"))
+    out = {"metric": "pipeline_block_boundary",
+           "unit": "evals/s (CPU backend)",
+           "shape": f"flagship fixed-white, 334 TOAs, nchains={NCH}, "
+                    f"ntemps={NT}, block={BLOCK}, {NBLOCKS} blocks, "
+                    f"best of {TRIALS} interleaved trials"}
+    modes = (
+        # seed behavior exactly: host round trip, full-batch eval
+        ("host_roundtrip", dict(device_state=False, eval_chunk=0)),
+        # the devicestate layer at its defaults: donated resident
+        # state, double-buffered host work
+        ("device_resident", dict(device_state=True)))
+    chains, trials = {}, {m: [] for m, _ in modes}
+    # modes INTERLEAVED, best-of-TRIALS per mode: the two legs run
+    # minutes apart, and shared-host CPU contention can swing absolute
+    # throughput ~2x between them — alternating trials and taking each
+    # mode's best keeps the RATIO honest under a noisy neighbor
+    for trial in range(TRIALS):
+        for mode, kw in modes:
+            like = build_pulsar_likelihood(psr, terms)
+            with tempfile.TemporaryDirectory() as d:
+                s = PTSampler(like, d, ntemps=NT, nchains=NCH, seed=0,
+                              cov_update=BLOCK, **kw)
+                # first block: jit compile + warmup, not in steady
+                s.sample(BLOCK, resume=False, verbose=False,
+                         block_size=BLOCK)
+                s.bubble_total_s = s.host_sync_total_s = 0.0
+                s.bubble_count = 0
+                s._t_ready = None
+                t0 = time.perf_counter()
+                s.sample(nsamp, resume=True, verbose=False,
+                         block_size=BLOCK)
+                steady_s = time.perf_counter() - t0
+                if trial == 0:
+                    chains[mode] = np.loadtxt(
+                        os.path.join(d, "chain_1.txt"))
+                evals = s.W * (nsamp - BLOCK)
+                nb = max(s.bubble_count, 1)
+                trials[mode].append({
+                    "steady_evals_per_s": round(evals / steady_s, 1),
+                    "steady_wall_s": round(steady_s, 3),
+                    "bubble_mean_s": round(s.bubble_total_s / nb, 5),
+                    "bubble_total_s": round(s.bubble_total_s, 3),
+                    "host_sync_total_s": round(s.host_sync_total_s,
+                                               3),
+                    "blocks": int(nb),
+                })
+    for mode, _ in modes:
+        best = max(trials[mode],
+                   key=lambda t: t["steady_evals_per_s"])
+        out[mode] = dict(best, trials=trials[mode])
+        print(f"# {mode}: {out[mode]['steady_evals_per_s']:.0f} "
+              f"evals/s steady (best of {TRIALS}), bubble "
+              f"{1e3 * out[mode]['bubble_mean_s']:.2f} ms/block, "
+              f"sync {out[mode]['host_sync_total_s']:.2f} s total",
+              file=sys.stderr)
+
+    out["chains_bit_equal"] = bool(np.array_equal(
+        chains["host_roundtrip"], chains["device_resident"]))
+    out["speedup"] = round(
+        out["device_resident"]["steady_evals_per_s"]
+        / out["host_roundtrip"]["steady_evals_per_s"], 3)
+    out["bubble_reduction"] = round(
+        out["host_roundtrip"]["bubble_mean_s"]
+        / max(out["device_resident"]["bubble_mean_s"], 1e-9), 2)
+    # scheduling bound: with the chain's sequential dependency, wall >=
+    # block compute, so boundary elimination can at most win the
+    # baseline's bubble share. On a CPU backend host work and "device"
+    # compute also share cores, so the measured speedup tracks this
+    # bound, NOT the accelerator figure (where H2D/D2H round trips and
+    # dispatch sync make the bubble a far larger share) — record the
+    # bound so the artifact is interpretable on either.
+    h = out["host_roundtrip"]
+    out["host_boundary_fraction"] = round(
+        h["bubble_total_s"] / h["steady_wall_s"], 4)
+    out["max_scheduling_speedup"] = round(
+        h["steady_wall_s"] / (h["steady_wall_s"]
+                              - h["bubble_total_s"]), 3)
+    out["cpu_count"] = os.cpu_count()
+    print(f"# pipeline: {out['speedup']}x steady evals/s (scheduling "
+          f"bound on this backend {out['max_scheduling_speedup']}x), "
+          f"{out['bubble_reduction']}x bubble reduction, bit_equal="
+          f"{out['chains_bit_equal']}", file=sys.stderr)
+
+    out["telemetry"] = telemetry_snapshot()
+    from enterprise_warp_tpu.io.writers import atomic_write_json
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_PIPELINE.json")
+    atomic_write_json(path, dict(
+        out, measured_at=time.strftime("%Y-%m-%dT%H:%M:%S")))
+    print(json.dumps(out))
+
+
 def config_benches():
     """Per-config throughput for every BASELINE.json config (run with
     ``python bench.py --configs``; writes CONFIGS_BENCH.json). Kept out
@@ -676,11 +820,14 @@ def config_benches():
 if __name__ == "__main__":
     configs_mode = "--configs" in sys.argv
     micro_mode = "--micro" in sys.argv
+    pipeline_mode = "--pipeline" in sys.argv
     try:
         if configs_mode:
             config_benches()
         elif micro_mode:
             micro_bench()
+        elif pipeline_mode:
+            pipeline_bench()
         else:
             main()
     except Exception as e:                              # noqa: BLE001
@@ -693,6 +840,12 @@ if __name__ == "__main__":
             print(json.dumps({"metric": "evalcache_micro",
                               "unit": "evals/s (CPU backend)",
                               "cache_hit_rate": None,
+                              "error": f"{type(e).__name__}: {e}"}))
+            sys.exit(1)
+        if pipeline_mode:
+            print(json.dumps({"metric": "pipeline_block_boundary",
+                              "unit": "evals/s (CPU backend)",
+                              "speedup": None,
                               "error": f"{type(e).__name__}: {e}"}))
             sys.exit(1)
         if configs_mode:

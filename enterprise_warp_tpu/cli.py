@@ -94,6 +94,33 @@ def main(argv=None):
                              config_hash=config_hash,
                              prfile=os.path.abspath(opts.prfile),
                              label=getattr(params, "label", None)):
+        # chain-axis sharding (sampler_kwargs: ``chain_shard: N`` or
+        # ``chain_shard: 1`` for all devices): the PT walker batch
+        # spans an N-device ``chain`` mesh instead of one chip
+        # (samplers/devicestate.py). The likelihood builders ignore
+        # the chain axis, so the mesh composes with any TOA/pulsar
+        # sharding the model build applied. PT-only — the HMC/nested
+        # drivers take no mesh, so the knob must not silently pretend
+        # to shard them.
+        mesh_kw = {}
+        cs = params.sampler_kwargs.get("chain_shard") \
+            if hasattr(params, "sampler_kwargs") else None
+        pt_branch = params.sampler in ("ptmcmcsampler", "emcee",
+                                       "ptemcee")
+        if cs and not pt_branch:
+            print(f"note: chain_shard applies to the PT-MCMC branch "
+                  f"only; sampler '{params.sampler}' runs unsharded")
+        elif cs:
+            import jax
+
+            from .parallel import make_chain_mesh
+            ndev = len(jax.devices())
+            want = ndev if int(cs) == 1 else min(int(cs), ndev)
+            if want > 1:
+                mesh_kw["mesh"] = make_chain_mesh(want)
+                print(f"chain-axis sharding: walker batch over {want} "
+                      f"of {ndev} devices")
+
         if params.sampler == "ptmcmcsampler":
             like = (HyperModelLikelihood(likes) if len(likes) >= 2
                     else likes[first_id])
@@ -101,7 +128,7 @@ def main(argv=None):
                 params, "nsamp",
                 params.sampler_kwargs.get("nsamp", 1000000)))
             run_ptmcmc(like, params.output_dir, nsamp,
-                       params=params, resume=resume)
+                       params=params, resume=resume, **mesh_kw)
         elif params.sampler == "hmc":
             like = likes[first_id]
             if len(likes) > 1:
@@ -121,7 +148,7 @@ def main(argv=None):
                        int(kw.get("nsteps", 10000)),
                        params=params, resume=resume,
                        ntemps=int(kw.get("ntemps", 1)),
-                       nchains=int(kw.get("nwalkers", 64)))
+                       nchains=int(kw.get("nwalkers", 64)), **mesh_kw)
         else:
             like = likes[first_id]
             if len(likes) > 1:

@@ -406,7 +406,11 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     device computes its chunk of the O(ntoa * nbasis^2) Gram contractions
     and XLA all-reduces the small (nbasis x nbasis) partials over ICI.
     TOAs are padded (mask rows, nw=1) to a shard-divisible count; results
-    are identical to the unsharded build.
+    are identical to the unsharded build. The mesh may carry OTHER axes
+    too (a sampler's walker/``chain`` axis — see
+    ``samplers/devicestate.py``): only ``toa_axis`` is bound here, a
+    mesh without it is treated as no TOA sharding, so one mesh composes
+    data-axis sharding with chain-axis ensemble sharding.
 
     ``const_grams`` — evaluation-structure layer: when every white-noise
     parameter is fixed (Constant priors / noisefile values — the standard
@@ -453,6 +457,11 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
                          "(use 'marginalized' or 'sampled')")
 
     # --- TOA-axis padding/sharding over the mesh -----------------------
+    # a mesh without the TOA axis (e.g. a sampler chain-axis mesh, or a
+    # combined ("chain", "toa") mesh whose toa extent is 1) only shards
+    # layers that own its axes — here that means: no row sharding
+    if mesh is not None and toa_axis not in mesh.axis_names:
+        mesh = None
     from ..ops.kernel import _CHUNK
     n_pad = 0
     if mesh is not None:

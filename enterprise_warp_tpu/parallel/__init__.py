@@ -28,3 +28,12 @@ def make_toa_mesh(n_devices=None):
     Gram sharding, SURVEY §5: each device Grams its TOA chunk and XLA
     all-reduces the small (nbasis x nbasis) partials)."""
     return make_psr_mesh(n_devices, axis="toa")
+
+
+def make_chain_mesh(n_devices=None):
+    """A 1-D device mesh over the sampler walker axis (``chain``): the
+    PT ensemble's temperature x chain batch spans the mesh instead of
+    one device (``PTSampler(mesh=...)``, samplers/devicestate.py). The
+    likelihood builders ignore the ``chain`` axis (they bind only
+    ``toa``/``psr``), so this mesh can be passed to them unchanged."""
+    return make_psr_mesh(n_devices, axis="chain")

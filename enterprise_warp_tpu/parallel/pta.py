@@ -394,7 +394,11 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
     ``mesh`` — optional ``jax.sharding.Mesh`` with axis ``psr_axis``; the
     pulsar-stacked static arrays are placed with ``NamedSharding`` along it
     (pulsar count padded up to a multiple of the axis size) so the Gram
-    and per-pulsar factorization stages run one shard per device.
+    and per-pulsar factorization stages run one shard per device. A mesh
+    WITHOUT ``psr_axis`` (e.g. a sampler chain-axis mesh — see
+    ``samplers/devicestate.py``) is treated as no pulsar sharding: each
+    layer binds only the mesh axis it owns, so one mesh composes
+    pulsar-axis model sharding with chain-axis ensemble sharding.
 
     ``joint_mode`` — ``'schur'`` (nested Schur elimination, the TPU path),
     ``'dense'`` (one dense equilibrated Cholesky of the joint Sigma), or
@@ -403,6 +407,8 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
     """
     if joint_mode is None:
         joint_mode = "dense" if gram_mode == "f64" else "schur"
+    if mesh is not None and psr_axis not in mesh.axis_names:
+        mesh = None                 # no pulsar axis -> no model sharding
     npsr_real = len(psrs)
     if npsr_real != len(termlists):
         raise ValueError("one TermList per pulsar required")

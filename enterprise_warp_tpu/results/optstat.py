@@ -131,7 +131,8 @@ def make_os_fn(psrs, termlists, fixed_values=None, gamma_gw=_GAMMA_GW):
             sigs.append(1.0 / jnp.sqrt(den))
         return jnp.stack(rhos), jnp.stack(sigs)
 
-    return jax.jit(os_pairs), pairs, xi, sampled
+    from ..utils.telemetry import traced
+    return traced(os_pairs, name="optstat.os_pairs"), pairs, xi, sampled
 
 
 def combine_os(rho, sig, xi, orf_name, pos):
@@ -230,7 +231,11 @@ class OptimalStatisticWarp(EnterpriseWarpResult):
         nmarg = min(int(self.opts.optimal_statistic_nsamples), len(draws))
         rng = np.random.default_rng(0)
         sel = rng.choice(len(draws), size=nmarg, replace=False)
-        marg_fn = jax.jit(jax.vmap(fn))
+        from ..utils.telemetry import traced
+        # vmap the underlying jitted fn, not the traced wrapper (whose
+        # host-side retrace bookkeeping must not run under tracing)
+        marg_fn = traced(jax.vmap(getattr(fn, "_jitted", fn)),
+                         name="optstat.os_pairs_batch")
         rho_m, sig_m = (np.asarray(v)
                         for v in marg_fn(jnp.asarray(draws[sel])))
 

@@ -112,8 +112,10 @@ class NoiseReconstructor:
                     out[name] = D_phys_j[:, sl] @ c[sl]
             return out
 
-        self._realize = jax.jit(realize)
-        self._realize_batch = jax.jit(jax.vmap(realize))
+        from ..utils.telemetry import traced
+        self._realize = traced(realize, name="reconstruct.realize")
+        self._realize_batch = traced(jax.vmap(realize),
+                                     name="reconstruct.realize_batch")
 
     # -------------------------------------------------------------- #
     def theta_from_dict(self, values: dict) -> np.ndarray:

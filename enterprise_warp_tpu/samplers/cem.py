@@ -94,7 +94,6 @@ def fit_cem(like, rounds=None, batch=256, inflate=1.5, seed=0,
     ``lnZ_err`` (mixture-IS evidence estimate), ``rounds_used``,
     ``ess_is`` (final full-history mixture ESS) and ``best_lnpost``.
     """
-    import jax
     import jax.numpy as jnp
 
     if rounds is not None:
@@ -102,7 +101,8 @@ def fit_cem(like, rounds=None, batch=256, inflate=1.5, seed=0,
         refine_rounds = max(rounds - search_rounds, 2)
     nd = like.ndim
     rng = np.random.default_rng(seed)
-    lnp_batch = jax.jit(jax.vmap(like.log_prior))
+    from .evalproto import prior_protocol
+    lnp_batch = prior_protocol(like)
 
     def eval_batch(x):
         lnl = np.asarray(like.loglike_batch(jnp.asarray(x)))

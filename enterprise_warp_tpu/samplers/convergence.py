@@ -246,7 +246,16 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
             rec.heartbeat(phase="convergence_check", step=int(steps),
                           rhat=s["_worst"]["rhat"],
                           ess=s["_worst"]["ess"],
-                          wall_s=round(time.perf_counter() - t_start, 2))
+                          wall_s=round(time.perf_counter() - t_start, 2),
+                          # cumulative block-boundary accounting from
+                          # the driven sampler (device-resident state
+                          # layer): how much wall the device spent idle
+                          # between blocks, and how much the host spent
+                          # blocked on device syncs
+                          bubble_s=round(getattr(
+                              sampler, "bubble_total_s", 0.0), 3),
+                          host_sync_s=round(getattr(
+                              sampler, "host_sync_total_s", 0.0), 3))
             if verbose:
                 _log.info("step %d: rhat_max=%.4f ess_min=%.0f",
                           steps, rh, es)

@@ -1,0 +1,145 @@
+"""Device-resident sampler state: pytree placement, donation-safe
+snapshots, and the double-buffered host pipeline.
+
+The seed samplers round-trip the ENTIRE ensemble state — walkers,
+lnl/lnp, RNG keys, the ``(HISTORY, ndim)`` DE buffer — host<->device
+through ``jnp.asarray``/``np.asarray`` once per block, with every byte
+of host work (chain-file appends, checkpoint serialization, R-hat
+diagnostics) sitting serially inside the device's idle window. The
+GPU-native PTA/GW samplers this package chases (PAPERS.md:
+blackjax-ns-style batched NS, discovery-style PTA analysis) get their
+throughput by keeping ensemble state resident on the accelerator
+between kernel launches. This module is the shared plumbing for that
+discipline:
+
+- :func:`host_snapshot` — a donation-safe host copy of a state pytree:
+  async D2H prefetch for every leaf, then materialization, all BEFORE
+  the buffers are donated into the next dispatched block. What the
+  checkpoint writer serializes (off the critical path) is this
+  snapshot, never the live device buffers;
+- :class:`HostPipeline` — the double-buffer: per-block host work
+  (file appends, ``state.npz`` serialization, telemetry heartbeats,
+  throttled diagnostics) is deferred and executed AFTER the next block
+  has been dispatched, so the device computes block ``k+1`` while the
+  host folds block ``k``. Strictly ordered, explicitly flushed.
+- :func:`chain_sharding` — ``NamedSharding`` specs for walker-axis
+  arrays over a mesh's chain axis, composing with the existing
+  TOA/pulsar-axis consts sharding (``models/build.py``,
+  ``parallel/pta.py``): one mesh may carry both axes and each layer
+  binds only the axis it owns.
+
+Donation invariants (see ``docs/performance.md``): after a donated
+dispatch the previous block's buffers are DEAD — every host-side reader
+(checkpointing, covariance adaptation, ensemble refits, heartbeats)
+must consume the snapshot taken at commit time, never ``st`` leaves.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["host_snapshot", "chain_sharding", "resolve_placement",
+           "place_resident", "HostPipeline"]
+
+
+def chain_sharding(mesh, axis="chain"):
+    """``(vec_sharding, mat_sharding)`` for walker-axis arrays: ``(W,)``
+    leaves shard along ``axis``, ``(W, ndim)`` leaves along
+    ``(axis, None)``. ``mesh`` may carry other axes (TOA, pulsar) —
+    those stay replicated here, so one mesh composes sampler-side
+    chain sharding with the likelihood's consts sharding. Returns
+    ``(None, None)`` when ``mesh`` is None or lacks ``axis``."""
+    if mesh is None or axis not in mesh.axis_names:
+        return None, None
+    from jax.sharding import NamedSharding, PartitionSpec
+    return (NamedSharding(mesh, PartitionSpec(axis)),
+            NamedSharding(mesh, PartitionSpec(axis, None)))
+
+
+def resolve_placement(consts):
+    """Placement for non-chain-sharded resident state: the first
+    device normally, but REPLICATED over the likelihood's mesh when
+    its consts are mesh-sharded (TOA/pulsar axis) — a single-device
+    commit alongside multi-device consts is an invalid jit. Shared by
+    the PT and HMC donation paths; resolve once per sampler."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(consts):
+        sh = getattr(leaf, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is not None and len(sh.device_set) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            return NamedSharding(mesh, PartitionSpec())
+    return jax.devices()[0]
+
+
+def place_resident(v, placement):
+    """Committed device placement for one DONATED state leaf: a
+    pass-through for arrays already resident (the steady state), an
+    explicit committed upload for host numpy (fresh/loaded state).
+    Consistent commitment keeps every block call on one jit cache
+    entry (first call = numpy, later calls = committed outputs). The
+    upload is ``jnp.array`` — a REAL copy, because these leaves are
+    donated: a zero-copy import aliasing caller-owned numpy memory
+    would let XLA overwrite and free memory it does not own."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(v, jax.Array):
+        return v                # resident — no placement dispatch
+    return jax.device_put(jnp.array(v), placement)
+
+
+def host_snapshot(tree):
+    """Donation-safe host copy of a pytree of (device or host) arrays.
+
+    Enqueues a non-blocking D2H copy for every device leaf first, then
+    materializes numpy arrays — so the transfers overlap each other,
+    and the result is plain host memory that stays valid after the
+    leaves are donated into the next dispatched block. MUST be called
+    before that dispatch.
+
+    Device leaves are copied with ``np.array`` (a REAL copy, never a
+    view): on the CPU backend ``np.asarray(jax_array)`` can be
+    zero-copy, and a view into a buffer that a later donated dispatch
+    overwrites in place is silent corruption followed by a heap crash —
+    the exact failure this snapshot exists to prevent."""
+    for v in tree.values():
+        prefetch = getattr(v, "copy_to_host_async", None)
+        if prefetch is not None:
+            prefetch()
+    return {k: (np.array(v) if hasattr(v, "copy_to_host_async")
+                else np.asarray(v))
+            for k, v in tree.items()}
+
+
+class HostPipeline:
+    """One-deep deferred host-work queue — the double buffer.
+
+    ``defer(fn)`` parks one block's host work; ``run_pending()`` is
+    called immediately AFTER the next block's dispatch so ``fn`` runs
+    while the device computes. ``flush()`` drains the queue (end of
+    run, or before any operation that must observe completed writes —
+    resume, convergence checks on the output files). Work runs in
+    defer order, exactly once, even when callbacks raise."""
+
+    def __init__(self, enabled=True):
+        self.enabled = enabled
+        self._pending = None
+
+    def defer(self, fn):
+        """Queue ``fn``; with the pipeline disabled (the host-roundtrip
+        baseline) it runs synchronously instead."""
+        if not self.enabled:
+            fn()
+            return
+        self.run_pending()          # strict ordering: one in flight
+        self._pending = fn
+
+    def run_pending(self):
+        fn, self._pending = self._pending, None
+        if fn is not None:
+            fn()
+
+    def flush(self):
+        self.run_pending()

@@ -67,6 +67,21 @@ def eval_protocol(like):
             ())
 
 
+def prior_protocol(like, name=None):
+    """A traced, vmapped batch log-prior for ``like`` — the shared
+    prior-evaluation jit of the PT/HMC/CEM drivers. Routing it through
+    :func:`utils.telemetry.traced` keeps the traced-jit contract (every
+    hot jit's compiles/retraces are counted) instead of each sampler
+    re-rolling a bare ``jax.jit`` of the same function."""
+    import jax
+
+    from ..utils.telemetry import traced
+
+    label = name or type(like).__name__
+    return traced(jax.vmap(like.log_prior),
+                  name=f"{label}.log_prior_batch")
+
+
 def install_protocol(like, eval_fn, consts, public=True, name=None):
     """Install the protocol attributes on ``like`` from a pure
     ``eval_fn(theta, consts)``: sets ``consts``/``_eval``/``_eval_batch``

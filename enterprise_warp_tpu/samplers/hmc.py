@@ -33,6 +33,7 @@ atomic ``state.npz`` checkpoint for resume.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 
 import jax
@@ -75,7 +76,8 @@ class HMCSampler:
 
     def __init__(self, like, outdir, nchains=64, seed=0, n_leapfrog=16,
                  target_accept=0.8, warmup=1000, init_eps=0.1,
-                 eps_jitter=0.1, jitter_L=True, mass0=None, z0=None):
+                 eps_jitter=0.1, jitter_L=True, mass0=None, z0=None,
+                 device_state=None):
         """``jitter_L``: draw the trajectory length uniformly in
         [n_leapfrog/2, n_leapfrog] each step (shared across the batch) —
         breaks periodic orbits like NUTS's dynamic termination does, at
@@ -100,6 +102,23 @@ class HMCSampler:
         self.mass0 = None if mass0 is None else np.asarray(mass0, float)
         self.z0 = None if z0 is None else np.asarray(z0, float)
         self.seed = seed
+        # device-resident ensemble state (samplers/devicestate.py):
+        # positions/key/acceptance stay on the accelerator between
+        # blocks and are donated into each block jit (in-place update);
+        # EWT_DEVICE_STATE=0 or device_state=False restores the seed
+        # host round trip bit-for-bit
+        if device_state is None:
+            device_state = os.environ.get("EWT_DEVICE_STATE", "1") != "0"
+        self.device_state = bool(device_state)
+        self._dev0 = None
+        self._t_ready = None
+        self.host_sync_total_s = 0.0
+        self.bubble_total_s = 0.0
+        self.bubble_count = 0
+        self._last_sync_s = 0.0
+        self._last_bubble_s = 0.0
+        self._g_sync = telemetry.registry().gauge("host_sync_wall_s")
+        self._g_bubble = telemetry.registry().gauge("block_bubble_s")
 
         # shared z-space target (samplers/transform.py): prior absorbed
         # by the sigmoid + unit-cube transform, -inf on solve failures
@@ -116,12 +135,17 @@ class HMCSampler:
             g = jnp.where(jnp.isfinite(g), g, 0.0)
             return (lp, lnl), g
 
+        # traced jits (telemetry contract: every hot jit's compiles and
+        # retraces are counted — no bare jax.jit in sampler code)
         self._vgrad_pure = jax.vmap(vgrad_fn, in_axes=(0, None))
-        self._logp_batch = jax.jit(jax.vmap(
-            lambda z, consts: logp_z(z, consts)[0], in_axes=(0, None)))
-        self._lnprior_batch = jax.jit(jax.vmap(like.log_prior))
-        self._from_unit_batch = jax.jit(
-            lambda z: like.from_unit(jax.nn.sigmoid(z)))
+        self._logp_batch = telemetry.traced(jax.vmap(
+            lambda z, consts: logp_z(z, consts)[0], in_axes=(0, None)),
+            name="hmc_logp_batch")
+        from .evalproto import prior_protocol
+        self._lnprior_batch = prior_protocol(like)
+        self._from_unit_batch = telemetry.traced(
+            lambda z: like.from_unit(jax.nn.sigmoid(z)),
+            name="hmc_from_unit_batch")
         os.makedirs(outdir, exist_ok=True)
 
     # ---------------- init / checkpoint -------------------------------- #
@@ -290,9 +314,29 @@ class HMCSampler:
                     lnls, jnp.mean(p_accs), ngrad)
 
         # traced jit: each (block size, adapt) pair is a separate trace;
-        # the telemetry makes that retrace pattern visible per run
+        # the telemetry makes that retrace pattern visible per run.
+        # Device-resident mode donates the persistent ensemble buffers
+        # (z, key, cumulative acceptance — args 0, 1, 6) so XLA updates
+        # them in place; ``_place`` guarantees they are XLA-owned
+        # copies (a donated zero-copy numpy import is heap corruption).
+        # mass (5) is rebuilt on host at the warmup boundary and the
+        # scalars are host floats.
+        donate = (0, 1, 6) if self.device_state else ()
         return telemetry.traced(
-            block, name=f"hmc_block_{'adapt' if adapt else 'sample'}")
+            block, name=f"hmc_block_{'adapt' if adapt else 'sample'}",
+            donate_argnums=donate)
+
+    def _place(self, v):
+        """Committed device placement for a donated state leaf
+        (:func:`devicestate.place_resident`, consts-aware default via
+        :func:`devicestate.resolve_placement`); plain ``asarray`` in
+        the seed host-round-trip mode."""
+        if not self.device_state:
+            return jnp.asarray(v)
+        from .devicestate import place_resident, resolve_placement
+        if self._dev0 is None:
+            self._dev0 = resolve_placement(self._consts)
+        return place_resident(v, self._dev0)
 
     # ---------------- public API --------------------------------------- #
     def sample(self, nsamp, resume=True, verbose=True, block_size=100,
@@ -365,22 +409,44 @@ class HMCSampler:
                 blocks[bkey] = self._make_block(todo, adapt)
             (z, key, log_eps, log_eps_bar, h_bar, acc, ndiv, zs, lnls,
              mean_acc, ngrad) = blocks[bkey](
-                jnp.asarray(st.z), jnp.asarray(st.key), st.log_eps,
+                self._place(st.z), self._place(st.key), st.log_eps,
                 st.log_eps_bar, st.h_bar, jnp.asarray(st.mass),
-                jnp.asarray(st.accepted), st.divergences, st.da_iter,
+                self._place(st.accepted), st.divergences, st.da_iter,
                 st.mu, st.ngrad, self._consts)
-            st.z = np.asarray(z)
-            st.key = np.asarray(key)
+            # block-boundary bubble: previous results landed ->
+            # this dispatch handed the device new work
+            now = time.perf_counter()
+            if self._t_ready is not None:
+                self._last_bubble_s = now - self._t_ready
+                self.bubble_total_s += self._last_bubble_s
+                self.bubble_count += 1
+                self._g_bubble.set(self._last_bubble_s)
+                self._t_ready = None
+            t_sync0 = time.perf_counter()
+            if self.device_state:
+                # ensemble buffers stay device-resident (and are
+                # donated into the next block); only the emissions and
+                # scalars cross to host below
+                st.z, st.key, st.accepted = z, key, acc
+            else:
+                st.z = np.asarray(z)
+                st.key = np.asarray(key)
+                st.accepted = np.asarray(acc)
             st.log_eps = float(log_eps)
             st.log_eps_bar = float(log_eps_bar)
             st.h_bar = float(h_bar)
-            st.accepted = np.asarray(acc)
             st.divergences = int(ndiv)
             st.ngrad = int(ngrad)
             st.step += todo
             if adapt:
                 st.da_iter += todo
             mean_acc = float(mean_acc)
+            # the scalar conversions above forced the host sync — the
+            # device is idle from here until the next block dispatch
+            self._last_sync_s = time.perf_counter() - t_sync0
+            self.host_sync_total_s += self._last_sync_s
+            self._g_sync.set(self._last_sync_s)
+            self._t_ready = time.perf_counter()
 
             if st.step <= mass_at and st.step > self.warmup // 4:
                 # collect warmup positions for the diagonal mass
@@ -436,6 +502,8 @@ class HMCSampler:
                           evals_per_s=round(meter.window_rate(), 1),
                           evals_total=int(meter.total),
                           cache_hit_rate=0.0,
+                          host_sync_wall_s=round(self._last_sync_s, 4),
+                          block_bubble_s=round(self._last_bubble_s, 4),
                           warmup=bool(adapt))
                 worst = self._block_diag(
                     thetas.reshape(todo, self.W, self.ndim), diag_t)
@@ -477,6 +545,8 @@ def run_hmc(like, outdir, nsamp, params=None, resume=True, seed=0,
             advi_init = bool(int(skw["advi_init"]))
         if "jitter_L" in skw:
             opts["jitter_L"] = bool(int(skw["jitter_L"]))
+        if "device_state" in skw:
+            opts["device_state"] = bool(int(skw["device_state"]))
     opts.update(kw)
     if advi_init and "mass0" not in opts and \
             not (resume and os.path.exists(

@@ -203,8 +203,15 @@ def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
                 lnz, ln_x, delta)
 
     # traced jit: one trace per (nlive, kbatch, nsteps) geometry — a
-    # retrace mid-run means the configuration changed under the sampler
-    return telemetry.traced(iteration, name="nested_iteration")
+    # retrace mid-run means the configuration changed under the sampler.
+    # The live-point state (u, lnl, key — args 0-2) is donated: it
+    # never leaves the device between iterations, and XLA reuses the
+    # buffers in place instead of allocating a second live set per
+    # call (EWT_DEVICE_STATE=0 restores the copying path).
+    donate = (0, 1, 2) \
+        if os.environ.get("EWT_DEVICE_STATE", "1") != "0" else ()
+    return telemetry.traced(iteration, name="nested_iteration",
+                            donate_argnums=donate)
 
 
 def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
@@ -330,6 +337,17 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
             nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
             params_fp=_params_fingerprint(like))
         os.replace(tmp, ckpt_path)
+
+    # commit the live-point state once: the first iteration call (fresh
+    # uniform draws / checkpoint load, uncommitted) must hit the same
+    # jit cache entry as every later call (committed iteration
+    # outputs). jnp.array = REAL copy — these arrays are donated into
+    # the iteration jit, so they must be XLA-owned buffers, never
+    # zero-copy imports of the checkpoint's numpy memory.
+    _dev0 = jax.devices()[0]
+    u = jax.device_put(jnp.array(u), _dev0)
+    lnl = jax.device_put(jnp.array(lnl), _dev0)
+    rng_key = jax.device_put(jnp.array(rng_key), _dev0)
 
     converged = False
     with telemetry.run_scope(outdir, sampler="nested", label=label,

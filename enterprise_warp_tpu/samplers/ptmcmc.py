@@ -89,13 +89,74 @@ class PTSampler:
                  write_hot_chains=False, init_x=None,
                  ind_weight=0, ind_inflate=1.4,
                  cg_weight=0, cg_k=3, cg_group_frac=0.5,
-                 kde_weight=0, kde_bw=None, ns_weight=0):
+                 kde_weight=0, kde_bw=None, ns_weight=0,
+                 device_state=None, mesh=None, chain_axis="chain",
+                 eval_chunk=None):
         self.like = like
         self.outdir = outdir
         self.ntemps = ntemps
         self.nchains = nchains
         self.W = ntemps * nchains
         self.ndim = like.ndim
+        # device-resident sampler state (samplers/devicestate.py): the
+        # big ensemble buffers (walkers, lnl/lnp, RNG key, DE history)
+        # stay on the accelerator between blocks, the block jit takes
+        # and returns them with donate_argnums (XLA updates them in
+        # place), and the per-block host work runs double-buffered
+        # behind the next dispatched block. ``device_state=False``
+        # restores the seed host-round-trip path bit-for-bit
+        # (EWT_DEVICE_STATE=0 flips the default).
+        if device_state is None:
+            device_state = os.environ.get("EWT_DEVICE_STATE", "1") != "0"
+        self.device_state = bool(device_state)
+        # chain-axis sharding: a mesh whose ``chain_axis`` spans >= 2
+        # devices shards every walker-indexed array over it, so the
+        # ensemble batch spans the mesh instead of one device. Composes
+        # with the TOA/pulsar consts sharding (models/build.py,
+        # parallel/pta.py): one mesh may carry both axes, each layer
+        # binds only its own.
+        from .devicestate import chain_sharding
+        self._vec_shard, self._mat_shard = chain_sharding(mesh,
+                                                          chain_axis)
+        self._rep_shard = None
+        if self._vec_shard is not None:
+            ndev = mesh.shape[chain_axis]
+            if self.W % ndev:
+                raise ValueError(
+                    f"chain-axis sharding needs ntemps*nchains divisible "
+                    f"by the mesh '{chain_axis}' axis: W={self.W} over "
+                    f"{ndev} devices")
+            from jax.sharding import NamedSharding, PartitionSpec
+            # non-walker arrays replicate over the whole mesh: a
+            # single-device commit would conflict with the sharded
+            # walker args inside one jitted computation
+            self._rep_shard = NamedSharding(mesh, PartitionSpec())
+        # block-boundary telemetry (satellite: host_sync_wall_s /
+        # block_bubble_s): cumulative + last-block figures, surfaced in
+        # heartbeats, the registry gauges, and bench.py --pipeline
+        self.host_sync_total_s = 0.0
+        self.bubble_total_s = 0.0
+        self.bubble_count = 0
+        self._last_sync_s = 0.0
+        self._last_bubble_s = 0.0
+        self._t_ready = None
+        self._last_snap = None
+        self._dev0 = None
+        self._g_sync = telemetry.registry().gauge("host_sync_wall_s")
+        self._g_bubble = telemetry.registry().gauge("block_bubble_s")
+        # walker-batch micro-chunking of the in-block likelihood eval
+        # (EWT_EVAL_CHUNK / eval_chunk=N evaluates the W batch as
+        # sequential N-walker lax.map chunks). Default OFF: the
+        # isolated kernel shows a CPU cache cliff past ~64 walkers
+        # (batch-128 ~1.05k evals/s vs 2x64 chunks ~1.35k), but inside
+        # the compiled block XLA's fusion already recovers it (measured
+        # no in-situ win) and the chunked lowering is not bitwise
+        # identical in situ — kept as an explicit knob for other
+        # hardware, never a silent default.
+        if eval_chunk is None:
+            eval_chunk = int(os.environ.get("EWT_EVAL_CHUNK", "0"))
+        self.eval_chunk = 0 if self._vec_shard is not None \
+            else int(eval_chunk)
         # noise-budget slide (family 7): moves ALONG each backend's
         # efac/equad degeneracy curve v = efac^2 sigma_bar^2 + equad^2
         # (redraw the equad fraction of v uniformly, exact Jacobian
@@ -169,8 +230,8 @@ class PTSampler:
         # re-drawn from the prior by _fresh_state's existing guard
         self.init_x = None if init_x is None else np.atleast_2d(
             np.asarray(init_x, dtype=float))
-        self._lnprior_batch = jax.jit(jax.vmap(
-            lambda t: like.log_prior(t)))
+        from .evalproto import prior_protocol
+        self._lnprior_batch = prior_protocol(like)
         self._compiled_block = None
         self._block_steps = -1
         # per-family (scam, am, de, prior, ind, cgibbs, kde, ns)
@@ -240,17 +301,15 @@ class PTSampler:
     def _ckpt_path(self):
         return os.path.join(self.outdir, "state.npz")
 
-    def _save_state(self, st: PTState):
+    def _write_ckpt(self, payload):
+        """Serialize one checkpoint payload (donation-safe host arrays,
+        assembled eagerly at the host-sync point in the sample loop —
+        never live device leaves). Atomic: a kill mid-savez must not
+        corrupt the checkpoint the next attempt resumes from."""
         if not _is_primary():
             return
-        # atomic write: a kill mid-savez must not corrupt the checkpoint
-        # the next attempt resumes from
         tmp = self._ckpt_path + ".tmp.npz"
-        np.savez(tmp, x=st.x, lnl=st.lnl, lnp=st.lnp,
-                 key=st.key, cov=st.cov, history=st.history,
-                 hist_len=st.hist_len, step=st.step,
-                 accepted=st.accepted, swaps_accepted=st.swaps_accepted,
-                 swaps_proposed=st.swaps_proposed, ladder=st.ladder)
+        np.savez(tmp, **payload)
         os.replace(tmp, self._ckpt_path)
 
     def _load_state(self):
@@ -289,6 +348,16 @@ class PTSampler:
         like = self.like
         from .evalproto import eval_protocol
         batch_eval, _, self._consts = eval_protocol(like)
+        ck = self.eval_chunk
+        if ck > 0 and self.W > ck and self.W % ck == 0:
+            # cache-blocked evaluation (see __init__): sequential
+            # ck-walker chunks, bit-identical to the full-batch call
+            full_eval, nchunks = batch_eval, self.W // ck
+
+            def batch_eval(thetas, consts):      # noqa: F811
+                tc = thetas.reshape(nchunks, ck, thetas.shape[-1])
+                return jax.lax.map(
+                    lambda t: full_eval(t, consts), tc).reshape(-1)
         log_prior_dims = self._log_prior_dims
         jump_p = jnp.asarray(self.jump_probs)
         W, nd = self.W, self.ndim
@@ -641,24 +710,30 @@ class PTSampler:
 
         # traced jit: a block retrace (new block size, new walker
         # count) is the dominant stall of a PT run — count it and emit
-        # a compile event instead of stalling silently
-        return telemetry.traced(block, name="ptmcmc_block")
+        # a compile event instead of stalling silently.
+        # Donation (device-resident mode): the persistent state buffers
+        # (x, lnl, lnp, key, history — args 0-4) are donated, so XLA
+        # aliases the outputs onto the inputs and the walker/history
+        # buffers are updated in place — no second copy of the ensemble
+        # state lives on device across a block call. ONLY these: a
+        # donated buffer must be XLA-owned (``_place`` guarantees it),
+        # never a zero-copy import of host numpy — XLA overwriting and
+        # freeing memory the numpy allocator owns is heap corruption.
+        # The per-block counter/statics uploads (6-22) are tiny
+        # zero-copy imports and stay undonated; hist_len (5) is a host
+        # int; consts (23) must stay alive for the likelihood.
+        donate = (0, 1, 2, 3, 4) if self.device_state else ()
+        return telemetry.traced(block, name="ptmcmc_block",
+                                donate_argnums=donate)
 
     # ---------------- block execution ---------------------------------- #
-    def _run_block(self, st, todo, temps=None):
-        """Advance ``st`` by ``todo`` steps through the compiled block.
-
-        Host-side per-block work: eigendecomposition of the adapted
-        covariance, ensemble fits for the independence/conditional-Gibbs
-        proposals, the device call, and the state update. ``temps``
-        overrides the ladder-derived per-walker temperatures (used by
-        :meth:`anneal_init` to run the whole ensemble tempered).
-        Returns the block's ``(positions, lnl, lnp)`` emissions."""
-        if self._compiled_block is None or self._block_steps != todo:
-            self._block = self._make_block(todo)
-            self._block_steps = todo
-            self._compiled_block = True
-
+    def _host_prep(self, st):
+        """Per-block host math on the dispatch critical path: eigh of
+        the adapted covariance plus the ensemble fits for the
+        independence/conditional-Gibbs/KDE families. Reads only host
+        numpy (``st.cov`` and the cold-walker cloud snapshot) so the
+        result is bit-identical whether the ensemble state lives on
+        host or device."""
         # eigendecomposition of the adapted covariance (host side)
         cov = st.cov + 1e-12 * np.eye(self.ndim)
         eigvals, eigvecs = np.linalg.eigh(cov)
@@ -671,7 +746,7 @@ class PTSampler:
         # Degenerate clouds (fresh identical walkers, tiny nchains)
         # fall back to the adapted covariance above.
         if self.jump_probs[4:].sum() > 0:
-            cold_x = st.x[:self.nchains]
+            cold_x = self._x_host(st)[:self.nchains]
             ind_mean = cold_x.mean(axis=0)
             ind_cov = cov
             if self.nchains > 2 * self.ndim:
@@ -708,38 +783,164 @@ class PTSampler:
             cg_rows = np.tile(np.arange(self.cg_k), (self.ndim, 1))
             kde_pts = np.zeros((1, self.ndim))
             kde_bw = np.ones(self.ndim)
+        return (eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL, lam,
+                cg_rows, kde_pts, kde_bw)
 
+    def _x_host(self, st):
+        """Host numpy view of the walker positions. Host-resident
+        ``st.x`` (fresh/loaded/annealed state) wins; a device-resident
+        ``st.x`` is read through the commit-time snapshot instead of a
+        second D2H fetch."""
+        if isinstance(st.x, np.ndarray):
+            return st.x
+        if self._last_snap is not None:
+            return self._last_snap["x"]
+        return np.asarray(st.x)
+
+    def _default_placement(self):
+        """Shared consts-aware placement for non-chain-sharded state
+        (:func:`devicestate.resolve_placement`), resolved once after
+        the block build bound ``_consts``."""
+        if self._dev0 is None:
+            from .devicestate import resolve_placement
+            self._dev0 = resolve_placement(self._consts)
+        return self._dev0
+
+    def _place(self, v, shard=None):
+        """Placement for one donated state leaf (see
+        :func:`devicestate.place_resident`); plain ``asarray``
+        reproduces the seed path in host mode."""
+        if not self.device_state:
+            return jnp.asarray(v)
+        from .devicestate import place_resident
+        if shard is None:
+            shard = self._rep_shard
+        if shard is None:
+            shard = self._default_placement()
+        return place_resident(v, shard)
+
+    def _dispatch_block(self, st, todo, temps=None):
+        """Compile (once per block size), run the host-side prep, and
+        dispatch one block — returning the raw device outputs WITHOUT
+        waiting for them (JAX async dispatch: the host is free to fold
+        the previous block's diagnostics while the device runs)."""
+        import time
+        if self._compiled_block is None or self._block_steps != todo:
+            self._block = self._make_block(todo)
+            self._block_steps = todo
+            self._compiled_block = True
+
+        prep = self._host_prep(st)
+        (eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL, lam,
+         cg_rows, kde_pts, kde_bw) = prep
         if temps is None:
             temps = np.repeat(st.ladder, self.nchains)
-        carry, cold, cold_lnl, cold_lnp = self._block(
-            jnp.asarray(st.x), jnp.asarray(st.lnl),
-            jnp.asarray(st.lnp), jnp.asarray(st.key),
-            jnp.asarray(st.history), st.hist_len,
-            jnp.asarray(st.accepted), jnp.asarray(st.swaps_accepted),
-            jnp.asarray(st.swaps_proposed),
-            jnp.asarray(self.fam_accept),
-            jnp.asarray(self.fam_propose),
-            jnp.asarray(self.mask_counts), jnp.asarray(eigvecs),
-            jnp.asarray(eigvals), jnp.asarray(chol),
-            jnp.asarray(ind_mean), jnp.asarray(ind_L),
-            jnp.asarray(ind_iL), jnp.asarray(lam),
-            jnp.asarray(cg_rows), jnp.asarray(kde_pts),
-            jnp.asarray(kde_bw), jnp.asarray(temps), self._consts)
+        # per-block host-built arrays: uploaded in ONE batched
+        # device_put (one dispatch, not ~17) in device-resident mode;
+        # plain asarray reproduces the seed path otherwise
+        host_in = (st.accepted, st.swaps_accepted, st.swaps_proposed,
+                   self.fam_accept, self.fam_propose, self.mask_counts,
+                   eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
+                   lam, cg_rows, kde_pts, kde_bw, temps)
+        if self.device_state:
+            vs, rep = self._vec_shard, self._rep_shard
+            if vs is None:
+                vs = rep = self._default_placement()
+            shards = (vs,) + (rep,) * 15 + (vs,)
+            placed = jax.device_put(host_in, shards)
+        else:
+            placed = tuple(jnp.asarray(v) for v in host_in)
+        (acc_in, sacc_in, sprop_in, fam_a_in, fam_p_in, mask_in,
+         eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
+         lam, cg_rows, kde_pts, kde_bw, temps_in) = placed
+        out = self._block(
+            self._place(st.x, self._mat_shard),
+            self._place(st.lnl, self._vec_shard),
+            self._place(st.lnp, self._vec_shard), self._place(st.key),
+            self._place(st.history), st.hist_len,
+            acc_in, sacc_in, sprop_in, fam_a_in, fam_p_in, mask_in,
+            eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
+            lam, cg_rows, kde_pts, kde_bw, temps_in, self._consts)
+        # block-boundary bubble: host wall between the previous block's
+        # results landing (device went idle) and this dispatch handing
+        # the device new work
+        now = time.perf_counter()
+        if self._t_ready is not None:
+            b = now - self._t_ready
+            self._last_bubble_s = b
+            self.bubble_total_s += b
+            self.bubble_count += 1
+            self._g_bubble.set(b)
+            self._t_ready = None
+        return out
+
+    def _commit_block(self, st, out, todo):
+        """Wait for one dispatched block, take the donation-safe host
+        snapshot (the ONLY host copy of the ensemble state this block —
+        checkpointing, adaptation, and chain writes all read it), and
+        rebind the state leaves. Device-resident mode keeps the live
+        leaves as the device outputs (donated into the next dispatch);
+        host mode rebinds the numpy snapshot, reproducing the seed
+        round-trip exactly. Returns ``(snap, cold, cold_lnl,
+        cold_lnp)`` with everything host-side."""
+        import time
+
+        from .devicestate import host_snapshot
+        carry, cold, cold_lnl, cold_lnp = out
         (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
          fam_acc, fam_prop, mask_counts, *_unused) = carry
-        self.fam_accept = np.asarray(fam_acc)
-        self.fam_propose = np.asarray(fam_prop)
-        self.mask_counts = np.asarray(mask_counts)
-        st.x = np.asarray(x)
-        st.lnl = np.asarray(lnl)
-        st.lnp = np.asarray(lnp)
-        st.key = np.asarray(key)
-        st.history = np.asarray(hist)
+        t0 = time.perf_counter()
+        snap = host_snapshot(dict(
+            x=x, lnl=lnl, lnp=lnp, key=key, history=hist, accepted=acc,
+            swaps_accepted=sacc, swaps_proposed=sprop,
+            fam_accept=fam_acc, fam_propose=fam_prop,
+            mask_counts=mask_counts, cold=cold, cold_lnl=cold_lnl,
+            cold_lnp=cold_lnp))
+        self._t_ready = time.perf_counter()
+        self._last_sync_s = self._t_ready - t0
+        self.host_sync_total_s += self._last_sync_s
+        self._g_sync.set(self._last_sync_s)
+        if self.device_state:
+            st.x, st.lnl, st.lnp, st.key, st.history = \
+                x, lnl, lnp, key, hist
+            self._last_snap = snap
+        else:
+            st.x = snap["x"]
+            st.lnl = snap["lnl"]
+            st.lnp = snap["lnp"]
+            st.key = snap["key"]
+            st.history = snap["history"]
         st.hist_len = int(min(st.hist_len + todo, _HISTORY))
-        st.accepted = np.asarray(acc)
-        st.swaps_accepted = np.asarray(sacc, dtype=float)
-        st.swaps_proposed = np.asarray(sprop, dtype=float)
+        st.accepted = snap["accepted"]
+        st.swaps_accepted = np.asarray(snap["swaps_accepted"],
+                                       dtype=float)
+        st.swaps_proposed = np.asarray(snap["swaps_proposed"],
+                                       dtype=float)
+        self.fam_accept = snap["fam_accept"]
+        self.fam_propose = snap["fam_propose"]
+        self.mask_counts = snap["mask_counts"]
         st.step += todo
+        return snap, snap["cold"], snap["cold_lnl"], snap["cold_lnp"]
+
+    def _run_block(self, st, todo, temps=None):
+        """Advance ``st`` by ``todo`` steps (dispatch + commit in one
+        synchronous call — the compatibility surface for
+        :meth:`anneal_init` and other out-of-pipeline callers, which
+        expect host-readable state afterwards). ``temps`` overrides the
+        ladder-derived per-walker temperatures. Returns the block's
+        ``(positions, lnl, lnp)`` emissions."""
+        out = self._dispatch_block(st, todo, temps=temps)
+        snap, cold, cold_lnl, cold_lnp = self._commit_block(st, out,
+                                                            todo)
+        if self.device_state:
+            # out-of-pipeline callers mutate/resample the state with
+            # host numpy; hand them the snapshot leaves (the device
+            # twins would be donated dead on the next dispatch anyway)
+            st.x = snap["x"]
+            st.lnl = snap["lnl"]
+            st.lnp = snap["lnp"]
+            st.key = snap["key"]
+            st.history = snap["history"]
         return cold, cold_lnl, cold_lnp
 
     def anneal_init(self, schedule=None, steps_per=100, resample=True,
@@ -814,13 +1015,17 @@ class PTSampler:
         from ..utils.diagnostics import throttled_block_worst
         return throttled_block_worst(cs, self.like.param_names, diag_t)
 
-    def _cache_hit_rate(self):
+    def _cache_hit_rate(self, mask_counts=None):
         """Cache-hit potential of the proposal mix so far (0.0 when the
-        likelihood declares no parameter blocks)."""
+        likelihood declares no parameter blocks). ``mask_counts``
+        overrides the live counters (deferred consumers pass the
+        block-k snapshot)."""
         if not self.use_maskstats:
             return 0.0
+        if mask_counts is None:
+            mask_counts = self.mask_counts
         from ..utils.diagnostics import cache_hit_summary
-        return cache_hit_summary(*self.mask_counts)["cache_hit_rate"]
+        return cache_hit_summary(*mask_counts)["cache_hit_rate"]
 
     # ---------------- public API --------------------------------------- #
     def sample(self, nsamp, resume=True, verbose=True, thin=1,
@@ -876,38 +1081,108 @@ class PTSampler:
             np.savetxt(os.path.join(self.outdir, "pars.txt"),
                        self.like.param_names, fmt="%s")
 
-        while st.step < nsamp:
-            todo = int(min(block_size, nsamp - st.step))
-            sacc_before = st.swaps_accepted.copy()
-            sprop_before = st.swaps_proposed.copy()
-            cold, cold_lnl, cold_lnp = self._run_block(st, todo)
+        # the double buffer (samplers/devicestate.py): block k's host
+        # work — chain-file appends, checkpoint serialization,
+        # heartbeats, throttled diagnostics — runs AFTER block k+1 is
+        # dispatched, so the device never idles on file IO. With
+        # device_state=False the pipeline degrades to synchronous
+        # execution and this loop reproduces the seed path exactly.
+        from .devicestate import HostPipeline
+        pipe = HostPipeline(enabled=self.device_state)
+        try:
+            while st.step < nsamp:
+                todo = int(min(block_size, nsamp - st.step))
+                sacc_before = np.asarray(st.swaps_accepted).copy()
+                sprop_before = np.asarray(st.swaps_proposed).copy()
+                out = self._dispatch_block(st, todo)
+                # device is busy with block k: fold block k-1's
+                # deferred host work into the gap
+                pipe.run_pending()
+                snap, cold, cold_lnl, cold_lnp = self._commit_block(
+                    st, out, todo)
 
-            # --- swap-rate-targeted ladder adaptation ----------------- #
-            if self.adapt_ladder and self.ntemps > 1:
-                dprop = st.swaps_proposed - sprop_before
-                dacc = st.swaps_accepted - sacc_before
-                if np.all(dprop > 0):
-                    rate = dacc / dprop
-                    kappa = self.ladder_t0 / (st.step + self.ladder_t0)
-                    log_gap = np.log(np.diff(st.ladder))
-                    log_gap += kappa * (rate - self.swap_target)
-                    st.ladder = np.concatenate(
-                        [[1.0], 1.0 + np.cumsum(np.exp(log_gap))])
+                # --- swap-rate-targeted ladder adaptation ------------- #
+                # (critical path: the next dispatch consumes the ladder)
+                if self.adapt_ladder and self.ntemps > 1:
+                    dprop = st.swaps_proposed - sprop_before
+                    dacc = st.swaps_accepted - sacc_before
+                    if np.all(dprop > 0):
+                        rate = dacc / dprop
+                        kappa = self.ladder_t0 / (st.step
+                                                  + self.ladder_t0)
+                        log_gap = np.log(np.diff(st.ladder))
+                        log_gap += kappa * (rate - self.swap_target)
+                        st.ladder = np.concatenate(
+                            [[1.0], 1.0 + np.cumsum(np.exp(log_gap))])
 
+                # post-thin views; with write_hot the block emitted the
+                # FULL ensemble and the cold rung is columns [:nchains]
+                full_x = cold[::thin]              # (steps, *, nd)
+                full_l = cold_lnl[::thin]
+                full_p = cold_lnp[::thin]
+                cs = full_x[:, :self.nchains]
+
+                # --- adapt covariance from recent cold samples -------- #
+                # (critical path: the next block's eigh reads st.cov)
+                flat = cs.reshape(-1, self.ndim)
+                if flat.shape[0] > 10 and st.step > self.burn:
+                    new_cov = np.cov(flat.T)
+                    if self.ndim == 1:
+                        new_cov = new_cov.reshape(1, 1)
+                    w = min(0.5, flat.shape[0] / max(st.step, 1))
+                    st.cov = (1 - w) * st.cov + w * new_cov
+
+                # checkpoint payload: donation-safe host references,
+                # captured NOW (post-adaptation cov/ladder, block-k
+                # snapshot arrays) so the deferred serialization writes
+                # a consistent state
+                payload = dict(
+                    x=snap["x"], lnl=snap["lnl"], lnp=snap["lnp"],
+                    key=snap["key"], cov=st.cov,
+                    history=snap["history"], hist_len=st.hist_len,
+                    step=st.step, accepted=st.accepted,
+                    swaps_accepted=st.swaps_accepted,
+                    swaps_proposed=st.swaps_proposed, ladder=st.ladder)
+                pipe.defer(self._block_host_work(
+                    nsamp, todo, chain_path, collect, rec, meter,
+                    diag_t, verbose, snap, full_x, full_l, full_p,
+                    payload, int(st.step),
+                    np.asarray(st.ladder, dtype=float).copy(),
+                    self._last_sync_s, self._last_bubble_s))
+        finally:
+            # the last block's writes/checkpoint must land before the
+            # caller (convergence driver, resume, tests) reads the
+            # output directory
+            pipe.flush()
+        return st
+
+    def _block_host_work(self, nsamp, todo, chain_path, collect, rec,
+                         meter, diag_t, verbose, snap, full_x, full_l,
+                         full_p, payload, step_now, ladder_now, sync_s,
+                         bubble_s):
+        """One block's off-critical-path host work, as a closure for
+        the :class:`~.devicestate.HostPipeline`: chain-file appends,
+        hot-rung files, diagnostics artifacts, checkpoint
+        serialization, telemetry heartbeat, and the verbose log line.
+        Everything it touches is a host-side snapshot captured at the
+        commit sync point — never a live (donatable) device buffer."""
+        cs = full_x[:, :self.nchains]
+        cl = full_l[:, :self.nchains]
+        cp = full_p[:, :self.nchains]
+        accepted = snap["accepted"]
+        sacc = np.asarray(snap["swaps_accepted"], dtype=float)
+        sprop = np.asarray(snap["swaps_proposed"], dtype=float)
+        fam_accept = snap["fam_accept"]
+        fam_propose = snap["fam_propose"]
+        mask_counts = snap["mask_counts"]
+        max_lnl = float(np.max(snap["lnl"]))
+
+        def work():
             # --- write cold chains (interleaved walkers) -------------- #
-            # with write_hot the block emitted the FULL ensemble and the
-            # cold rung is columns [:nchains]; otherwise the slice is a
-            # no-op on the already-cold emission
-            full_x = np.asarray(cold)[::thin]      # (steps, *, nd)
-            full_l = np.asarray(cold_lnl)[::thin]
-            full_p = np.asarray(cold_lnp)[::thin]
-            cs = full_x[:, :self.nchains]
-            cl = full_l[:, :self.nchains]
-            cp = full_p[:, :self.nchains]
-            acc_rate = float(np.mean(st.accepted[:self.nchains])
-                             / max(st.step, 1))
-            tot_prop = float(np.sum(st.swaps_proposed))
-            swap_rate = (float(np.sum(st.swaps_accepted)) / tot_prop
+            acc_rate = float(np.mean(accepted[:self.nchains])
+                             / max(step_now, 1))
+            tot_prop = float(np.sum(sprop))
+            swap_rate = (float(np.sum(sacc)) / tot_prop
                          if tot_prop else 0.0)
             rows = np.concatenate([
                 cs.reshape(-1, self.ndim),
@@ -929,16 +1204,16 @@ class PTSampler:
                 # the temperature in the filename is exact.
                 for k in range(1, self.ntemps):
                     sl = slice(k * self.nchains, (k + 1) * self.nchains)
-                    T_k = st.ladder[k]
+                    T_k = ladder_now[k]
                     if T_k <= 1.0:
                         # degenerate ladder (e.g. tmax=1): the rung is
                         # statistically the cold chain and its filename
                         # would collide with chain_1.txt — skip it
                         continue
-                    acc_k = float(np.mean(st.accepted[sl])
-                                  / max(st.step, 1))
-                    swap_k = (float(st.swaps_accepted[k - 1])
-                              / max(st.swaps_proposed[k - 1], 1.0))
+                    acc_k = float(np.mean(accepted[sl])
+                                  / max(step_now, 1))
+                    swap_k = (float(sacc[k - 1])
+                              / max(sprop[k - 1], 1.0))
                     nrow = full_x.shape[0] * self.nchains
                     rows_k = np.concatenate([
                         full_x[:, sl].reshape(-1, self.ndim),
@@ -953,16 +1228,9 @@ class PTSampler:
             if collect is not None:
                 collect.append(cs.astype(np.float32))
 
-            # --- adapt covariance from recent cold samples ------------ #
-            flat = cs.reshape(-1, self.ndim)
-            if flat.shape[0] > 10 and st.step > self.burn:
-                new_cov = np.cov(flat.T)
-                if self.ndim == 1:
-                    new_cov = new_cov.reshape(1, 1)
-                w = min(0.5, flat.shape[0] / max(st.step, 1))
-                st.cov = (1 - w) * st.cov + w * new_cov
             if _is_primary():
-                np.save(os.path.join(self.outdir, "cov.npy"), st.cov)
+                np.save(os.path.join(self.outdir, "cov.npy"),
+                        payload["cov"])
                 if self.use_maskstats:
                     # update_mask emission record: what fraction of the
                     # cold-rung proposal mix a block-sparse evaluator
@@ -971,25 +1239,28 @@ class PTSampler:
                     from ..utils.diagnostics import cache_hit_summary
                     atomic_write_json(
                         os.path.join(self.outdir, "mask_stats.json"),
-                        cache_hit_summary(*self.mask_counts))
-            self._save_state(st)
-            rec.checkpoint(step=int(st.step))
+                        cache_hit_summary(*mask_counts))
+            self._write_ckpt(payload)
+            rec.checkpoint(step=step_now)
 
-            # --- heartbeat (host-sync point: the block just landed) --- #
+            # --- heartbeat (from the commit-time host snapshot) ------- #
             # everything inside the rec.enabled gate exists only for
             # the event stream, so EWT_TELEMETRY=0 (or a disabled-on-
             # write-error recorder) pays zero diagnostics cost
             if rec.enabled:
                 meter.add(self.W * todo)
-                hb = dict(step=int(st.step), nsamp=int(nsamp),
+                hb = dict(step=step_now, nsamp=int(nsamp),
                           accept=round(acc_rate, 4),
                           swap=round(swap_rate, 4),
                           ladder=[round(float(T), 4)
-                                  for T in st.ladder],
+                                  for T in ladder_now],
                           evals_per_s=round(meter.window_rate(), 1),
                           evals_total=int(meter.total),
-                          cache_hit_rate=self._cache_hit_rate(),
-                          max_lnl=round(float(np.max(st.lnl)), 3))
+                          cache_hit_rate=self._cache_hit_rate(
+                              mask_counts),
+                          host_sync_wall_s=round(sync_s, 4),
+                          block_bubble_s=round(bubble_s, 4),
+                          max_lnl=round(max_lnl, 3))
                 worst = self._block_diag(cs, diag_t)
                 if worst is not None:
                     hb["rhat"] = worst["rhat"]
@@ -1000,16 +1271,16 @@ class PTSampler:
                     f"{n}={a / max(p, 1.0):.2f}" for n, a, p in zip(
                         ("scam", "am", "de", "pd", "ind", "cg", "kde",
                          "ns"),
-                        self.fam_accept, self.fam_propose))
+                        fam_accept, fam_propose))
                 mask = ""
                 if self.use_maskstats:
-                    tot = max(self.mask_counts.sum(), 1.0)
+                    tot = max(mask_counts.sum(), 1.0)
                     mask = (" maskable="
-                            f"{self.mask_counts[:2].sum() / tot:.2f}")
+                            f"{mask_counts[:2].sum() / tot:.2f}")
                 _log.info("step %d/%d acc=%.3f swap=%.3f [%s]%s "
-                          "maxlnl=%.2f", st.step, nsamp, acc_rate,
-                          swap_rate, fam, mask, np.max(st.lnl))
-        return st
+                          "maxlnl=%.2f", step_now, nsamp, acc_rate,
+                          swap_rate, fam, mask, max_lnl)
+        return work
 
     def __init_subclass__(cls):
         pass
@@ -1041,6 +1312,12 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
                 skw.get("writeHotChains", False))),
         )
         thin = int(getattr(params, "thin", skw.get("thin", 1)) or 1)
+        if "device_state" in skw:
+            # paramfile escape hatch back to the seed host-round-trip
+            # block path (device_state: 0); default is device-resident
+            opts["device_state"] = bool(int(skw["device_state"]))
+        if "eval_chunk" in skw:
+            opts["eval_chunk"] = int(skw["eval_chunk"])
         opts["burn"] = int(getattr(params, "burn",
                                    skw.get("burn", 0)) or 0)
         if getattr(params, "mcmc_covm", None) is not None:

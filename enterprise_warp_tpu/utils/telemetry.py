@@ -32,6 +32,14 @@ Instrumentation contract (enforced by construction): heartbeats are
 emitted only at existing host-sync points (sampler block boundaries),
 registry increments are plain host-side arithmetic, and no code path
 here introduces a device synchronization.
+
+Block-boundary gauges (device-resident state layer,
+``samplers/devicestate.py``): the PT/HMC samplers set
+``host_sync_wall_s`` (host wall spent blocked waiting for a dispatched
+block) and ``block_bubble_s`` (device wall spent idle between a block's
+results landing and the next dispatch) per block, and carry the same
+fields in every heartbeat; ``tools/report.py`` folds them into the
+compile-vs-sample-vs-bubble wall split.
 """
 
 from __future__ import annotations

@@ -1,0 +1,261 @@
+"""Device-resident sampler state (samplers/devicestate.py + the
+PT/HMC donation paths).
+
+Covers the ISSUE-3 acceptance surface: bit-equivalence of the donated
+device-resident block path against the seed host-round-trip path (same
+seed, same block size, CPU), checkpoint/resume equivalence (run N+M vs
+run N, checkpoint, resume M), chain-axis sharding on the virtual
+multi-device CPU mesh producing identical chains, the donation-safe
+snapshot contract, the double-buffer pipeline semantics, and the
+block-boundary telemetry gauges flowing into heartbeats and the run
+report.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from test_samplers import GaussianLike
+
+from enterprise_warp_tpu.samplers import PTSampler, run_nested
+from enterprise_warp_tpu.samplers.devicestate import (HostPipeline,
+                                                      chain_sharding,
+                                                      host_snapshot)
+from enterprise_warp_tpu.samplers.hmc import HMCSampler
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_report_cli():
+    spec = importlib.util.spec_from_file_location(
+        "ewt_report_cli_ds", str(REPO_ROOT / "tools" / "report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _pt(like, outdir, **kw):
+    """PT sampler with every proposal family exercised (the donation
+    path must be bit-safe for the full machinery, not just the default
+    mix)."""
+    opts = dict(ntemps=2, nchains=8, seed=0, cov_update=100,
+                ind_weight=10, cg_weight=10, kde_weight=10)
+    opts.update(kw)
+    return PTSampler(like, str(outdir), **opts)
+
+
+def _run(like, outdir, nsamp=300, block_size=100, resume=False, **kw):
+    s = _pt(like, outdir, **kw)
+    st = s.sample(nsamp, resume=resume, verbose=False,
+                  block_size=block_size)
+    return s, st, np.loadtxt(os.path.join(str(outdir), "chain_1.txt"))
+
+
+# ------------------------------------------------------------------ #
+#  bit-equivalence guard: donated device path == seed host path       #
+# ------------------------------------------------------------------ #
+
+def test_device_path_bit_equal_to_host_path(tmp_path):
+    like = GaussianLike([0.0, 1.0], [0.5, 0.3])
+    _, st_h, ch_h = _run(like, tmp_path / "host", device_state=False)
+    _, st_d, ch_d = _run(GaussianLike([0.0, 1.0], [0.5, 0.3]),
+                         tmp_path / "dev", device_state=True)
+    # chain files (positions, lnpost, lnl, rates) bit-for-bit
+    assert ch_h.shape == ch_d.shape
+    assert np.array_equal(ch_h, ch_d)
+    # final walker state and counters bit-for-bit
+    for f in ("x", "lnl", "lnp", "key", "history", "accepted",
+              "swaps_accepted", "swaps_proposed"):
+        assert np.array_equal(np.asarray(getattr(st_h, f)),
+                              np.asarray(getattr(st_d, f))), f
+    assert st_h.step == st_d.step and st_h.hist_len == st_d.hist_len
+    np.testing.assert_array_equal(st_h.cov, st_d.cov)
+    np.testing.assert_array_equal(st_h.ladder, st_d.ladder)
+    # identical checkpoints on disk
+    zh = np.load(tmp_path / "host" / "state.npz")
+    zd = np.load(tmp_path / "dev" / "state.npz")
+    for k in zh.files:
+        assert np.array_equal(zh[k], zd[k]), k
+
+
+def test_device_path_single_block_compile(tmp_path):
+    """The first (numpy fresh-state) and every later (device-resident)
+    block call must share one jit cache entry — a silent second
+    compile is the placement bug the committed-upload contract
+    prevents."""
+    from enterprise_warp_tpu.utils import telemetry
+    telemetry.registry().reset()
+    like = GaussianLike([0.0], [1.0])
+    _run(like, tmp_path, device_state=True)
+    snap = telemetry.registry().snapshot()["counters"]
+    assert snap.get("retraces{fn=ptmcmc_block}") == 1
+    telemetry.registry().reset()
+
+
+# ------------------------------------------------------------------ #
+#  checkpoint off the hot path: resume equivalence                    #
+# ------------------------------------------------------------------ #
+
+def test_resume_equivalence_n_plus_m(tmp_path):
+    """Run N+M steps in one go vs run N, checkpoint, new sampler
+    resumes M — identical cold chains and counters (the deferred
+    checkpoint serialization must observe exactly the committed
+    block-k state)."""
+    mk = lambda: GaussianLike([1.0, -2.0], [0.3, 0.7])  # noqa: E731
+    _, st_full, ch_full = _run(mk(), tmp_path / "full", nsamp=400)
+    d2 = tmp_path / "split"
+    _run(mk(), d2, nsamp=200)
+    s3 = _pt(mk(), d2)
+    st_res = s3.sample(400, resume=True, verbose=False, block_size=100)
+    ch_res = np.loadtxt(d2 / "chain_1.txt")
+    assert np.array_equal(ch_full, ch_res)
+    assert np.array_equal(np.asarray(st_full.x), np.asarray(st_res.x))
+    assert np.array_equal(np.asarray(st_full.accepted),
+                          np.asarray(st_res.accepted))
+    assert np.array_equal(st_full.swaps_accepted, st_res.swaps_accepted)
+    assert st_full.step == st_res.step
+
+
+# ------------------------------------------------------------------ #
+#  chain-axis sharding (virtual multi-device CPU mesh)                #
+# ------------------------------------------------------------------ #
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs >= 2 (virtual) devices")
+def test_chain_sharding_identical_chains(tmp_path):
+    from jax.sharding import Mesh
+    like = GaussianLike([0.0, 1.0], [0.5, 0.3])
+    _, _, ch_ref = _run(like, tmp_path / "ref", device_state=True)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("chain",))
+    s, st, ch_sh = _run(GaussianLike([0.0, 1.0], [0.5, 0.3]),
+                        tmp_path / "sharded", device_state=True,
+                        mesh=mesh)
+    assert np.array_equal(ch_ref, ch_sh)
+    # the walker state really is sharded over the chain axis
+    x_shard = getattr(st.x, "sharding", None)
+    assert x_shard is not None
+    assert len(x_shard.device_set) == 2
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs >= 2 (virtual) devices")
+def test_chain_sharding_requires_divisible_walkers(tmp_path):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:2]), ("chain",))
+    with pytest.raises(ValueError, match="divisible"):
+        PTSampler(GaussianLike([0.0], [1.0]), str(tmp_path),
+                  ntemps=1, nchains=3, mesh=mesh)
+
+
+def test_chain_sharding_helper_unbound_axis():
+    """A mesh without the chain axis yields no shardings (composition
+    contract: each layer binds only the axis it owns)."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:1]), ("toa",))
+    assert chain_sharding(mesh, "chain") == (None, None)
+    assert chain_sharding(None) == (None, None)
+
+
+# ------------------------------------------------------------------ #
+#  donation-safe snapshot + pipeline semantics                        #
+# ------------------------------------------------------------------ #
+
+def test_host_snapshot_real_copies():
+    """Snapshot leaves must be REAL copies of device buffers — a
+    zero-copy view into memory a later donated dispatch overwrites in
+    place is silent corruption."""
+    import jax.numpy as jnp
+    x = jnp.arange(8.0)
+    snap = host_snapshot({"x": x, "n": np.ones(3)})
+    assert isinstance(snap["x"], np.ndarray)
+    assert not np.shares_memory(snap["x"], np.asarray(x))
+    np.testing.assert_array_equal(snap["x"], np.arange(8.0))
+
+
+def test_host_pipeline_orders_and_flushes():
+    ran = []
+    p = HostPipeline(enabled=True)
+    p.defer(lambda: ran.append(1))
+    assert ran == []                    # parked, not run
+    p.defer(lambda: ran.append(2))      # forces 1 to run first
+    assert ran == [1]
+    p.run_pending()
+    assert ran == [1, 2]
+    p.flush()                           # idempotent
+    assert ran == [1, 2]
+    # disabled pipeline degrades to synchronous execution
+    p2 = HostPipeline(enabled=False)
+    p2.defer(lambda: ran.append(3))
+    assert ran == [1, 2, 3]
+
+
+# ------------------------------------------------------------------ #
+#  block-boundary telemetry: gauges -> heartbeats -> report           #
+# ------------------------------------------------------------------ #
+
+def test_heartbeat_gauges_and_report_bubble(tmp_path, monkeypatch):
+    monkeypatch.setenv("EWT_TELEMETRY", "1")
+    from enterprise_warp_tpu.utils import telemetry
+    telemetry.registry().reset()
+    like = GaussianLike([0.0, 1.0], [0.5, 0.3])
+    s, _, _ = _run(like, tmp_path, device_state=True)
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    hbs = [e for e in events if e["type"] == "heartbeat"]
+    assert hbs
+    for hb in hbs:
+        assert "host_sync_wall_s" in hb and "block_bubble_s" in hb
+        assert hb["host_sync_wall_s"] >= 0
+    # cumulative totals exposed for the bench + convergence driver
+    assert s.host_sync_total_s >= 0 and s.bubble_count >= 1
+    gauges = telemetry.registry().snapshot()["gauges"]
+    assert "host_sync_wall_s" in gauges and "block_bubble_s" in gauges
+
+    report_cli = _load_report_cli()
+    assert report_cli.main([str(tmp_path), "-q"]) == 0
+    rpt = json.load(open(tmp_path / "run_report.json"))
+    w = rpt["wall_clock"]
+    assert w["bubble_s"] is not None and w["bubble_s"] >= 0
+    assert w["host_sync_s"] is not None
+    assert w["bubble_fraction"] is not None
+    telemetry.registry().reset()
+
+
+# ------------------------------------------------------------------ #
+#  HMC + nested device-resident equivalents                           #
+# ------------------------------------------------------------------ #
+
+def test_hmc_device_path_matches_host_path(tmp_path):
+    """HMC device-resident vs host path: donation's input/output
+    aliasing changes XLA fusion inside the value_and_grad leapfrog, so
+    the chains agree to the last ulp (measured: max |diff| = 1 ulp on
+    a tiny fraction of entries) rather than bitwise — unlike the PT
+    block, which is asserted bit-exact above."""
+    mk = lambda: GaussianLike([0.5, -0.5], [0.4, 0.8])  # noqa: E731
+    ch = {}
+    for mode, dev in (("host", False), ("dev", True)):
+        s = HMCSampler(mk(), str(tmp_path / mode), nchains=8, seed=0,
+                       warmup=100, n_leapfrog=4, device_state=dev)
+        s.sample(200, resume=False, verbose=False, block_size=50)
+        ch[mode] = np.loadtxt(tmp_path / mode / "chain_1.txt")
+    assert ch["host"].shape == ch["dev"].shape
+    np.testing.assert_allclose(ch["host"], ch["dev"], rtol=0,
+                               atol=1e-9)
+
+
+def test_nested_donation_matches_undonated(tmp_path, monkeypatch):
+    def run(outdir, env):
+        monkeypatch.setenv("EWT_DEVICE_STATE", env)
+        return run_nested(GaussianLike([0.0], [0.5]),
+                          outdir=str(outdir), nlive=100, dlogz=0.5,
+                          nsteps=10, seed=3, verbose=False,
+                          max_iter=400, label="ds")
+    r_off = run(tmp_path / "off", "0")
+    r_on = run(tmp_path / "on", "1")
+    assert r_off["log_evidence"] == r_on["log_evidence"]
+    assert r_off["num_iterations"] == r_on["num_iterations"]

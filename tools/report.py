@@ -112,6 +112,7 @@ def build_report(events, dropped=0):
 
     # ---- heartbeat folds: eval-rate timeline + convergence ---------- #
     rate_timeline, convergence, cache_hit = [], [], None
+    bubble_s, host_sync_s, bubble_blocks = 0.0, 0.0, 0
     for hb in heartbeats:
         t_rel = round(hb["t"] - t0, 2) if t0 is not None else None
         if hb.get("evals_per_s") is not None:
@@ -124,6 +125,14 @@ def build_report(events, dropped=0):
                                 "ess": hb.get("ess")})
         if hb.get("cache_hit_rate") is not None:
             cache_hit = hb["cache_hit_rate"]
+        # block-boundary accounting (device-resident state layer):
+        # per-block gauges sum to the device-idle and host-blocked
+        # wall of the run
+        if hb.get("block_bubble_s") is not None:
+            bubble_s += float(hb["block_bubble_s"])
+            bubble_blocks += 1
+        if hb.get("host_sync_wall_s") is not None:
+            host_sync_s += float(hb["host_sync_wall_s"])
 
     rates = [r["evals_per_s"] for r in rate_timeline
              if r["evals_per_s"] is not None]
@@ -142,6 +151,17 @@ def build_report(events, dropped=0):
             "compile_s": compile_wall,
             "sample_s": (round(total_wall - compile_wall, 2)
                          if total_wall is not None else None),
+            # device-idle time at block boundaries (summed per-block
+            # heartbeat gauges) and its share of the post-compile wall
+            # — the figure the double-buffered dispatch pipeline exists
+            # to shrink
+            "bubble_s": (round(bubble_s, 3) if bubble_blocks else None),
+            "host_sync_s": (round(host_sync_s, 3) if bubble_blocks
+                            else None),
+            "bubble_fraction": (
+                round(bubble_s / max(total_wall - compile_wall, 1e-9),
+                      4)
+                if bubble_blocks and total_wall is not None else None),
         },
         "compiles": {"total": sum(d["count"] for d in per_fn.values()),
                      "per_fn": per_fn},
@@ -182,6 +202,10 @@ def _human_summary(report, out=sys.stdout):
     if w["total_s"] is not None:
         p(f"wall-clock: total {w['total_s']}s = compile "
           f"{w['compile_s']}s + sample {w['sample_s']}s")
+    if w.get("bubble_s") is not None:
+        p(f"block-boundary bubble: {w['bubble_s']}s device-idle "
+          f"({w['bubble_fraction']} of sample wall; host blocked on "
+          f"sync {w['host_sync_s']}s)")
     c = report["compiles"]
     p(f"compiles: {c['total']}")
     for fn, d in sorted(c["per_fn"].items(),
