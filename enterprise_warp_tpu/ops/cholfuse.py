@@ -398,6 +398,13 @@ def probe_status():
 
 
 def _pallas_enabled():
+    # EWT_PALLAS=0 is the package-wide MASTER escape hatch (see
+    # ops.megakernel): it disables every Pallas kernel — this fused
+    # preconditioner and the likelihood megakernel — and restores the
+    # pure-XLA path bit-for-bit. EWT_PALLAS_CHOL=0 disables only this
+    # kernel.
+    if os.environ.get("EWT_PALLAS", "1") == "0":
+        return False
     if os.environ.get("EWT_PALLAS_CHOL", "1") == "0":
         return False
     try:
@@ -406,6 +413,17 @@ def _pallas_enabled():
     except Exception:
         return False
     return pallas_chol_available()
+
+
+def _record_chol_path(path):
+    """``pallas_path{kernel=chol_precond,path=...}`` — which route the
+    batched dispatch rule took, counted at trace time (one increment
+    per (re)trace; the executable caches the decision). Same counter
+    family as the megakernel's, consumed by sampler heartbeats,
+    ``tools/report.py`` and the bench provenance blocks."""
+    from ..utils.telemetry import registry
+    registry().counter("pallas_path", kernel="chol_precond",
+                       path=path).inc()
 
 
 @custom_batching.custom_vmap
@@ -432,8 +450,11 @@ def _chol_precond_vmap(axis_size, in_batched, Sn32, j1, j2):
         # AD never reaches this rule body: chol_precond's custom_vjp
         # intercepts differentiation above, so the raw Pallas call
         # needs no AD wrapper of its own
+        _record_chol_path("pallas")
         out = _pallas_fused_raw(Sn32, j1, j2)
     else:
+        _record_chol_path("probe-failed" if _PROBE_RESULT is False
+                          else "xla-fallback")
         out = _fused_xla(Sn32, j1, j2)
     return out, (True, True, True)
 

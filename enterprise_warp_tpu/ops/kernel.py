@@ -305,7 +305,7 @@ def equilibrated_cholesky(S, jitter):
 
 def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
                             delta_mode="tree", blocked=False,
-                            fused=None):
+                            fused=None, mega=None):
     """Solve ``S Z = B`` and compute ``log|S|`` for symmetric PD ``S`` in
     mixed precision (TPU-fast: no emulated-f64 factorization).
 
@@ -339,6 +339,20 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     (f32 preconditioner + split-mode ``E``); the refined solves and the
     trace-corrected logdet are unchanged downstream.
 
+    ``mega`` (None = auto) routes the ENTIRE post-equilibration chain —
+    three-tier factorization, preconditioner solves, refinement passes,
+    divergence guard, trace-corrected logdet — through the solve
+    megakernel (:mod:`ops.megakernel`): ONE Pallas dispatch on TPU
+    instead of the whole latency-bound op chain, within the
+    megakernel's documented f32 tolerance class (refinement residuals
+    are f32, so the solve floor is ~kappa_eq * eps_f32 instead of the
+    f64-residual ~1e-9; see ``docs/kernels.md``). Auto resolves at
+    trace time like ``fused``: split mode, no blocked-factorization
+    override, ``EWT_PALLAS``/``EWT_PALLAS_MEGA`` on, TPU backend, probe
+    passed. ``mega=False`` pins the exact classic chain (the AD
+    reference); ``mega='interpret'`` runs the kernel through the
+    Pallas interpreter (CPU-testable).
+
     Returns ``(Z, logdet)`` with ``Z`` (n, k) f64.
     """
     f64 = S.dtype
@@ -370,6 +384,26 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     Sn = S * s[:, None] * s[None, :]
     Sn = jnp.fill_diagonal(
         Sn, jnp.where(null, 1.0, jnp.diagonal(Sn)), inplace=False)
+    if mega is None and delta_mode == "split" and not blocked \
+            and fused is not False:
+        # megakernel auto-route (trace-time, like the toggles below):
+        # declining — env/backend/probe, or an over-cap matrix order —
+        # keeps the classic chain below bit-for-bit
+        from .megakernel import mega_solve_route
+        mega = mega_solve_route(n)
+    if mega:
+        # fused post-equilibration chain (ops.megakernel): three-tier
+        # factorization, preconditioner solves, refinement, divergence
+        # guard and trace-corrected logdet in ONE dispatch. Z comes
+        # back f32 (the megakernel's documented accuracy class); the
+        # equilibration book-keeping stays f64 out here.
+        from .megakernel import mega_solve_logdet
+        Bn32 = (s[:, None] * B).astype(jnp.float32)
+        Z32, ld_eq = mega_solve_logdet(Sn.astype(jnp.float32), Bn32,
+                                       float(jitter), float(jitter2),
+                                       refine, mega == "interpret")
+        logdet = ld_eq.astype(f64) + jnp.sum(jnp.log(d))
+        return s[:, None] * Z32.astype(f64), logdet
     if fused is None:
         from .cholfuse import fused_chol_enabled
         # an explicit blocked-factorization request (EWT_BLOCKED_CHOL)
@@ -562,10 +596,10 @@ def gram_blocks(nw, r_w, M_w, T_w, mask=None, gram_mode="split",
 
 
 @partial(jax.jit, static_argnames=("gram_mode", "blocked_chol",
-                                   "refine"))
+                                   "refine", "mega"))
 def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
                          pair_program=None, blocked_chol=False,
-                         refine=3, grams=None):
+                         refine=3, grams=None, mega=None):
     """Marginalized GP log-likelihood for one pulsar at one parameter point.
 
     Parameters
@@ -584,6 +618,20 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
         constant-folded Gram stage for fixed-white-noise builds. When
         given, the O(ntoa * nbasis^2) contraction is skipped entirely and
         the eval is O(nbasis^3).
+    mega : megakernel routing (static). ``None`` (default): auto —
+        when the Gram stage actually runs (``grams is None``), the TM
+        Schur stage exists and ``gram_mode`` is reduced-precision, the
+        WHOLE eval (gram accumulation, Sigma assembly, equilibrated
+        factorization, refined solves, TM Schur, logdet corrections)
+        routes through the fused likelihood megakernel
+        (:mod:`ops.megakernel`: one Pallas dispatch per eval) if the
+        backend/env/probe ladder accepts; otherwise the classic chain
+        below runs unchanged (and ``_mixed_psd_solve_logdet`` makes its
+        own solve-megakernel decision). ``False`` pins the exact
+        classic path everywhere (including the inner solve).
+        ``True``/``'interpret'`` force the megakernel tolerance class
+        (``'interpret'`` executes through the Pallas interpreter — the
+        CPU-testable route asserted in tier-1).
 
     Returns lnL up to a theta-independent constant (see
     ``oracle.kernel_constant_offset`` for the exact relation to the dense
@@ -591,6 +639,31 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
     """
     f64 = r_w.dtype
     ntm = 0 if M_w is None else M_w.shape[1]
+    # explicit mega=False must pin the classic chain END TO END — the
+    # AD/bit-exactness reference — so the inner solve's auto-route is
+    # disabled too; a declined AUTO route leaves the inner decision
+    # open (partial fusion: the solve megakernel can still fire)
+    solve_mega = False if mega is False else None
+    if mega is None:
+        if (gram_mode in ("split", "f32") and grams is None
+                and M_w is not None and not blocked_chol):
+            # the route decision sees the call's CONCRETE shapes, so
+            # an over-cap pulsar (VMEM budget, docs/kernels.md)
+            # declines here and keeps the classic path bit-for-bit
+            from .megakernel import mega_like_route
+            mega = mega_like_route(T_w.shape[0], T_w.shape[1])
+        else:
+            mega = False
+    if mega:
+        if M_w is None or grams is not None:
+            raise ValueError(
+                "mega route requires the marginalized-TM path with a "
+                "live Gram stage (M_w present, grams=None)")
+        from .megakernel import mega_marginalized_loglike
+        mask_arr = jnp.ones_like(nw) if mask is None else mask
+        return mega_marginalized_loglike(nw, b, r_w, M_w, T_w,
+                                         mask_arr, refine,
+                                         mega == "interpret")
     if grams is not None:
         G, H, P, X, q, rwr = grams
     else:
@@ -616,7 +689,8 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
             jitter = CHOL_JITTER[gram_mode]
             zx, logdet_sigma = _mixed_psd_solve_logdet(
                 Sigma, X[:, None], jitter, refine=refine,
-                delta_mode="split", blocked=blocked_chol)
+                delta_mode="split", blocked=blocked_chol,
+                mega=solve_mega)
             quad = rwr - X @ zx[:, 0]
         logdet_n = jnp.sum(jnp.log(nw) * (mask if mask is not None
                                           else 1.0))
@@ -654,7 +728,8 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
         # cost (CPU: 83 -> 18 ms/16-batch)
         ZXH, logdet_sigma = _mixed_psd_solve_logdet(
             Sigma, jnp.concatenate([X[:, None], H], axis=1), jitter,
-            refine=refine, delta_mode="split", blocked=blocked_chol)
+            refine=refine, delta_mode="split", blocked=blocked_chol,
+            mega=solve_mega)
         zx, ZH = ZXH[:, 0], ZXH[:, 1:]
         A = P - H.T @ ZH
         y = q - ZH.T @ X

@@ -1,0 +1,1041 @@
+"""Fused likelihood megakernel: the gram→solve→logdet chain as one
+(or two) tiled Pallas pipelines.
+
+The round-5 device roofline (``ROOFLINE.json``) shows the hot path is
+latency/dispatch-bound, not compute-bound: at batch=1024 the gram phase
+runs at 5.5% and the solve phase at 0.6% of their FLOP/bandwidth
+ceilings — a ~30 ms full kernel against a ~0.5 ms combined ceiling. The
+wall is the LONG CHAIN of small batched XLA ops (factorization sweeps,
+triangular solves, refinement passes, trace-correction products), each a
+separate dispatch whose latency the accelerator cannot hide. This module
+collapses that chain:
+
+- :func:`mega_solve_logdet` — the SOLVE megakernel: one ``pallas_call``
+  per batch that keeps the whole post-equilibration stage of
+  ``ops.kernel._mixed_psd_solve_logdet`` resident in VMEM — three-tier
+  jittered Cholesky, triangular inverse, preconditioner solves, the
+  iterative-refinement residual passes, the divergence guard, and the
+  trace-expansion logdet correction. Consumed by the single-pulsar
+  kernel and by the joint-PTA stage-1/stage-3 solves
+  (``parallel.pta._stage12_single`` / ``_stage3``) through the shared
+  ``_mixed_psd_solve_logdet`` entry point.
+- :func:`mega_marginalized_loglike` — the LIKELIHOOD megakernel for the
+  single-pulsar hot path: adds the per-walker basis-Gram accumulation,
+  Sigma assembly, equilibrated-cast construction, and the tiny
+  timing-model Schur stage to the same VMEM pipeline, so one eval is
+  ONE Pallas dispatch plus a handful of cheap f64 scalar ops outside
+  (weight/prior programs, equilibration scales, final assembly).
+
+Precision contract (documented, asserted in ``tests/test_megakernel.py``
+via interpret mode; see ``docs/kernels.md``)
+--------------------------------------------
+The megakernel runs ENTIRELY in f32: the in-kernel Gram is f32-class
+(the accumulation error of ``gram_mode='f32'``, not the hi/lo 'split'
+class), the refinement residuals are f32 (they remove the
+preconditioner's jitter bias but cannot push below ~kappa_eq * eps_f32),
+and the logdet carries the same ~1e-4-class trace-correction noise as
+``delta_mode='split'``. At posterior-typical conditioning this agrees
+with the XLA split path to ~1e-3 in lnL; at strong-red-noise /
+TM-degenerate corners it degrades exactly where the split-Gram error
+already dominates the XLA path. Oracle work uses ``gram_mode='f64'``
+(never routed here) or ``EWT_PALLAS=0``.
+
+Dispatch ladder (mirrors ``ops.cholfuse``)
+------------------------------------------
+Each op is a ``jax.custom_batching.custom_vmap``: unbatched calls use
+the XLA twin; under ``vmap`` the rule routes the whole batch to the
+Pallas kernel when the backend is TPU, ``EWT_PALLAS`` != "0" (the
+MASTER escape hatch for every Pallas kernel in the package),
+``EWT_PALLAS_MEGA`` != "0", and a one-time compile-and-run probe of the
+real kernel passes — one representative shape per tile class plus the
+outer-vmap (walkers x pulsars) composition. Transient (transport)
+probe failures re-probe instead of pinning the slow path; the verdict
+and every route taken are recorded in the ``pallas_path{kernel=...}``
+telemetry counters and in :func:`mega_status` for bench provenance.
+``jax.custom_vjp`` wrappers route gradients through the XLA reference
+path (exact, pre-fusion cost), so ``vmap(grad(...))`` — the HMC/ADVI
+pattern — never reaches the kernel.
+
+Escape hatches: ``EWT_PALLAS=0`` disables every Pallas kernel
+(megakernel AND ``ops.cholfuse``) and restores the current XLA path
+bit-for-bit; ``EWT_PALLAS_MEGA=0`` disables only the megakernel (the
+fused cholfuse preconditioner stays); ``EWT_PALLAS_INTERPRET=1`` runs
+the kernels through the Pallas interpreter on any backend (CPU-testable
+semantics, not a performance mode).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import custom_batching
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .cholfuse import _fused_xla, _fused_xla_ad, _is_transient
+
+_HIGH = jax.lax.Precision.HIGHEST
+
+# Above these sizes the VMEM working set (see docs/kernels.md for the
+# per-buffer budget) no longer fits even at tile T=1 — such calls keep
+# the XLA path.
+_MEGA_MAX_N = 448          # solve kernel: matrix order
+_MEGA_MAX_TOA = 4096       # likelihood kernel: TOA rows
+_MEGA_MAX_M = 192          # likelihood kernel: noise-basis columns
+
+
+def _tile_solve(n):
+    """Walkers per solve-kernel program: ~7 (T, n, n) f32 buffers live
+    at once (in + out + chol scratch + tier-2 retry + inverse), double-
+    buffered by the pipeline."""
+    if n <= 128:
+        return 8
+    if n <= 192:
+        return 4
+    if n <= 320:
+        return 2
+    return 1
+
+
+def _tile_like(n):
+    """Walkers per likelihood-kernel program: the solve working set plus
+    the (ntoa, m) static basis, the per-walker scaled-basis scratch and
+    the (T, m, m) Gram buffer."""
+    if n <= 96:
+        return 4
+    if n <= 160:
+        return 2
+    return 1
+
+
+# --------------------------------------------------------------------
+# in-kernel subroutine library (shared by both kernels)
+# --------------------------------------------------------------------
+
+def _eye_lane(n):
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    eyem = (rows == cols).astype(jnp.float32)              # (n, n)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)  # (1, n)
+    return eyem, lane
+
+
+def _chol_into(src, jit_vec, X_ref, out_ref, eyem, lane, T, n):
+    """Right-looking Cholesky of ``src + diag(jit_vec)`` (a (T, n, n)
+    value), upper factor into ``out_ref``; ``X_ref`` is the symmetric
+    working copy (same layout trick as ``ops.cholfuse``: 'column k'
+    reads are row reads of the symmetric remainder)."""
+    X_ref[:] = src + jit_vec[:, None, None] * eyem[None]
+    out_ref[:] = jnp.zeros((T, n, n), jnp.float32)
+
+    def step(k, carry):
+        rowk = X_ref[:, pl.ds(k, 1), :][:, 0, :]           # (T, n)
+        dkk = jnp.sum(jnp.where(lane == k, rowk, 0.0), axis=1)
+        ipiv = 1.0 / jnp.sqrt(dkk)                         # (T,)
+        lcol = jnp.where(lane >= k, rowk * ipiv[:, None], 0.0)
+        out_ref[:, pl.ds(k, 1), :] = lcol[:, None, :]
+        X_ref[:] = X_ref[:] - lcol[:, :, None] * lcol[:, None, :]
+        return carry
+
+    jax.lax.fori_loop(0, n, step, 0)
+
+
+def _three_tier_chol(src, j1, j2, X_ref, U_ref, U2_ref, eyem, lane,
+                     T, n):
+    """Three-tier jittered factorization into ``U_ref`` (same semantics
+    as ``ops.kernel._mixed_psd_solve_logdet``: tier-1 jitter, predicated
+    tier-2 retry for indefinite walkers, tier-3 identity fallback)."""
+    _chol_into(src, jnp.full((T,), j1, jnp.float32), X_ref, U_ref,
+               eyem, lane, T, n)
+    bad1 = ~jnp.all(jnp.isfinite(U_ref[:]), axis=(1, 2))   # (T,)
+
+    @pl.when(jnp.any(bad1))
+    def _():
+        _chol_into(src, jnp.where(bad1, j2, j1).astype(jnp.float32),
+                   X_ref, U2_ref, eyem, lane, T, n)
+        U_ref[:] = jnp.where(bad1[:, None, None], U2_ref[:], U_ref[:])
+
+    bad2 = ~jnp.all(jnp.isfinite(U_ref[:]), axis=(1, 2))
+    U_ref[:] = jnp.where(bad2[:, None, None], eyem[None], U_ref[:])
+
+
+def _backsub_inv(U_ref, V_ref, lane, T, n):
+    """Back substitution for ``V = U^-1`` (upper), row i from rows > i
+    — identical recurrence to the cholfuse kernel."""
+    V_ref[:] = jnp.zeros((T, n, n), jnp.float32)
+
+    def bstep(irev, carry):
+        i = n - 1 - irev
+        urow = U_ref[:, pl.ds(i, 1), :][:, 0, :]           # (T, n)
+        dii = jnp.sum(jnp.where(lane == i, urow, 0.0), axis=1)
+        uoff = jnp.where(lane > i, urow, 0.0)
+        acc = jnp.sum(uoff[:, :, None] * V_ref[:], axis=1)  # (T, n)
+        onei = (lane == i).astype(jnp.float32)              # (1, n)
+        V_ref[:, pl.ds(i, 1), :] = \
+            ((onei - acc) / dii[:, None])[:, None, :]
+        return carry
+
+    jax.lax.fori_loop(0, n, bstep, 0)
+
+
+def _dot_t(a, b):
+    """a^T b on the MXU at full f32 precision (contract axis 0)."""
+    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32,
+                               precision=_HIGH)
+
+
+def _dot(a, b):
+    return jnp.dot(a, b, preferred_element_type=jnp.float32,
+                   precision=_HIGH)
+
+
+def _solve_refine_logdet(Sn, Bn, U_ref, V_ref, eyem, refine, T, n):
+    """The post-factorization half of the mixed solve, per tile, on
+    values already in VMEM: preconditioner solves, ``refine`` f32
+    residual passes, the divergence guard, and the trace-expansion
+    logdet correction. Returns ``(Z, ld_eq)`` — the refined solution
+    (T, n, k) and the equilibrated logdet ``2 sum log diag U + corr``
+    (T,). Static unroll over the tile (T is small; Mosaic's batched-dot
+    support is not relied on, matching cholfuse)."""
+    Zs, lds = [], []
+    for t in range(T):
+        Ut, Vt = U_ref[t], V_ref[t]
+        Snt, Bnt = Sn[t], Bn[t]
+
+        def psolve(R, Vt=Vt):
+            return _dot(Vt, _dot_t(Vt, R))
+
+        Z0 = psolve(Bnt)
+        Z = Z0
+        r0 = None
+        for i in range(refine):
+            r = Bnt - _dot(Snt, Z)
+            if i == 0:
+                r0 = r
+            Z = Z + psolve(r)
+        # divergence guard: keep whichever of (refined, plain
+        # preconditioner) solution has the smaller true residual
+        res_ref = jnp.sum(jnp.square(Bnt - _dot(Snt, Z)))
+        res_pre = jnp.sum(jnp.square(r0)) if r0 is not None else res_ref
+        Z = jnp.where(res_ref <= res_pre, Z, Z0)
+        Zs.append(Z)
+
+        # E = Linv (Sn - L L^T) Linv^T = V^T (Sn - U^T U) V, then the
+        # 4-term trace expansion — the same correction and convergence
+        # gate as the XLA path
+        utu = _dot_t(Ut, Ut)
+        delta = Snt - utu
+        E = _dot(_dot_t(Vt, delta), Vt)
+        E2 = _dot(E, E)
+        # trace via the eye mask: jnp.trace's diagonal gather has no
+        # reliable Mosaic lowering; the masked sum is pure elementwise
+        corr = (jnp.sum(E * eyem) - jnp.sum(E * E.T) / 2.0
+                + jnp.sum(E2 * E.T) / 3.0
+                - jnp.sum(E2 * E2.T) / 4.0)
+        corr = jnp.where(jnp.sum(E * E) < 0.09, corr, 0.0)
+        diagU = jnp.sum(Ut * eyem, axis=1)
+        lds.append(2.0 * jnp.sum(jnp.log(diagU)) + corr)
+    return jnp.stack(Zs), jnp.stack(lds)
+
+
+# --------------------------------------------------------------------
+# solve megakernel
+# --------------------------------------------------------------------
+
+def _mega_solve_kernel(refine, j1_ref, j2_ref, Sn_ref, Bn_ref,
+                       Z_ref, ld_ref, X_ref, U_ref, U2_ref, V_ref):
+    T, n = Sn_ref.shape[0], Sn_ref.shape[1]
+    eyem, lane = _eye_lane(n)
+    j1 = j1_ref[0, 0]
+    j2 = j2_ref[0, 0]
+    _three_tier_chol(Sn_ref[:], j1, j2, X_ref, U_ref, U2_ref,
+                     eyem, lane, T, n)
+    _backsub_inv(U_ref, V_ref, lane, T, n)
+    Z, ld = _solve_refine_logdet(Sn_ref[:], Bn_ref[:], U_ref, V_ref,
+                                 eyem, refine, T, n)
+    Z_ref[:] = Z
+    ld_ref[:] = ld[:, None]
+
+
+def _mega_solve_raw(Sn_b, Bn_b, j1, j2, refine, interpret=False):
+    """Invoke the solve megakernel on a (B, n, n) + (B, n, k) batch."""
+    B, n = Sn_b.shape[0], Sn_b.shape[-1]
+    k = Bn_b.shape[-1]
+    T = _tile_solve(n)
+    Bp = -(-B // T) * T
+    if Bp != B:
+        pad = jnp.broadcast_to(jnp.eye(n, dtype=Sn_b.dtype),
+                               (Bp - B, n, n))
+        Sn_b = jnp.concatenate([Sn_b, pad], axis=0)
+        Bn_b = jnp.concatenate(
+            [Bn_b, jnp.zeros((Bp - B, n, k), Bn_b.dtype)], axis=0)
+    j1a = jnp.full((1, 1), j1, jnp.float32)
+    j2a = jnp.full((1, 1), j2, jnp.float32)
+    smem = pl.BlockSpec((1, 1), lambda i: (0, 0),
+                        memory_space=pltpu.SMEM)
+    tile_nn = pl.BlockSpec((T, n, n), lambda i: (i, 0, 0),
+                           memory_space=pltpu.VMEM)
+    tile_nk = pl.BlockSpec((T, n, k), lambda i: (i, 0, 0),
+                           memory_space=pltpu.VMEM)
+    tile_sc = pl.BlockSpec((T, 1), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    Z, ld = pl.pallas_call(
+        functools.partial(_mega_solve_kernel, refine),
+        grid=(Bp // T,),
+        in_specs=[smem, smem, tile_nn, tile_nk],
+        out_specs=[tile_nk, tile_sc],
+        out_shape=[jax.ShapeDtypeStruct((Bp, n, k), jnp.float32),
+                   jax.ShapeDtypeStruct((Bp, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((T, n, n), jnp.float32)
+                        for _ in range(4)],
+        interpret=interpret,
+    )(j1a, j2a, Sn_b, Bn_b)
+    return Z[:B], ld[:B, 0]
+
+
+def _mega_solve_xla(Sn_b, Bn_b, j1, j2, refine, ad=False):
+    """Batched XLA twin of the solve megakernel — the numerical
+    reference the probe and the interpret tests compare against, the
+    unbatched/CPU fallback, and (``ad=True``, sanitized factorizations)
+    the backward-pass implementation."""
+    f32 = jnp.float32
+    U, V, E = (_fused_xla_ad if ad else _fused_xla)(Sn_b, j1, j2)
+    Vt = jnp.swapaxes(V, -1, -2)
+
+    def psolve(R):
+        return jnp.matmul(V, jnp.matmul(Vt, R, precision=_HIGH),
+                          precision=_HIGH)
+
+    Z0 = psolve(Bn_b)
+    Z = Z0
+    r0 = None
+    for i in range(refine):
+        r = Bn_b - jnp.matmul(Sn_b, Z, precision=_HIGH)
+        if i == 0:
+            r0 = r
+        Z = Z + psolve(r)
+    res_ref = jnp.sum(jnp.square(Bn_b - jnp.matmul(Sn_b, Z,
+                                                   precision=_HIGH)),
+                      axis=(1, 2))
+    res_pre = jnp.sum(jnp.square(r0), axis=(1, 2)) if r0 is not None \
+        else res_ref
+    Z = jnp.where((res_ref <= res_pre)[:, None, None], Z, Z0)
+
+    Et = jnp.swapaxes(E, -1, -2)
+    E2 = jnp.matmul(E, E, precision=_HIGH)
+    corr = (jnp.trace(E, axis1=-2, axis2=-1)
+            - jnp.sum(E * Et, axis=(1, 2)) / 2.0
+            + jnp.sum(E2 * Et, axis=(1, 2)) / 3.0
+            - jnp.sum(E2 * jnp.swapaxes(E2, -1, -2), axis=(1, 2)) / 4.0)
+    corr = jnp.where(jnp.sum(E * E, axis=(1, 2)) < 0.09, corr, 0.0)
+    diagU = jnp.diagonal(U, axis1=-2, axis2=-1).astype(f32)
+    ld = 2.0 * jnp.sum(jnp.log(diagU), axis=1) + corr
+    return Z, ld
+
+
+# one custom_vmap op per (refine, interpret) static pair — custom_vmap
+# has no static-argument channel, and the op cache keeps retraces from
+# rebuilding primitives
+_SOLVE_OPS = {}
+
+
+def _solve_op(refine, interpret=False):
+    key = (refine, interpret)
+    if key in _SOLVE_OPS:
+        return _SOLVE_OPS[key]
+
+    @custom_batching.custom_vmap
+    def inner(Sn32, Bn32, j1, j2):
+        _record_path("mega_solve", "xla-fallback")
+        Z, ld = _mega_solve_xla(Sn32[None], Bn32[None], j1, j2, refine)
+        return Z[0], ld[0]
+
+    @inner.def_vmap
+    def _vmap_rule(axis_size, in_batched, Sn32, Bn32, j1, j2):
+        del axis_size
+        if not (in_batched[0] and in_batched[1]) or in_batched[2] \
+                or in_batched[3]:
+            raise NotImplementedError(
+                "mega_solve expects matrix+RHS batched, scalar jitters")
+        if interpret:
+            _record_path("mega_solve", "pallas")
+            out = _mega_solve_raw(Sn32, Bn32, j1, j2, refine,
+                                  interpret=True)
+        elif Sn32.shape[-1] <= _MEGA_MAX_N and _rule_route("mega_solve"):
+            out = _mega_solve_raw(Sn32, Bn32, j1, j2, refine,
+                                  interpret=_env_interpret())
+        else:
+            out = _mega_solve_xla(Sn32, Bn32, j1, j2, refine)
+        return out, (True, True)
+
+    _SOLVE_OPS[key] = inner
+    return inner
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def mega_solve_logdet(Sn32, Bn32, j1, j2, refine, interpret=False):
+    """Fused post-equilibration mixed solve: ``(Z, ld_eq)`` for one
+    equilibrated f32 cast and RHS — the whole
+    factor/solve/refine/logdet chain of
+    ``ops.kernel._mixed_psd_solve_logdet`` in ONE dispatch when the
+    batched rule routes to the Pallas kernel. Gradients re-derive the
+    primal through the (sanitized) XLA twin — exact at pre-fusion cost;
+    the fused dispatch is for value-only sampling."""
+    return _solve_op(refine, interpret)(Sn32, Bn32, j1, j2)
+
+
+def _mega_solve_fwd(Sn32, Bn32, j1, j2, refine, interpret=False):
+    return (_solve_op(refine, interpret)(Sn32, Bn32, j1, j2),
+            (Sn32, Bn32))
+
+
+def _mega_solve_bwd(j1, j2, refine, interpret, res, ct):
+    Sn32, Bn32 = res
+
+    def f(s, b):
+        Z, ld = _mega_solve_xla(s[None], b[None], j1, j2, refine,
+                                ad=True)
+        return Z[0], ld[0]
+
+    _, vjp = jax.vjp(f, Sn32, Bn32)
+    return vjp(ct)
+
+
+mega_solve_logdet.defvjp(_mega_solve_fwd, _mega_solve_bwd)
+
+
+# --------------------------------------------------------------------
+# likelihood megakernel (single-pulsar hot path)
+# --------------------------------------------------------------------
+#
+# Precision split: the kernel owns the O(ntoa * nb^2) Gram hog, the
+# Sigma assembly/equilibrated cast and the whole factor/solve/refine/
+# logdet chain — all f32-class. The cancellation-sensitive skinny side
+# (H, P, q, X, rwr) and the timing-model Schur complement stay OUTSIDE
+# in genuine f64, exactly like the classic split path: A's condition
+# number reaches ~1e10 (polynomial design columns), where any f32
+# factorization — jittered or not — loses the logdet by O(1) (measured:
+# an in-kernel f32 A-stage was off by ~2.5 in lnL at kappa(A)~4e6; the
+# f64 outside stage is off by ~1e-3).
+
+def _mega_like_kernel(refine, j1_ref, j2_ref, S_ref, w_ref, s_ref,
+                      ivb_ref, Bn_ref, Z_ref, ld_ref,
+                      Ss_ref, Gm_ref, X_ref, U_ref, U2_ref, V_ref):
+    T = w_ref.shape[0]
+    nb = s_ref.shape[1]
+    eyem, lane = _eye_lane(nb)
+    j1 = j1_ref[0, 0]
+    j2 = j2_ref[0, 0]
+
+    # ---- per-walker basis-Gram accumulation, entirely in VMEM ------- #
+    # Ss = T_w * sqrt(w) row scaling, G = Ss^T Ss on the MXU. Padded
+    # TOA rows carry w = 0 and contribute nothing.
+    for t in range(T):
+        sqw = jnp.sqrt(w_ref[t, :])                        # (ntoa,)
+        Ss_ref[:] = S_ref[:] * sqw[:, None]
+        Gm_ref[t] = _dot_t(Ss_ref[:], Ss_ref[:])
+
+    # ---- Sigma assembly + equilibrated cast ------------------------- #
+    # Sn = s G s + diag(invb * s^2); the scales come in from the f64
+    # host side (f32 equilibration of 1/phi would overflow at prior
+    # corners), so the unit diagonal holds to O(gram noise) and the
+    # tier-1 jitter dominates.
+    s_eq = s_ref[:]                                        # (T, nb)
+    Sn = (Gm_ref[:] * s_eq[:, :, None] * s_eq[:, None, :]
+          + ivb_ref[:][:, :, None] * eyem[None])
+
+    # ---- mixed solve + equilibrated logdet (shared subroutines) ----- #
+    _three_tier_chol(Sn, j1, j2, X_ref, U_ref, U2_ref, eyem, lane,
+                     T, nb)
+    _backsub_inv(U_ref, V_ref, lane, T, nb)
+    Z, ld_sig = _solve_refine_logdet(Sn, Bn_ref[:], U_ref, V_ref,
+                                     eyem, refine, T, nb)
+    Z_ref[:] = Z
+    ld_ref[:] = ld_sig[:, None]
+
+
+def _mega_like_raw(S32, w_b, s_b, ivb_b, Bn_b, j1, j2, refine,
+                   interpret=False):
+    """Invoke the likelihood megakernel: ``S32`` (ntoa, nb) static
+    whitened noise basis shared by every program; per-walker (B, ...)
+    weights, equilibration scales and equilibrated RHS. Returns
+    ``(Z, ld_eq)``."""
+    B, nb = w_b.shape[0], s_b.shape[-1]
+    k = Bn_b.shape[-1]
+    T = _tile_like(nb)
+    Bp = -(-B // T) * T
+    if Bp != B:
+        # pad with zero weights / unit scales / zero RHS: finite work
+        w_b = jnp.concatenate(
+            [w_b, jnp.zeros((Bp - B,) + w_b.shape[1:], w_b.dtype)],
+            axis=0)
+        s_b = jnp.concatenate(
+            [s_b, jnp.ones((Bp - B,) + s_b.shape[1:], s_b.dtype)],
+            axis=0)
+        ivb_b = jnp.concatenate(
+            [ivb_b, jnp.ones((Bp - B,) + ivb_b.shape[1:],
+                             ivb_b.dtype)], axis=0)
+        Bn_b = jnp.concatenate(
+            [Bn_b, jnp.zeros((Bp - B,) + Bn_b.shape[1:], Bn_b.dtype)],
+            axis=0)
+    ntoa = S32.shape[0]
+    j1a = jnp.full((1, 1), j1, jnp.float32)
+    j2a = jnp.full((1, 1), j2, jnp.float32)
+    smem = pl.BlockSpec((1, 1), lambda i: (0, 0),
+                        memory_space=pltpu.SMEM)
+    stat = pl.BlockSpec((ntoa, nb), lambda i: (0, 0),
+                        memory_space=pltpu.VMEM)
+    row_toa = pl.BlockSpec((T, ntoa), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    row_nb = pl.BlockSpec((T, nb), lambda i: (i, 0),
+                          memory_space=pltpu.VMEM)
+    tile_nk = pl.BlockSpec((T, nb, k), lambda i: (i, 0, 0),
+                           memory_space=pltpu.VMEM)
+    tile_sc = pl.BlockSpec((T, 1), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    Z, ld = pl.pallas_call(
+        functools.partial(_mega_like_kernel, refine),
+        grid=(Bp // T,),
+        in_specs=[smem, smem, stat, row_toa, row_nb, row_nb, tile_nk],
+        out_specs=[tile_nk, tile_sc],
+        out_shape=[jax.ShapeDtypeStruct((Bp, nb, k), jnp.float32),
+                   jax.ShapeDtypeStruct((Bp, 1), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((ntoa, nb), jnp.float32),       # Ss
+            pltpu.VMEM((T, nb, nb), jnp.float32),      # G
+            pltpu.VMEM((T, nb, nb), jnp.float32),      # chol working
+            pltpu.VMEM((T, nb, nb), jnp.float32),      # U
+            pltpu.VMEM((T, nb, nb), jnp.float32),      # U2
+            pltpu.VMEM((T, nb, nb), jnp.float32),      # V
+        ],
+        interpret=interpret,
+    )(j1a, j2a, S32, w_b, s_b, ivb_b, Bn_b)
+    return Z[:B], ld[:B, 0]
+
+
+def _mega_like_xla(S32, w_b, s_b, ivb_b, Bn_b, j1, j2, refine):
+    """Batched XLA twin of the likelihood megakernel (same f32 math,
+    ordinary XLA ops): the numerical reference for the probe and the
+    interpret tests, and the unbatched/too-big fallback."""
+    f32 = jnp.float32
+    nb = s_b.shape[-1]
+    sqw = jnp.sqrt(w_b)                                     # (B, ntoa)
+    Ss = S32[None] * sqw[:, :, None]
+    G = jnp.einsum("bik,bil->bkl", Ss, Ss, precision=_HIGH)
+    eye = jnp.eye(nb, dtype=f32)
+    Sn = (G * s_b[:, :, None] * s_b[:, None, :]
+          + ivb_b[:, :, None] * eye[None])
+    return _mega_solve_xla(Sn, Bn_b, j1, j2, refine)
+
+
+_LIKE_OPS = {}
+
+
+def _like_op(refine, interpret=False):
+    key = (refine, interpret)
+    if key in _LIKE_OPS:
+        return _LIKE_OPS[key]
+
+    @custom_batching.custom_vmap
+    def inner(S32, w, s, ivb, Bn, j1, j2):
+        _record_path("mega_like", "xla-fallback")
+        Z, ld = _mega_like_xla(S32, w[None], s[None], ivb[None],
+                               Bn[None], j1, j2, refine)
+        return Z[0], ld[0]
+
+    @inner.def_vmap
+    def _vmap_rule(axis_size, in_batched, S32, w, s, ivb, Bn, j1, j2):
+        del axis_size
+        if in_batched[0] or not all(in_batched[1:5]) \
+                or in_batched[5] or in_batched[6]:
+            raise NotImplementedError(
+                "mega_like expects static basis, batched per-walker "
+                "arrays, scalar jitters")
+        nb = s.shape[-1]
+        fits = (S32.shape[0] <= _MEGA_MAX_TOA and nb <= _MEGA_MAX_M)
+        if interpret and fits:
+            _record_path("mega_like", "pallas")
+            out = _mega_like_raw(S32, w, s, ivb, Bn, j1, j2, refine,
+                                 interpret=True)
+        elif fits and _rule_route("mega_like"):
+            out = _mega_like_raw(S32, w, s, ivb, Bn, j1, j2, refine,
+                                 interpret=_env_interpret())
+        else:
+            if not fits:
+                _record_path("mega_like", "xla-fallback")
+            out = _mega_like_xla(S32, w, s, ivb, Bn, j1, j2, refine)
+        return out, (True, True)
+
+    _LIKE_OPS[key] = inner
+    return inner
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def mega_marginalized_loglike(nw, b, r_w, M_w, T_w, mask, refine,
+                              interpret=False):
+    """Single-pulsar marginalized log-likelihood through the fused
+    megakernel: ONE Pallas dispatch per eval for the gram → Sigma →
+    Cholesky → solve → refine → TM-Schur → logdet chain, plus cheap
+    f64 host-precision scalar ops outside (equilibration scales, prior
+    log-determinants, final assembly). Same value semantics as
+    ``ops.kernel.marginalized_loglike`` within the megakernel's
+    documented f32 tolerance class (``mask`` must be a concrete array
+    here — pass ones when unmasked). Value path only: gradients
+    re-derive through the exact XLA reference kernel."""
+    return _mega_lnl_impl(nw, b, r_w, M_w, T_w, mask, refine,
+                          interpret)
+
+
+def _mega_lnl_impl(nw, b, r_w, M_w, T_w, mask, refine, interpret):
+    """The host-precision half of the likelihood megakernel. Besides
+    the f64 precision split (see the comment above
+    ``_mega_like_kernel``), every reduction out here is FOLDED — one
+    skinny-Gram reduction, one post-solve pairing reduction, one
+    concatenated log-determinant sum — because each separate reduction
+    is a fusion barrier, i.e. one more device dispatch of exactly the
+    latency class the megakernel exists to remove (the counts are the
+    committed ``dispatch_ops`` figures in ROOFLINE.json /
+    BENCH_MICRO.json)."""
+    from .kernel import CHOL_JITTER
+
+    f64 = r_w.dtype
+    ntm = M_w.shape[1]
+    w = mask / nw                                          # (ntoa,)
+    sqw = jnp.sqrt(w)
+    invb = 1.0 / b.astype(f64)
+    # The genuine-f64 skinny side, exactly as in the classic split
+    # path: everything that touches M or r feeds the TM Schur
+    # complement A = P - H^T Sigma^-1 H, whose cancellation amplifies
+    # Gram error by up to ~1e8 — it must never pass through the f32
+    # kernel. One fused broadcast-multiply + tree-sum reduction yields
+    # [HX; Pq] = [Ts; Us]^T Us at once.
+    Us = (jnp.concatenate([M_w, r_w[:, None]], axis=1)
+          * sqw[:, None])                                  # (ntoa, ntm+1)
+    Ts = T_w * sqw[:, None]
+    TU = jnp.concatenate([Ts, Us], axis=1)                 # (ntoa, nb+k)
+    R1 = jnp.sum(TU[:, :, None] * Us[:, None, :], axis=0)  # (nb+k, k)
+    nb = T_w.shape[1]
+    HX, Pq = R1[:nb], R1[nb:]
+    H, X = HX[:, :ntm], HX[:, ntm]
+    P, q, rwr = Pq[:ntm, :ntm], Pq[:ntm, ntm], Pq[ntm, ntm]
+    # equilibration stays in f64 OUTSIDE the kernel too: 1/phi spans
+    # the full prior exponent range and would overflow the f32 cast
+    dG = jnp.einsum("i,ik->k", w, T_w * T_w, precision=_HIGH)
+    d = dG + invb
+    s = 1.0 / jnp.sqrt(d)
+    Bn = s[:, None] * jnp.concatenate([X[:, None], H], axis=1)
+    j1 = float(CHOL_JITTER["split"])
+
+    # ---- the fused device half: gram + Sigma + factor/solve/logdet -- #
+    Z32, ld_eq = _like_op(refine, interpret)(
+        T_w.astype(jnp.float32), w.astype(jnp.float32),
+        s.astype(jnp.float32), (invb * s * s).astype(jnp.float32),
+        Bn.astype(jnp.float32), j1, 30.0 * j1)
+    ZXH = s[:, None] * Z32.astype(f64)
+
+    # ---- TM Schur stage, genuine f64 (classic structure) ------------ #
+    # one pairing reduction gives every quadratic at once:
+    # W = [H|X]^T ZXH, so H^T ZH = W[:ntm,1:], ZH^T X = W[ntm,1:],
+    # X^T zx = W[ntm,0], H^T zx = W[:ntm,0]
+    W = jnp.sum(HX[:, :, None] * ZXH[:, None, :], axis=0)  # (k, k)
+    A = P - W[:ntm, 1:]
+    y = q - W[ntm, 1:]
+    # f64 eigensolve with a relative clamp (the stage-2 semantics of
+    # the joint kernel): exact at normal points, condition-bounded PSD
+    # solve at corners, and no second factorization dispatch
+    evA, VA = jnp.linalg.eigh(A)
+    emax = jnp.max(jnp.abs(evA))
+    evA_cl = jnp.maximum(evA, 1e-13 * emax + 1e-300)
+    u = VA.T @ y
+    quad = rwr - W[ntm, 0] - jnp.sum(u * u / evA_cl)
+    # every log-determinant in ONE concatenated reduction
+    ld_all = jnp.sum(jnp.concatenate(
+        [jnp.log(nw) * mask, jnp.log(d), jnp.log(b),
+         jnp.log(evA_cl)]))
+    return -0.5 * (quad + ld_all + ld_eq.astype(f64))
+
+
+def _mega_lnl_fwd(nw, b, r_w, M_w, T_w, mask, refine, interpret=False):
+    return (_mega_lnl_impl(nw, b, r_w, M_w, T_w, mask, refine,
+                           interpret),
+            (nw, b, r_w, M_w, T_w, mask))
+
+
+def _mega_lnl_bwd(refine, interpret, res, ct):
+    """Backward pass through the exact XLA reference kernel: gradient
+    samplers keep split-path accuracy at pre-fusion cost (the fused
+    dispatch is for value-only sampling)."""
+    from .kernel import marginalized_loglike
+
+    nw, b, r_w, M_w, T_w, mask = res
+
+    def f(nw_, b_, r_, M_, T_, mask_):
+        return marginalized_loglike(nw_, b_, r_, M_, T_, mask=mask_,
+                                    gram_mode="split", refine=refine,
+                                    mega=False)
+
+    _, vjp = jax.vjp(f, nw, b, r_w, M_w, T_w, mask)
+    return vjp(ct)
+
+
+mega_marginalized_loglike.defvjp(_mega_lnl_fwd, _mega_lnl_bwd)
+
+
+# --------------------------------------------------------------------
+# probe ladder + routing
+# --------------------------------------------------------------------
+
+# representative matrix orders, one per _tile_solve class
+_PROBE_SHAPES_SOLVE = (80, 160, 256, 384)
+# (nb, k, ntoa) per _tile_like class
+_PROBE_SHAPES_LIKE = ((80, 4, 256), (128, 4, 384), (176, 5, 512))
+
+_PROBE_TRANSIENT_CAP = 3
+
+_STATE = {
+    "mega_solve": {"result": None, "reason": "not probed",
+                   "transients": 0, "last_path": None},
+    "mega_like": {"result": None, "reason": "not probed",
+                  "transients": 0, "last_path": None},
+}
+
+# trace-inspection override (tools/roofline.py --dispatch, bench.py
+# --micro): forces the dispatch rules to EMIT the pallas_call so
+# ``jax.make_jaxpr`` / dispatch_stats can count the fused program on
+# any backend. Tracing never executes the kernel, so this is safe off
+# TPU; actually RUNNING a force-routed trace off TPU fails in Mosaic
+# lowering — which is why execution paths never set it.
+_FORCE_ROUTE = False
+
+
+@contextlib.contextmanager
+def force_route():
+    """Force the dispatch rules onto the Pallas route for the duration
+    — TRACE INSPECTION ONLY (see ``_FORCE_ROUTE``). ``EWT_PALLAS=0``
+    still wins: the master hatch must restore the XLA path everywhere,
+    including op counting."""
+    global _FORCE_ROUTE
+    _FORCE_ROUTE = True
+    try:
+        yield
+    finally:
+        _FORCE_ROUTE = False
+
+
+def pallas_master_enabled():
+    """The package-wide Pallas escape hatch: ``EWT_PALLAS=0`` disables
+    EVERY Pallas kernel (megakernel and the cholfuse preconditioner)
+    and restores the pure-XLA path bit-for-bit."""
+    return os.environ.get("EWT_PALLAS", "1") != "0"
+
+
+def _mega_enabled():
+    return pallas_master_enabled() \
+        and os.environ.get("EWT_PALLAS_MEGA", "1") != "0"
+
+
+def _env_interpret():
+    """Interpreter-mode escape hatch (``EWT_PALLAS_INTERPRET=1``): run
+    the kernels through the Pallas interpreter on any backend —
+    CPU-testable end-to-end semantics, not a performance mode."""
+    return os.environ.get("EWT_PALLAS_INTERPRET", "0") == "1"
+
+
+def _record_path(kernel, path):
+    """Count the route a dispatch took, at trace time: one increment
+    per (re)trace, not per eval — a jit caches the decision with the
+    executable. Surfaces as ``pallas_path{kernel=,path=}`` in the
+    registry, sampler heartbeats, and bench provenance."""
+    from ..utils.telemetry import registry
+    registry().counter("pallas_path", kernel=kernel, path=path).inc()
+    if kernel in _STATE:
+        _STATE[kernel]["last_path"] = path
+
+
+def _probe_once_solve(interpret=False):
+    for n in _PROBE_SHAPES_SOLVE:
+        rng = np.random.default_rng(n)
+        A = rng.standard_normal((n, n)).astype(np.float64)
+        Sm = A @ A.T / n + np.eye(n)
+        dd = np.sqrt(np.diag(Sm))
+        Sn = (Sm / dd[:, None] / dd[None, :]).astype(np.float32)
+        T = _tile_solve(n)
+        Sb = jnp.broadcast_to(jnp.asarray(Sn), (T, n, n))
+        Bb = jnp.broadcast_to(
+            jnp.asarray(rng.standard_normal((n, 3)).astype(np.float32)),
+            (T, n, 3))
+        Z, ld = _mega_solve_raw(Sb, Bb, 1e-6, 3e-5, 2,
+                                interpret=interpret)
+        Zx, ldx = _mega_solve_xla(Sb, Bb, 1e-6, 3e-5, 2)
+        if not (np.all(np.isfinite(np.asarray(Z)))
+                and np.allclose(np.asarray(Z), np.asarray(Zx),
+                                atol=5e-4)
+                and np.allclose(np.asarray(ld), np.asarray(ldx),
+                                atol=5e-4)):
+            return False
+    # outer-vmap composition (walkers x pulsars): vmap of pallas_call
+    # lowers through the batched-grid route — probe it too
+    n = 80
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((n, n))
+    Sm = A @ A.T / n + np.eye(n)
+    dd = np.sqrt(np.diag(Sm))
+    Sn = (Sm / dd[:, None] / dd[None, :]).astype(np.float32)
+    Sb = jnp.broadcast_to(jnp.asarray(Sn), (2, 2, n, n))
+    Bb = jnp.broadcast_to(
+        jnp.asarray(rng.standard_normal((n, 2)).astype(np.float32)),
+        (2, 2, n, 2))
+    Zv = jax.vmap(lambda sm, bm: _mega_solve_raw(
+        sm, bm, 1e-6, 3e-5, 2, interpret=interpret)[0])(Sb, Bb)
+    Zx, _ = _mega_solve_xla(Sb[0], Bb[0], 1e-6, 3e-5, 2)
+    return bool(np.all(np.isfinite(np.asarray(Zv)))
+                and np.allclose(np.asarray(Zv[0]), np.asarray(Zx),
+                                atol=5e-4))
+
+
+def _probe_once_like(interpret=False):
+    for nb, k, ntoa in _PROBE_SHAPES_LIKE:
+        rng = np.random.default_rng(nb)
+        S = (rng.standard_normal((ntoa, nb))
+             / np.sqrt(ntoa)).astype(np.float32)
+        T = _tile_like(nb)
+        w = (1.0 + 0.1 * rng.random((T, ntoa))).astype(np.float32)
+        s = np.ones((T, nb), np.float32)
+        ivb = np.full((T, nb), 0.5, np.float32)
+        Bn = np.broadcast_to(
+            rng.standard_normal((nb, k)).astype(np.float32),
+            (T, nb, k))
+        Z, ld = _mega_like_raw(jnp.asarray(S), jnp.asarray(w),
+                               jnp.asarray(s), jnp.asarray(ivb),
+                               jnp.asarray(Bn), 3e-6, 9e-5, 2,
+                               interpret=interpret)
+        Zx, ldx = _mega_like_xla(jnp.asarray(S), jnp.asarray(w),
+                                 jnp.asarray(s), jnp.asarray(ivb),
+                                 jnp.asarray(Bn), 3e-6, 9e-5, 2)
+        if not (np.all(np.isfinite(np.asarray(Z)))
+                and np.allclose(np.asarray(Z), np.asarray(Zx),
+                                atol=5e-4)
+                and np.allclose(np.asarray(ld), np.asarray(ldx),
+                                atol=5e-4)):
+            return False
+    return True
+
+
+_PROBES = {"mega_solve": _probe_once_solve,
+           "mega_like": _probe_once_like}
+
+
+def _available(kernel):
+    """One-time compile-and-run probe of ``kernel`` against its XLA
+    twin — same verdict-caching contract as
+    ``ops.cholfuse.pallas_chol_available``: accuracy/lowering failures
+    pin False for the process; transient (transport) failures leave
+    the verdict unset so a later call re-probes, up to a cap."""
+    st = _STATE[kernel]
+    if st["result"] is not None:
+        return st["result"]
+    from ..utils.logging import get_logger
+    _log = get_logger("ewt.megakernel")
+    try:
+        ok = _PROBES[kernel]()
+        st["result"] = ok
+        st["reason"] = ("probe passed" if ok
+                        else "accuracy check failed")
+        if not ok:
+            _log.warning("%s Pallas probe compiled but failed the "
+                         "accuracy check; using the XLA path", kernel)
+    except Exception as exc:
+        if _is_transient(exc):
+            st["transients"] += 1
+            st["reason"] = f"transient probe failure: {exc!r}"[:300]
+            if st["transients"] >= _PROBE_TRANSIENT_CAP:
+                st["reason"] = (
+                    f"{st['transients']} consecutive transient probe "
+                    f"failures (cap) — last: {exc!r}")[:300]
+                _log.warning("%s Pallas probe transient-failure cap "
+                             "reached; pinning the XLA path", kernel)
+                st["result"] = False
+                return False
+            _log.warning("%s Pallas probe hit a transient error (%r); "
+                         "XLA path for this trace, will re-probe",
+                         kernel, exc)
+            return False
+        st["reason"] = f"compile/lowering failure: {exc!r}"[:300]
+        st["result"] = False
+        _log.warning("%s Pallas probe failed (%r); using the XLA path",
+                     kernel, exc)
+    return st["result"]
+
+
+def _ladder(kernel, record_accept):
+    """The one routing ladder every decision goes through: master
+    hatch, force-route (trace inspection), mega toggle, interpreter
+    escape hatch, backend, probe. ``record_accept`` — whether THIS
+    call site owns the accept-side telemetry (the vmap rules do; the
+    kernel-level route helpers leave the accept to the rule that
+    actually dispatches, recording only their declines)."""
+    if not pallas_master_enabled():
+        _record_path(kernel, "xla-fallback")
+        return False
+    if _FORCE_ROUTE:
+        # trace inspection, never execution: counted under its own
+        # label so bench/report provenance can't mistake a forced
+        # counting trace for a genuinely Pallas-routed run
+        if record_accept:
+            _record_path(kernel, "forced-trace")
+        return True
+    if not _mega_enabled():
+        _record_path(kernel, "xla-fallback")
+        return False
+    if _env_interpret():
+        if record_accept:
+            _record_path(kernel, "pallas")
+        return True
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    if not on_tpu:
+        _record_path(kernel, "xla-fallback")
+        return False
+    if _available(kernel):
+        if record_accept:
+            _record_path(kernel, "pallas")
+        return True
+    _record_path(kernel, "probe-failed")
+    return False
+
+
+def _rule_route(kernel):
+    """The vmap-dispatch-rule decision for one batched call, with its
+    ``pallas_path`` telemetry side effect (trace-time)."""
+    return _ladder(kernel, record_accept=True)
+
+
+def mega_like_fits(ntoa, nb):
+    """Size-cap check of the likelihood megakernel, on the CONCRETE
+    shapes available at the route decision: over-cap calls must keep
+    the classic split path (they would otherwise be committed to the
+    f32 XLA twin with zero dispatch win — see the decline contract in
+    :func:`mega_like_route`)."""
+    return ntoa <= _MEGA_MAX_TOA and nb <= _MEGA_MAX_M
+
+
+def mega_solve_fits(n):
+    """Size-cap check of the solve megakernel (see
+    :func:`mega_like_fits`)."""
+    return n <= _MEGA_MAX_N
+
+
+def mega_like_route(ntoa, nb):
+    """Trace-time routing decision for the single-pulsar LIKELIHOOD
+    megakernel, taken INSIDE ``ops.kernel.marginalized_loglike`` before
+    the classic Gram stage is traced, on the call's concrete shapes.
+    Declining here — env off, non-TPU backend, probe failed, OVER-CAP
+    shape — keeps the classic split path bit-for-bit (the megakernel's
+    f32 twin never runs); accepting commits the trace to the megakernel
+    tolerance class with the Pallas/XLA-twin split handled by the
+    dispatch rule. The probe runs here (concrete inputs, legal
+    mid-trace) so a probe failure also falls back to the EXACT classic
+    path, not the twin."""
+    if not mega_like_fits(ntoa, nb):
+        return False
+    return _ladder("mega_like", record_accept=False)
+
+
+def mega_solve_route(n):
+    """Trace-time routing decision for the SOLVE megakernel inside
+    ``_mixed_psd_solve_logdet`` — same contract as
+    :func:`mega_like_route` (decline, including over-cap ``n``, =
+    exact classic chain)."""
+    if not mega_solve_fits(n):
+        return False
+    return _ladder("mega_solve", record_accept=False)
+
+
+def dispatch_ab_counts(r_w, M_w, T_w, cs2, batch=64, seed=7,
+                       solve_refine=3):
+    """Classic-vs-fused dispatch statistics of the recorded hot path —
+    the ONE measurement protocol behind both committed artifacts
+    (ROOFLINE.json["dispatch"] via ``tools/roofline.py --dispatch`` and
+    BENCH_MICRO.json["fused_ab"] via ``bench.py --micro``), so the two
+    records can never drift apart.
+
+    Counts the full kernel (nw, b -> lnL; the gram+solve+TM-Schur
+    composite the roofline phases cover, classic side on the
+    pair-program gram path) and the solve phase alone, by jaxpr
+    inspection (``utils.telemetry.dispatch_stats``) with the fused
+    route forced for COUNTING only — backend-independent and honest on
+    CPU, because tracing never executes the Pallas kernel. Returns
+    ``{"full_classic", "full_mega", "solve_classic", "solve_mega"}``.
+    """
+    from .kernel import (_mixed_psd_solve_logdet, build_pair_program,
+                         marginalized_loglike)
+    from ..utils.telemetry import dispatch_stats
+
+    ntoa, nb = T_w.shape
+    nu = M_w.shape[1] + 1
+    rng = np.random.default_rng(seed)
+    nw = jnp.asarray(np.exp(0.1 * rng.standard_normal((batch, ntoa))))
+    b = jnp.asarray(10.0 ** rng.uniform(-2, 2, (batch, nb)) * cs2)
+    prog = build_pair_program(r_w, M_w, T_w)
+    r_j, M_j, T_j = (jnp.asarray(r_w), jnp.asarray(M_w),
+                     jnp.asarray(T_w))
+
+    def kern(mega, pair=None):
+        return lambda nwb, bb: jax.vmap(
+            lambda nwi, bi: marginalized_loglike(
+                nwi, bi, r_j, M_j, T_j, pair_program=pair,
+                mega=mega))(nwb, bb)
+
+    A = rng.standard_normal((batch, nb, nb))
+    Gs = jnp.asarray(np.einsum("bij,bkj->bik", A, A) / nb
+                     + 3.0 * np.eye(nb)[None])
+    RHS = jnp.asarray(rng.standard_normal((batch, nb, nu)))
+
+    def solve_fn(mega):
+        return lambda Sb, Rb: jax.vmap(
+            lambda s_, rr: _mixed_psd_solve_logdet(
+                s_, rr, 3e-6, refine=solve_refine, delta_mode="split",
+                mega=mega))(Sb, Rb)
+
+    counts = {
+        "full_classic": dispatch_stats(kern(False, prog), nw, b),
+        "solve_classic": dispatch_stats(solve_fn(False), Gs, RHS),
+    }
+    with force_route():
+        counts["full_mega"] = dispatch_stats(kern(True), nw, b)
+        counts["solve_mega"] = dispatch_stats(solve_fn(True), Gs, RHS)
+    return counts
+
+
+def dispatch_reduction(counts, phase, key="dispatch_ops"):
+    """``classic/mega`` ratio of one phase of a
+    :func:`dispatch_ab_counts` record (None when a side is missing)."""
+    cl = counts.get(f"{phase}_classic", {}).get(key)
+    mg = counts.get(f"{phase}_mega", {}).get(key)
+    if not cl or not mg:
+        return None
+    return round(cl / mg, 2)
+
+
+def mega_status():
+    """Provenance record for the bench/roofline artifacts: per-kernel
+    probe verdicts, reasons, transient counts, and the last dispatch
+    route taken. Never triggers a probe itself."""
+    return {
+        kernel: {
+            "available": (None if st["result"] is None
+                          else bool(st["result"])),
+            "reason": st["reason"],
+            "transient_failures": st["transients"],
+            "last_path": st["last_path"],
+        }
+        for kernel, st in _STATE.items()
+    }

@@ -388,7 +388,7 @@ def _coupling_inverse(phi_gw, s, orf, pad_diag, npsr_real):
 
 def build_pta_likelihood(psrs, termlists, fixed_values=None,
                          gram_mode="split", ecorr_dt=10.0, mesh=None,
-                         psr_axis="psr", joint_mode=None):
+                         psr_axis="psr", joint_mode=None, mega=None):
     """Compile per-pulsar TermLists + ORF coupling into one joint kernel.
 
     ``mesh`` — optional ``jax.sharding.Mesh`` with axis ``psr_axis``; the
@@ -404,9 +404,24 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
     ``'dense'`` (one dense equilibrated Cholesky of the joint Sigma), or
     None for the default: schur for ``gram_mode`` 'split'/'f32', dense for
     'f64' (the oracle).
+
+    ``mega`` — solve-megakernel routing for the stage-1 noise-block
+    factorizations and the stage-3 GW Schur solve (``ops.megakernel``:
+    the whole post-equilibration factor/solve/refine/logdet chain of
+    each ``_mixed_psd_solve_logdet`` becomes ONE Pallas dispatch —
+    under the pulsar vmap that is the outer-vmap composition the
+    megakernel probe validates). ``None`` (default): auto per the
+    dispatch ladder (TPU + ``EWT_PALLAS``/``EWT_PALLAS_MEGA`` + probe;
+    the f64 oracle path never routes). ``False``: pin the classic
+    chain. Resolved per TRACE, not per build — but burned into this
+    builder's closures so a paramfile can pin it.
     """
     if joint_mode is None:
         joint_mode = "dense" if gram_mode == "f64" else "schur"
+    # the f64 oracle path must never change accuracy class; 'split' /
+    # 'f32' builds leave the megakernel ladder to decide unless the
+    # caller pinned it
+    mega = False if gram_mode == "f64" else mega
     if mesh is not None and psr_axis not in mesh.axis_names:
         mesh = None                 # no pulsar axis -> no model sharding
     npsr_real = len(psrs)
@@ -687,10 +702,15 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
             # vmapped over pulsars this lowers exactly like _bmm64
             return jnp.sum(A[:, :, None] * B[:, None, :], axis=0)
 
-        # stage 1: mixed-precision factorization of the noise block
+        # stage 1: mixed-precision factorization of the noise block.
+        # Under the (walkers x pulsars) double vmap the megakernel
+        # route turns the whole per-pulsar factor/solve/refine/logdet
+        # chain into one batched-grid Pallas dispatch (the outer-vmap
+        # composition its probe validates).
         RHS = jnp.concatenate([Xn[:, None], H, Cng], axis=1)
         Z, ld_nn = _mixed_psd_solve_logdet(Gnn, RHS, jitter, refine=3,
-                                           delta_mode=stage1_delta)
+                                           delta_mode=stage1_delta,
+                                           mega=mega)
         Zx, ZH, ZC = Z[:, 0], Z[:, 1:1 + MW], Z[:, 1 + MW:]
 
         # stage 2: exact timing-model marginalization, genuine f64
@@ -750,7 +770,7 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         else:
             Zs, ld_S = _mixed_psd_solve_logdet(
                 S, Xs.reshape(n_s, 1), jitter, refine=3,
-                delta_mode="split")
+                delta_mode="split", mega=mega)
             xsx = jnp.sum(Xs.reshape(n_s) * Zs[:, 0])
         lnl = -0.5 * (quad_base - xsx + lds + logdet_b + ld_S)
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
@@ -854,5 +874,5 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
                         stage12_single=_stage12_single, stage3=_stage3,
                         NW=NW, MW=MW, n_g=n_g, npsr=npsr,
                         jitter=jitter, tm_pad=tm_pad_j,
-                        joint_mode=joint_mode)
+                        joint_mode=joint_mode, mega=mega)
     return like

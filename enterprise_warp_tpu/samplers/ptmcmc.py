@@ -1261,6 +1261,13 @@ class PTSampler:
                           host_sync_wall_s=round(sync_s, 4),
                           block_bubble_s=round(bubble_s, 4),
                           max_lnl=round(max_lnl, 3))
+                # which Pallas route the likelihood's traces actually
+                # took (pallas / xla-fallback / probe-failed) — a
+                # mid-run transient probe failure shows up here, not
+                # just in post-hoc bench provenance
+                pp = telemetry.pallas_path_summary()
+                if pp:
+                    hb["pallas_path"] = pp
                 worst = self._block_diag(cs, diag_t)
                 if worst is not None:
                     hb["rhat"] = worst["rhat"]

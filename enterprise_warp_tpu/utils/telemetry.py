@@ -53,7 +53,7 @@ import time
 
 __all__ = ["enabled", "registry", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "traced", "RunRecorder", "run_scope",
-           "active_recorder"]
+           "active_recorder", "dispatch_stats", "pallas_path_summary"]
 
 
 def enabled() -> bool:
@@ -296,6 +296,151 @@ def traced(fn, *, name: str | None = None, **jit_kwargs):
     call._jitted = jitted
     call._telemetry_name = label
     return call
+
+
+# ------------------------------------------------------------------ #
+#  dispatch/fusion inspection (compiled-module telemetry)             #
+# ------------------------------------------------------------------ #
+
+# jaxpr primitives whose body is a SINGLE device program: counted as
+# one op, never recursed into. ``pallas_call`` is the whole point of
+# the megakernel — its inner jaxpr describes the kernel, not separate
+# dispatches.
+_OPAQUE_PRIMITIVES = {"pallas_call", "tpu_custom_call", "custom_call"}
+
+# Primitives that XLA cannot fuse into a neighboring elementwise chain
+# — each one is (at least) its own kernel launch / fusion barrier on
+# the device, and several (cholesky, triangular_solve) lower on TPU to
+# O(n) serialized sweeps. Everything NOT listed here (broadcasts,
+# iota, converts, adds/muls, selects, slices...) fuses into adjacent
+# loops and contributes no dispatch of its own, so the barrier count
+# is the platform-honest dispatch proxy.
+_BARRIER_PRIMITIVES = {
+    "dot_general", "cholesky", "triangular_solve", "eigh", "svd", "lu",
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "argmax", "argmin", "reduce_precision",
+    "scatter", "scatter-add", "scatter_add", "gather", "sort",
+    "cumsum", "cumprod", "cumlogsumexp", "fft", "conv_general_dilated",
+    "while", "scan", "cond", "all_reduce", "psum", "all_gather",
+} | _OPAQUE_PRIMITIVES
+
+
+def _count_jaxpr_ops(jaxpr):
+    """Flattened equation statistics of a (closed) jaxpr: call-like
+    primitives (pjit, closed_call, custom_jvp/vjp/vmap wrappers, remat)
+    contribute their BODY's count; control flow (cond/while/scan)
+    counts each branch/body once plus itself; opaque device programs
+    (see ``_OPAQUE_PRIMITIVES``) count as one. Returns ``(total,
+    barriers)`` — all lowered ops, and the fusion-barrier subset (see
+    ``_BARRIER_PRIMITIVES``). Both figures are platform-independent
+    and computable on the CPU backend even for TPU-only Pallas routes,
+    because tracing never executes the kernel."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0
+    barriers = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _OPAQUE_PRIMITIVES:
+            total += 1
+            barriers += 1
+            continue
+        subs = []
+        for v in eqn.params.values():
+            leaves = v if isinstance(v, (list, tuple)) else [v]
+            for leaf in leaves:
+                if hasattr(leaf, "eqns") or hasattr(leaf, "jaxpr"):
+                    subs.append(leaf)
+        if subs:
+            for s in subs:
+                t, b = _count_jaxpr_ops(s)
+                total += t
+                barriers += b
+            # control flow keeps its own dispatch-side cost too
+            if name in ("cond", "while", "scan"):
+                total += 1
+                barriers += 1
+        else:
+            total += 1
+            if name in _BARRIER_PRIMITIVES:
+                barriers += 1
+    return total, barriers
+
+
+def _count_hlo_entry(hlo_text):
+    """Instruction count of the ENTRY computation of an (optimized) HLO
+    module dump — after XLA fusion each entry instruction is roughly
+    one executable thunk/kernel launch, so this is the closest
+    compiled-module proxy for the per-call dispatch count."""
+    in_entry = False
+    n = 0
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry:
+            if stripped.startswith("}"):
+                break
+            if " = " in stripped and not stripped.startswith("//"):
+                n += 1
+    return n
+
+
+def dispatch_stats(fn, *args, **kwargs):
+    """Dispatch/fusion statistics of one traced call: how many lowered
+    ops the program contains, how many of them are fusion barriers
+    (each its own device dispatch — see ``_BARRIER_PRIMITIVES``), and
+    — when the current backend can compile it — how many fused
+    instructions the optimized executable's entry computation runs per
+    call.
+
+    Returns ``{"jaxpr_ops", "dispatch_ops", "hlo_entry_instructions",
+    "hlo_total_instructions", "compile_error"}``; the HLO fields are
+    None when AOT compilation is unavailable (e.g. a force-routed
+    Pallas program on the CPU backend — Mosaic only lowers on TPU; the
+    jaxpr figures are still exact there, since tracing never executes
+    the kernel)."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    total, barriers = _count_jaxpr_ops(closed)
+    out = {"jaxpr_ops": total,
+           "dispatch_ops": barriers,
+           "hlo_entry_instructions": None,
+           "hlo_total_instructions": None,
+           "compile_error": None}
+    try:
+        compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+        try:
+            texts = [m.to_string() for m in compiled.hlo_modules()]
+        except AttributeError:
+            texts = [compiled.as_text()]
+        out["hlo_entry_instructions"] = sum(_count_hlo_entry(t)
+                                            for t in texts)
+        out["hlo_total_instructions"] = sum(
+            1 for t in texts for line in t.splitlines()
+            if " = " in line.strip())
+    except Exception as exc:   # noqa: BLE001 — Mosaic off-TPU, etc.
+        out["compile_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    return out
+
+
+def pallas_path_summary():
+    """Compact view of the ``pallas_path{kernel=,path=}`` counters —
+    which Pallas route each kernel's (re)traces took this process:
+    ``{kernel: {path: count}}``, empty when nothing Pallas-routable has
+    been traced (or telemetry is disabled). Consumed by sampler
+    heartbeats, ``tools/report.py`` and the bench provenance blocks."""
+    snap = _REGISTRY.snapshot()["counters"]
+    out: dict = {}
+    for key, count in snap.items():
+        if not key.startswith("pallas_path{"):
+            continue
+        labels = dict(part.split("=", 1)
+                      for part in key[len("pallas_path{"):-1].split(","))
+        kernel = labels.get("kernel", "?")
+        out.setdefault(kernel, {})[labels.get("path", "?")] = count
+    return out
 
 
 # ------------------------------------------------------------------ #

@@ -244,6 +244,30 @@ def test_no_bare_jax_jit_outside_telemetry():
         "compiles/retraces are counted):\n" + "\n".join(offenders))
 
 
+def test_no_raw_pallas_call_outside_ops():
+    """``pl.pallas_call(`` is banned in the package outside ``ops/`` —
+    every Pallas kernel must live behind the probe/fallback dispatch
+    ladder (``ops.megakernel`` / ``ops.cholfuse``: custom_vmap routing,
+    compile-and-run probe per tile class, transient-error re-probe,
+    ``EWT_PALLAS`` master hatch, ``pallas_path`` telemetry). A raw call
+    site elsewhere would put an unprobed Mosaic compile inside a hot
+    jit, exactly where its failure cannot be caught."""
+    allowed_dir = PKG_DIR / "ops"
+    offenders = []
+    for path in sorted(PKG_DIR.rglob("*.py")):
+        if allowed_dir in path.parents:
+            continue
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1):
+            if "pallas_call(" in line:
+                offenders.append(f"{path.relative_to(REPO_ROOT)}:"
+                                 f"{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raw pallas_call outside ops/ (route kernels through the "
+        "ops.megakernel/ops.cholfuse probe+fallback ladder):\n"
+        + "\n".join(offenders))
+
+
 # ------------------------------------------------------------------ #
 #  report CLI                                                         #
 # ------------------------------------------------------------------ #

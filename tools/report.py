@@ -113,6 +113,7 @@ def build_report(events, dropped=0):
     # ---- heartbeat folds: eval-rate timeline + convergence ---------- #
     rate_timeline, convergence, cache_hit = [], [], None
     bubble_s, host_sync_s, bubble_blocks = 0.0, 0.0, 0
+    pallas_path = None
     for hb in heartbeats:
         t_rel = round(hb["t"] - t0, 2) if t0 is not None else None
         if hb.get("evals_per_s") is not None:
@@ -125,6 +126,11 @@ def build_report(events, dropped=0):
                                 "ess": hb.get("ess")})
         if hb.get("cache_hit_rate") is not None:
             cache_hit = hb["cache_hit_rate"]
+        # which Pallas route each kernel's traces took (megakernel /
+        # fused preconditioner dispatch ladder) — last heartbeat wins,
+        # since the counters are cumulative
+        if hb.get("pallas_path") is not None:
+            pallas_path = hb["pallas_path"]
         # block-boundary accounting (device-resident state layer):
         # per-block gauges sum to the device-idle and host-blocked
         # wall of the run
@@ -179,6 +185,7 @@ def build_report(events, dropped=0):
                           else None),
         },
         "cache_hit_rate": cache_hit,
+        "pallas_path": pallas_path,
         "checkpoints": len(checkpoints),
         "metrics": (ends[-1].get("metrics") if ends else None),
     }
@@ -223,6 +230,12 @@ def _human_summary(report, out=sys.stdout):
           f"{len(conv['trajectory'])} checks")
     if report["cache_hit_rate"] is not None:
         p(f"cache_hit_rate: {report['cache_hit_rate']}")
+    if report.get("pallas_path"):
+        routes = "; ".join(
+            f"{kern}: " + ",".join(f"{path}x{n}"
+                                   for path, n in sorted(paths.items()))
+            for kern, paths in sorted(report["pallas_path"].items()))
+        p(f"pallas routes: {routes}")
     p(f"checkpoints: {report['checkpoints']}, heartbeats: "
       f"{report['events'].get('heartbeat', 0)}")
 

@@ -17,9 +17,28 @@ A phase running near max(t_flops, t_bw) is roofline-bound; a phase far
 above BOTH ceilings is latency/dispatch-bound (many small serialized ops
 — on TPU typically the batched Cholesky's sequential column sweep).
 
+Phase bookkeeping: phases and the full kernel are timed under ONE sync
+discipline (block-until-ready before and after the same rep loop), but
+phases timed in ISOLATION compile as standalone programs — XLA fuses
+across the gram/solve boundary inside the full kernel, so the phase
+sum can legitimately exceed the full-kernel time. The residual is
+therefore published CLAMPED at zero with the overlap recorded
+explicitly (``phase_overlap_ms`` + ``phase_sum_exceeds_total``) — a
+negative "time" must never appear in the artifact.
+
+Dispatch section (``--dispatch``, runs on any backend): per-eval
+lowered-op and fusion-barrier counts of the classic XLA kernel vs the
+fused Pallas megakernel route (``ops.megakernel``), measured by jaxpr
+inspection via ``utils.telemetry.dispatch_stats`` — tracing never
+executes the kernel, so the fused program is countable on the CPU
+backend even while the TPU tunnel is down. ``--dispatch`` updates the
+existing ROOFLINE.json in place (keeps the recorded device timings,
+fixes the phase bookkeeping fields, adds/refreshes ``dispatch``).
+
 Writes ROOFLINE.json at the repo root and a human-readable summary to
-stdout. Run on the device (the measurement chain does); on CPU it still
-runs but the ceilings are meaningless — the record is flagged.
+stdout. Run on the device (the measurement chain does); on CPU the
+timing mode still runs but the ceilings are meaningless — the record
+is flagged.
 """
 
 import json
@@ -61,6 +80,66 @@ def timeit(fn, *args):
         out = fn(*args)
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / REPS
+
+
+def phase_bookkeeping(t_full_ms, t_gram_ms, t_solve_ms):
+    """Residual bookkeeping for the phase split: phases timed in
+    isolation compile as standalone programs, so their sum can exceed
+    the fused full-kernel time. Clamp the published residual at zero
+    and record the overlap explicitly — never a negative time."""
+    residual = t_full_ms - t_gram_ms - t_solve_ms
+    return {
+        "residual_ms_outside_gram_plus_solve": round(max(residual, 0.0),
+                                                     3),
+        "phase_overlap_ms": round(max(-residual, 0.0), 3),
+        "phase_sum_exceeds_total": bool(residual < 0.0),
+        "phase_note": (
+            "phases are timed in isolation under the same sync "
+            "discipline as the full kernel; XLA fuses across the "
+            "gram/solve boundary inside the full kernel, so the phase "
+            "sum may exceed the total — the overlap is reported "
+            "instead of a negative residual"),
+    }
+
+
+def dispatch_section(r_w, M_w, T_w, cs2, batch=64, solve_refine=3):
+    """Per-eval dispatch statistics of the recorded hot path, classic
+    XLA vs the fused megakernel route: the full kernel (nw, b -> lnL;
+    the gram+solve+TM-Schur composite ROOFLINE's phases cover) and the
+    solve phase alone, via the ONE shared measurement protocol
+    (``ops.megakernel.dispatch_ab_counts`` — also behind
+    BENCH_MICRO.json's fused_ab leg, so the two artifacts cannot
+    drift). jaxpr inspection only — backend-independent, honest on CPU
+    (the fused pallas_call is traced, never executed)."""
+    from enterprise_warp_tpu.ops import megakernel as mk
+
+    counts = mk.dispatch_ab_counts(r_w, M_w, T_w, cs2, batch=batch,
+                                   solve_refine=solve_refine)
+    return {
+        "method": ("jaxpr inspection (utils.telemetry.dispatch_stats): "
+                   "jaxpr_ops = all lowered ops, dispatch_ops = fusion "
+                   "barriers (each its own device dispatch; elementwise "
+                   "chains fuse into neighbors); pallas_call counts as "
+                   "ONE. Counted at trace time — backend-independent, "
+                   "valid on the CPU backend."),
+        "counted_on": jax.devices()[0].platform,
+        "full_kernel": {
+            "classic": counts["full_classic"],
+            "mega": counts["full_mega"],
+            "jaxpr_reduction": mk.dispatch_reduction(
+                counts, "full", "jaxpr_ops"),
+            "dispatch_reduction": mk.dispatch_reduction(counts, "full"),
+        },
+        "solve_phase": {
+            "classic": counts["solve_classic"],
+            "mega": counts["solve_mega"],
+            "jaxpr_reduction": mk.dispatch_reduction(
+                counts, "solve", "jaxpr_ops"),
+            "dispatch_reduction": mk.dispatch_reduction(counts,
+                                                        "solve"),
+        },
+        "mega_status": mk.mega_status(),
+    }
 
 
 def main():
@@ -162,14 +241,55 @@ def main():
             "binding_resource": s_which,
             "roofline_fraction": s_eff,
         },
-        "residual_ms_outside_gram_plus_solve": round(
-            (t_full - t_gram - t_solve) * 1e3, 3),
         "ceilings": {"peak_f32_flops": PEAK_F32, "hbm_bw": HBM_BW},
     }
+    rec.update(phase_bookkeeping(t_full * 1e3, t_gram * 1e3,
+                                 t_solve * 1e3))
+    rec["dispatch"] = dispatch_section(r_w, M_w, T_w, cs2)
     with open(os.path.join(REPO, "ROOFLINE.json"), "w") as fh:
         json.dump(rec, fh, indent=1)
     print(json.dumps(rec, indent=1))
 
 
+def dispatch_only():
+    """``--dispatch``: refresh the dispatch section and fix the phase
+    bookkeeping of the EXISTING ROOFLINE.json without touching its
+    recorded device timings (countable on any backend — the fused
+    program is traced, never executed). Falls back to a fresh minimal
+    record when no prior roofline exists."""
+    path = os.path.join(REPO, "ROOFLINE.json")
+    rec = {}
+    if os.path.exists(path):
+        with open(path) as fh:
+            rec = json.load(fh)
+
+    psr, terms = g._flagship_single_pulsar()
+    T = np.concatenate([b.F if b.row_scale is None
+                        else b.F * b.row_scale[:, None]
+                        for b in terms if hasattr(b, "F")], axis=1)
+    r_w, M_w, T_w, cs2, _ = whiten_inputs(
+        psr.residuals, psr.toaerrs, psr.Mmat, T)
+
+    # re-derive the residual bookkeeping from the recorded timings so
+    # the committed artifact never carries a negative phase residual
+    t_full = rec.get("full_kernel_ms")
+    g_ms = rec.get("gram", {}).get("measured_ms")
+    s_ms = rec.get("solve", {}).get("measured_ms")
+    if None not in (t_full, g_ms, s_ms):
+        rec.update(phase_bookkeeping(t_full, g_ms, s_ms))
+    rec["dispatch"] = dispatch_section(r_w, M_w, T_w, cs2)
+    rec["dispatch"]["counted_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(path, "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps(rec["dispatch"], indent=1))
+    if rec.get("phase_sum_exceeds_total"):
+        print(f"# phase overlap {rec['phase_overlap_ms']} ms "
+              "(isolated-phase compilation; residual clamped to 0)",
+              file=sys.stderr)
+
+
 if __name__ == "__main__":
-    main()
+    if "--dispatch" in sys.argv:
+        dispatch_only()
+    else:
+        main()
