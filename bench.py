@@ -600,14 +600,14 @@ def fused_ab_leg():
                      + 3.0 * np.eye(nb)[None])
     RHS = jnp.asarray(rng.standard_normal((B, nb, nu)))
 
+    # the shared measurement protocol (utils.profiling.timeit) — the
+    # same warmup/block/rep discipline behind ROOFLINE.json and the
+    # profile tools, so the timing half of this record is comparable
+    # across artifacts just like the dispatch-count half
+    from enterprise_warp_tpu.utils import profiling as _prof
+
     def timed(fn, *args):
-        o = fn(*args)
-        jax.block_until_ready(o)
-        t0 = time.perf_counter()
-        for _ in range(3):
-            o = fn(*args)
-        jax.block_until_ready(o)
-        return (time.perf_counter() - t0) / 3
+        return _prof.timeit(fn, *args, reps=3, name="bench_fused_ab")
 
     jfull = jax.jit(lambda nwb, bvb: jax.vmap(
         lambda nwi, bi: marginalized_loglike(

@@ -745,3 +745,24 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
     logdet_b = jnp.sum(jnp.log(b))
 
     return -0.5 * (quad + logdet_n + logdet_b + logdet_sigma + logdet_a)
+
+
+def _named_entry(name, fn):
+    """``jax.named_scope`` annotation for an ops entry point, so
+    ``jax.profiler`` captures (``EWT_PROFILE_CAPTURE`` — see
+    ``utils/profiling.py``) decompose a sampler block into legible
+    kernel regions. Pure annotation: the lowered computation, AD
+    behavior, and megakernel routing are unchanged."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.named_scope(name):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
+_mixed_psd_solve_logdet = _named_entry("ops.mixed_solve",
+                                       _mixed_psd_solve_logdet)
+marginalized_loglike = _named_entry("ops.marginalized_loglike",
+                                    marginalized_loglike)

@@ -367,7 +367,15 @@ def _solve_op(refine, interpret=False):
             _record_path("mega_solve", "pallas")
             out = _mega_solve_raw(Sn32, Bn32, j1, j2, refine,
                                   interpret=True)
-        elif Sn32.shape[-1] <= _MEGA_MAX_N and _rule_route("mega_solve"):
+        elif Sn32.shape[-1] > _MEGA_MAX_N:
+            # over-cap decline must be a recorded route too: a run
+            # pinned to mega=True but silently on the f32 XLA twin is
+            # otherwise indistinguishable from one that never touched
+            # the solve route (module contract: EVERY route taken
+            # lands in the pallas_path counters)
+            _record_path("mega_solve", "over-cap")
+            out = _mega_solve_xla(Sn32, Bn32, j1, j2, refine)
+        elif _rule_route("mega_solve"):
             out = _mega_solve_raw(Sn32, Bn32, j1, j2, refine,
                                   interpret=_env_interpret())
         else:
@@ -840,8 +848,10 @@ def _available(kernel):
     st = _STATE[kernel]
     if st["result"] is not None:
         return st["result"]
+    from ..utils.flightrec import flight_recorder
     from ..utils.logging import get_logger
     _log = get_logger("ewt.megakernel")
+    _fr = flight_recorder()
     try:
         ok = _PROBES[kernel]()
         st["result"] = ok
@@ -850,10 +860,14 @@ def _available(kernel):
         if not ok:
             _log.warning("%s Pallas probe compiled but failed the "
                          "accuracy check; using the XLA path", kernel)
+            _fr.record("pallas_probe", kernel=kernel,
+                       verdict="accuracy_failed")
     except Exception as exc:
         if _is_transient(exc):
             st["transients"] += 1
             st["reason"] = f"transient probe failure: {exc!r}"[:300]
+            _fr.record("pallas_probe", kernel=kernel,
+                       verdict="transient", error=repr(exc)[:120])
             if st["transients"] >= _PROBE_TRANSIENT_CAP:
                 st["reason"] = (
                     f"{st['transients']} consecutive transient probe "
@@ -870,6 +884,8 @@ def _available(kernel):
         st["result"] = False
         _log.warning("%s Pallas probe failed (%r); using the XLA path",
                      kernel, exc)
+        _fr.record("pallas_probe", kernel=kernel,
+                   verdict="compile_failed", error=repr(exc)[:120])
     return st["result"]
 
 
@@ -945,6 +961,7 @@ def mega_like_route(ntoa, nb):
     mid-trace) so a probe failure also falls back to the EXACT classic
     path, not the twin."""
     if not mega_like_fits(ntoa, nb):
+        _record_path("mega_like", "over-cap")
         return False
     return _ladder("mega_like", record_accept=False)
 
@@ -955,6 +972,7 @@ def mega_solve_route(n):
     :func:`mega_like_route` (decline, including over-cap ``n``, =
     exact classic chain)."""
     if not mega_solve_fits(n):
+        _record_path("mega_solve", "over-cap")
         return False
     return _ladder("mega_solve", record_accept=False)
 

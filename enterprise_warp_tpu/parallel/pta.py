@@ -81,6 +81,18 @@ from .orf import is_low_rank, is_positive_definite, orf_matrix
 _TM_PHI = 1.0e30
 
 
+def _named(name, fn):
+    """Wrap a trace-time function in ``jax.named_scope(name)`` so the
+    joint-likelihood stages render as legible regions in
+    ``jax.profiler`` captures (``EWT_PROFILE_CAPTURE`` — see
+    ``utils/profiling.py``). Pure annotation: the lowered computation
+    is unchanged."""
+    def wrapped(*args, **kwargs):
+        with jax.named_scope(name):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
 def _gram_batched(S, B, mode):
     """Batched Gram over the TOA axis: (P,n,k) x (P,n,l) -> (P,k,l).
 
@@ -774,6 +786,13 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
             xsx = jnp.sum(Xs.reshape(n_s) * Zs[:, 0])
         lnl = -0.5 * (quad_base - xsx + lds + logdet_b + ld_S)
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
+
+    # profiler legibility: the Schur stages carry named scopes, so an
+    # EWT_PROFILE_CAPTURE trace decomposes the joint eval into
+    # front-end / per-pulsar stage-1+2 / coupling stage-3 regions
+    _common = _named("pta.common", _common)
+    _stage12_single = _named("pta.stage12", _stage12_single)
+    _stage3 = _named("pta.stage3", _stage3)
 
     # ---- evaluation-structure layer: cache build + block updates ------
     def _cache_init(theta, sh):

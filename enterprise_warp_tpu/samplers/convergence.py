@@ -11,13 +11,13 @@ stop when every parameter passes.
 from __future__ import annotations
 
 import os
-import time
 from dataclasses import dataclass
 
 import numpy as np
 
 from ..utils import telemetry
 from ..utils.diagnostics import summarize_chains
+from ..utils.profiling import monotonic, span
 from ..utils.logging import get_logger
 
 _log = get_logger("ewt.convergence")
@@ -217,7 +217,7 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
         return (np.inf if rh is None else rh,
                 0.0 if es is None else es)
 
-    t_start = time.perf_counter()
+    t_start = monotonic()
     t_after_first = None
     report = None
     # the run-level scope: the inner sampler.sample() calls join this
@@ -238,15 +238,16 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
                            resume=steps > 0, verbose=False,
                            block_size=block_size, collect=blocks)
             if t_after_first is None:
-                t_after_first = time.perf_counter()
+                t_after_first = monotonic()
             steps = min(steps + todo, max_steps)
-            chains = _chains_from_blocks(blocks, burn_frac)
-            s = _diag(chains)
+            with span("convergence.check", step=steps):
+                chains = _chains_from_blocks(blocks, burn_frac)
+                s = _diag(chains)
             rh, es = _worst_floats(s)
             rec.heartbeat(phase="convergence_check", step=int(steps),
                           rhat=s["_worst"]["rhat"],
                           ess=s["_worst"]["ess"],
-                          wall_s=round(time.perf_counter() - t_start, 2),
+                          wall_s=round(monotonic() - t_start, 2),
                           # cumulative block-boundary accounting from
                           # the driven sampler (device-resident state
                           # layer): how much wall the device spent idle
@@ -262,13 +263,13 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
             if on_check is not None:
                 # lets drivers persist attempt progress (steps, wall so
                 # far, steady wall so far) so a killed run loses nothing
-                on_check(steps, time.perf_counter() - t_start,
-                         time.perf_counter() - t_after_first)
+                on_check(steps, monotonic() - t_start,
+                         monotonic() - t_after_first)
             if rh <= rhat_max and es >= target_ess:
                 report = ConvergenceReport(
                     converged=True, steps=steps,
-                    wall_s=time.perf_counter() - t_start,
-                    steady_wall_s=time.perf_counter() - t_after_first,
+                    wall_s=monotonic() - t_start,
+                    steady_wall_s=monotonic() - t_after_first,
                     rhat_max=rh, ess_min=es,
                     summary=s, chains=chains)
                 break
@@ -278,8 +279,8 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
             rh, es = _worst_floats(s)
             report = ConvergenceReport(
                 converged=False, steps=steps,
-                wall_s=time.perf_counter() - t_start,
-                steady_wall_s=time.perf_counter()
+                wall_s=monotonic() - t_start,
+                steady_wall_s=monotonic()
                 - (t_after_first or t_start),
                 rhat_max=rh, ess_min=es,
                 summary=s, chains=chains)

@@ -33,7 +33,6 @@ atomic ``state.npz`` checkpoint for resume.
 from __future__ import annotations
 
 import os
-import time
 from dataclasses import dataclass
 
 import jax
@@ -43,8 +42,10 @@ import numpy as np
 from ..native import write_table
 from .transform import make_logp_z
 from ..parallel.distributed import is_primary as _is_primary
-from ..utils import telemetry
+from ..utils import profiling, telemetry
+from ..utils.flightrec import flight_recorder
 from ..utils.logging import EvalRateMeter, get_logger
+from ..utils.profiling import monotonic, span
 
 _log = get_logger("ewt.hmc")
 
@@ -392,6 +393,11 @@ class HMCSampler:
             np.savetxt(os.path.join(self.outdir, "pars.txt"),
                        self.like.param_names, fmt="%s")
 
+        # divergence-delta baseline for the flight-recorder records: a
+        # resumed checkpoint's historical count must not be replayed
+        # as a phantom divergence storm on the first block
+        self._ndiv_seen = int(st.divergences)
+
         warm_z = []
         mass_at = 3 * self.warmup // 4    # set mass here; eps re-adapts
         blocks = {}
@@ -407,22 +413,23 @@ class HMCSampler:
             bkey = (todo, adapt)
             if bkey not in blocks:
                 blocks[bkey] = self._make_block(todo, adapt)
-            (z, key, log_eps, log_eps_bar, h_bar, acc, ndiv, zs, lnls,
-             mean_acc, ngrad) = blocks[bkey](
-                self._place(st.z), self._place(st.key), st.log_eps,
-                st.log_eps_bar, st.h_bar, jnp.asarray(st.mass),
-                self._place(st.accepted), st.divergences, st.da_iter,
-                st.mu, st.ngrad, self._consts)
+            with span("hmc.dispatch", steps=todo, adapt=adapt):
+                (z, key, log_eps, log_eps_bar, h_bar, acc, ndiv, zs,
+                 lnls, mean_acc, ngrad) = blocks[bkey](
+                    self._place(st.z), self._place(st.key), st.log_eps,
+                    st.log_eps_bar, st.h_bar, jnp.asarray(st.mass),
+                    self._place(st.accepted), st.divergences,
+                    st.da_iter, st.mu, st.ngrad, self._consts)
             # block-boundary bubble: previous results landed ->
             # this dispatch handed the device new work
-            now = time.perf_counter()
+            now = monotonic()
             if self._t_ready is not None:
                 self._last_bubble_s = now - self._t_ready
                 self.bubble_total_s += self._last_bubble_s
                 self.bubble_count += 1
                 self._g_bubble.set(self._last_bubble_s)
                 self._t_ready = None
-            t_sync0 = time.perf_counter()
+            t_sync0 = monotonic()
             if self.device_state:
                 # ensemble buffers stay device-resident (and are
                 # donated into the next block); only the emissions and
@@ -443,10 +450,24 @@ class HMCSampler:
             mean_acc = float(mean_acc)
             # the scalar conversions above forced the host sync — the
             # device is idle from here until the next block dispatch
-            self._last_sync_s = time.perf_counter() - t_sync0
+            self._last_sync_s = monotonic() - t_sync0
             self.host_sync_total_s += self._last_sync_s
             self._g_sync.set(self._last_sync_s)
-            self._t_ready = time.perf_counter()
+            self._t_ready = monotonic()
+            # deep-profiling block boundary: capture-window tick +
+            # flight-recorder crash position (no-ops without the knobs)
+            profiling.capture_tick()
+            ndiv_before = self._ndiv_seen
+            if st.divergences > ndiv_before:
+                flight_recorder().record(
+                    "divergence", step=int(st.step),
+                    new=int(st.divergences - ndiv_before),
+                    total=int(st.divergences))
+            self._ndiv_seen = st.divergences
+            flight_recorder().note_state(
+                sampler="hmc", outdir=self.outdir, step=int(st.step),
+                divergences=int(st.divergences),
+                eps=float(np.exp(st.log_eps)))
 
             if st.step <= mass_at and st.step > self.warmup // 4:
                 # collect warmup positions for the diagonal mass
@@ -473,6 +494,23 @@ class HMCSampler:
             thetas = np.asarray(self._from_unit_batch(
                 jnp.asarray(zs_np.reshape(-1, self.ndim))))
             lnls_np = np.asarray(lnls).reshape(-1, 1)
+            nbad = int(np.sum(~np.isfinite(lnls_np)))
+            if nbad:
+                # a committed non-finite lnl is an anomaly (HMC only
+                # accepts finite-lp endpoints, so this means the chain
+                # state itself went bad): count, record, dump once
+                telemetry.registry().counter(
+                    "nonfinite_eval", where="hmc_block").inc(nbad)
+                fr = flight_recorder()
+                fr.record("nonfinite_eval", where="hmc_block",
+                          count=nbad, step=int(st.step))
+                bad = ~np.isfinite(lnls_np[:, 0])
+                fr.anomaly(
+                    "nonfinite_eval", run_dir=self.outdir,
+                    once_key=f"nonfinite_eval:{self.outdir}",
+                    step=int(st.step), n_bad=nbad,
+                    bad_theta=thetas[bad][:8],
+                    bad_lnl=lnls_np[bad, 0][:8])
             lnpri = np.asarray(self._lnprior_batch(
                 jnp.asarray(thetas))).reshape(-1, 1)
             acc_rate = float(np.mean(st.accepted) / max(st.step, 1))
@@ -505,6 +543,9 @@ class HMCSampler:
                           host_sync_wall_s=round(self._last_sync_s, 4),
                           block_bubble_s=round(self._last_bubble_s, 4),
                           warmup=bool(adapt))
+                mem = profiling.memory_watermark()
+                if mem is not None:
+                    hb.update(mem)
                 worst = self._block_diag(
                     thetas.reshape(todo, self.W, self.ndim), diag_t)
                 if worst is not None:

@@ -26,8 +26,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.writers import atomic_write_json
-from ..utils import telemetry
+from ..utils import profiling, telemetry
+from ..utils.flightrec import flight_recorder
 from ..utils.logging import EvalRateMeter, get_logger
+from ..utils.profiling import span
 
 _log = get_logger("ewt.nested")
 
@@ -357,11 +359,33 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
                              param_names=list(like.param_names)) as rec:
         meter = EvalRateMeter()
         while it < max_iter:
-            u, lnl, rng_key, du, dl, acc, lnz_d, lnx_d, delta_d = \
-                iteration(u, lnl, rng_key, jnp.float64(scale),
-                          jnp.float64(lnz), jnp.float64(ln_x), _consts)
-            dead_u.append(np.asarray(du))
-            dead_lnl.append(np.asarray(dl))
+            with span("ns.iteration", it=it):
+                u, lnl, rng_key, du, dl, acc, lnz_d, lnx_d, delta_d = \
+                    iteration(u, lnl, rng_key, jnp.float64(scale),
+                              jnp.float64(lnz), jnp.float64(ln_x),
+                              _consts)
+                dead_u.append(np.asarray(du))
+                dead_lnl.append(np.asarray(dl))
+            profiling.capture_tick()
+            # the likelihood builders map NaN -> -inf (the oracle
+            # corner contract), so the bad-dead-point test must be
+            # ~isfinite, not isnan: live points are redrawn/walked to
+            # finite lnl, so ANY non-finite dead point means a bad
+            # evaluation leaked into the evidence accumulator
+            badm = ~np.isfinite(dead_lnl[-1])
+            nbad = int(np.sum(badm))
+            if nbad:
+                telemetry.registry().counter(
+                    "nonfinite_eval", where="nested").inc(nbad)
+                fr = flight_recorder()
+                fr.record("nonfinite_eval", where="nested",
+                          count=nbad, iteration=it)
+                fr.anomaly(
+                    "nonfinite_eval", run_dir=outdir,
+                    once_key=f"nonfinite_eval:{outdir}",
+                    iteration=it, n_bad=nbad,
+                    bad_u=dead_u[-1][badm][:8],
+                    bad_lnl=dead_lnl[-1][badm][:8])
             dead_lnx.append(ln_x - lnx_offsets)
             dead_dlnx.append(dlnx_per)
             lnz = float(lnz_d)
@@ -369,6 +393,12 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
             delta = float(delta_d)
             it += 1
             meter.add(kbatch * nsteps)
+            # crash position AFTER the accumulator updates, so an
+            # anomaly dump's state agrees with this iteration's
+            # dead-point records
+            flight_recorder().note_state(
+                sampler="nested", outdir=outdir, iteration=it,
+                lnz=lnz, scale=float(scale))
 
             # adapt the walk scale toward ~40% acceptance
             a = float(acc)
@@ -382,11 +412,15 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
             if it % 20 == 0:
                 # heartbeat at the existing host-sync point (the
                 # iteration results just landed as numpy above)
-                rec.heartbeat(iteration=it, lnz=round(lnz, 3),
-                              dlogz=round(delta, 4),
-                              accept=round(a, 3), scale=round(scale, 4),
-                              evals_per_s=round(meter.window_rate(), 1),
-                              evals_total=int(meter.total))
+                hb = dict(iteration=it, lnz=round(lnz, 3),
+                          dlogz=round(delta, 4),
+                          accept=round(a, 3), scale=round(scale, 4),
+                          evals_per_s=round(meter.window_rate(), 1),
+                          evals_total=int(meter.total))
+                mem = profiling.memory_watermark()
+                if mem is not None:
+                    hb.update(mem)
+                rec.heartbeat(**hb)
                 if verbose:
                     _log.info("NS it=%d lnZ=%.3f dlogz=%.4f acc=%.2f "
                               "scale=%.3f", it, lnz, delta, a, scale)

@@ -41,8 +41,10 @@ import numpy as np
 from ..io.writers import atomic_write_json
 from ..native import write_table
 from ..parallel.distributed import is_primary as _is_primary
-from ..utils import telemetry
+from ..utils import profiling, telemetry
+from ..utils.flightrec import flight_recorder
 from ..utils.logging import EvalRateMeter, get_logger
+from ..utils.profiling import monotonic, span
 
 _log = get_logger("ewt.ptmcmc")
 
@@ -268,13 +270,30 @@ class PTSampler:
             reps = int(np.ceil(self.W / len(self.init_x)))
             x0 = np.tile(self.init_x, (reps, 1))[:self.W]
         lnl = np.asarray(self.like.loglike_batch(jnp.asarray(x0)))
-        # re-draw any walker that landed on a non-finite corner
+        # re-draw any walker that landed on a non-finite corner. Not
+        # silent: every bad draw is a counted ``nonfinite_eval`` and a
+        # flight-recorder record, and exhausting the redraw budget is
+        # a full anomaly dump — the run would otherwise start from a
+        # non-finite ensemble and fail hours later at block commit.
+        fr = flight_recorder()
         for _ in range(20):
             bad = ~np.isfinite(lnl)
             if not bad.any():
                 break
+            telemetry.registry().counter(
+                "nonfinite_eval", where="init").inc(int(bad.sum()))
+            fr.record("nonfinite_eval", where="init",
+                      count=int(bad.sum()))
             x0[bad] = self.like.sample_prior(rng, int(bad.sum()))
             lnl = np.asarray(self.like.loglike_batch(jnp.asarray(x0)))
+        else:
+            bad = ~np.isfinite(lnl)
+            if bad.any():
+                fr.anomaly(
+                    "nonfinite_init", run_dir=self.outdir,
+                    once_key=f"nonfinite_init:{self.outdir}",
+                    n_bad=int(bad.sum()),
+                    bad_theta=x0[bad][:8], bad_lnl=lnl[bad][:8])
         lnp = np.asarray(self._lnprior_batch(jnp.asarray(x0)))
         cov = self.init_cov if self.init_cov is not None else \
             np.diag(self._prior_scales() ** 2 * 0.01)
@@ -364,6 +383,16 @@ class PTSampler:
         ntemps, nchains = self.ntemps, self.nchains
         swap_every = self.swap_every
         emit_hot = self.write_hot
+        # non-finite-eval surveillance (flight-recorder layer): emit a
+        # per-step count of genuinely bad evaluations — NaN/-inf
+        # likelihood at a FINITE-prior point, or NaN prior — so the
+        # first bad eval inside a block is escalated at the commit
+        # sync point instead of staying invisible (a NaN proposal is
+        # never accepted, so the committed state alone cannot show
+        # it). Gated on the telemetry build flag: with EWT_TELEMETRY=0
+        # the block program is bit-identical to the uninstrumented one.
+        emit_nf = telemetry.enabled()
+        self._nf_emitted = emit_nf
         use_ind = bool(self.jump_probs[4] > 0)
         use_cg = bool(self.jump_probs[5] > 0)
         use_kde = bool(self.jump_probs[6] > 0)
@@ -581,8 +610,13 @@ class PTSampler:
                 prop = jnp.where((choice == 7)[:, None], ns_prop, prop)
 
             key, ka = jax.random.split(key)
-            lnp_new = like.log_prior(prop)
-            lnl_new = batch_eval(prop, consts)
+            with jax.named_scope("pt.eval"):
+                lnp_new = like.log_prior(prop)
+                lnl_new = batch_eval(prop, consts)
+            if emit_nf:
+                nf_t = jnp.sum(
+                    (~jnp.isfinite(lnl_new) & ~jnp.isneginf(lnp_new))
+                    | jnp.isnan(lnp_new)).astype(jnp.int32)
             lnl_new = jnp.where(jnp.isneginf(lnp_new), -jnp.inf, lnl_new)
             # prior-draw proposal asymmetry: q(x'|x) is the prior density
             # of the redrawn dimension, so the MH correction is
@@ -691,6 +725,8 @@ class PTSampler:
                 ys = (x, lnl, lnp)
             else:
                 ys = (x[:nchains], lnl[:nchains], lnp[:nchains])
+            if emit_nf:
+                ys = ys + (nf_t,)
             return ((x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                      fam_acc, fam_prop, mask_counts,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
@@ -704,8 +740,11 @@ class PTSampler:
                      fam_acc, fam_prop, mask_counts,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
                      lam, cg_rows, kde_pts, kde_bw, temps, consts)
-            carry, ys = jax.lax.scan(
-                one_step, carry, jnp.arange(nsteps))
+            # named for jax.profiler captures (EWT_PROFILE_CAPTURE):
+            # the whole K-step scan shows up as one legible region
+            with jax.named_scope("ptmcmc_block"):
+                carry, ys = jax.lax.scan(
+                    one_step, carry, jnp.arange(nsteps))
             return (carry,) + tuple(ys)
 
         # traced jit: a block retrace (new block size, new walker
@@ -824,13 +863,13 @@ class PTSampler:
         dispatch one block — returning the raw device outputs WITHOUT
         waiting for them (JAX async dispatch: the host is free to fold
         the previous block's diagnostics while the device runs)."""
-        import time
         if self._compiled_block is None or self._block_steps != todo:
             self._block = self._make_block(todo)
             self._block_steps = todo
             self._compiled_block = True
 
-        prep = self._host_prep(st)
+        with span("pt.host_prep"):
+            prep = self._host_prep(st)
         (eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL, lam,
          cg_rows, kde_pts, kde_bw) = prep
         if temps is None:
@@ -853,18 +892,20 @@ class PTSampler:
         (acc_in, sacc_in, sprop_in, fam_a_in, fam_p_in, mask_in,
          eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
          lam, cg_rows, kde_pts, kde_bw, temps_in) = placed
-        out = self._block(
-            self._place(st.x, self._mat_shard),
-            self._place(st.lnl, self._vec_shard),
-            self._place(st.lnp, self._vec_shard), self._place(st.key),
-            self._place(st.history), st.hist_len,
-            acc_in, sacc_in, sprop_in, fam_a_in, fam_p_in, mask_in,
-            eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
-            lam, cg_rows, kde_pts, kde_bw, temps_in, self._consts)
+        with span("pt.dispatch", steps=todo):
+            out = self._block(
+                self._place(st.x, self._mat_shard),
+                self._place(st.lnl, self._vec_shard),
+                self._place(st.lnp, self._vec_shard),
+                self._place(st.key),
+                self._place(st.history), st.hist_len,
+                acc_in, sacc_in, sprop_in, fam_a_in, fam_p_in, mask_in,
+                eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
+                lam, cg_rows, kde_pts, kde_bw, temps_in, self._consts)
         # block-boundary bubble: host wall between the previous block's
         # results landing (device went idle) and this dispatch handing
         # the device new work
-        now = time.perf_counter()
+        now = monotonic()
         if self._t_ready is not None:
             b = now - self._t_ready
             self._last_bubble_s = b
@@ -883,20 +924,26 @@ class PTSampler:
         host mode rebinds the numpy snapshot, reproducing the seed
         round-trip exactly. Returns ``(snap, cold, cold_lnl,
         cold_lnp)`` with everything host-side."""
-        import time
-
         from .devicestate import host_snapshot
-        carry, cold, cold_lnl, cold_lnp = out
+        if getattr(self, "_nf_emitted", False):
+            carry, cold, cold_lnl, cold_lnp, nf_steps = out
+        else:
+            carry, cold, cold_lnl, cold_lnp = out
+            nf_steps = None
         (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
          fam_acc, fam_prop, mask_counts, *_unused) = carry
-        t0 = time.perf_counter()
-        snap = host_snapshot(dict(
+        t0 = monotonic()
+        leaves = dict(
             x=x, lnl=lnl, lnp=lnp, key=key, history=hist, accepted=acc,
             swaps_accepted=sacc, swaps_proposed=sprop,
             fam_accept=fam_acc, fam_propose=fam_prop,
             mask_counts=mask_counts, cold=cold, cold_lnl=cold_lnl,
-            cold_lnp=cold_lnp))
-        self._t_ready = time.perf_counter()
+            cold_lnp=cold_lnp)
+        if nf_steps is not None:
+            leaves["nf_steps"] = nf_steps
+        with span("pt.commit", steps=todo):
+            snap = host_snapshot(leaves)
+        self._t_ready = monotonic()
         self._last_sync_s = self._t_ready - t0
         self.host_sync_total_s += self._last_sync_s
         self._g_sync.set(self._last_sync_s)
@@ -920,7 +967,41 @@ class PTSampler:
         self.fam_propose = snap["fam_propose"]
         self.mask_counts = snap["mask_counts"]
         st.step += todo
+        if nf_steps is not None:
+            self._escalate_nonfinite(snap, st, todo)
         return snap, snap["cold"], snap["cold_lnl"], snap["cold_lnp"]
+
+    def _escalate_nonfinite(self, snap, st, todo):
+        """Flight-recorder escalation of in-block non-finite
+        evaluations (see the ``emit_nf`` emission in
+        :meth:`_make_block`): count them, record the event, and dump
+        the forensics crime scene ONCE per run — the offending region
+        (walkers whose committed lnl/lnp went non-finite, or the
+        per-step bad-eval counts when every bad proposal was
+        rejected), the RNG key, and the step/block position."""
+        nf = np.asarray(snap.get("nf_steps"))
+        total = int(nf.sum())
+        if total == 0:
+            return
+        telemetry.registry().counter(
+            "nonfinite_eval", where="block").inc(total)
+        fr = flight_recorder()
+        fr.record("nonfinite_eval", where="block", count=total,
+                  step=int(st.step))
+        x = np.asarray(snap["x"])
+        lnl = np.asarray(snap["lnl"])
+        lnp = np.asarray(snap["lnp"])
+        bad = ~np.isfinite(lnl) | ~np.isfinite(lnp)
+        fr.anomaly(
+            "nonfinite_eval", run_dir=self.outdir,
+            once_key=f"nonfinite_eval:{self.outdir}",
+            step=int(st.step), block_steps=int(todo),
+            n_bad_evals=total,
+            nf_per_step=nf[:256],
+            rng_key=np.asarray(snap["key"]),
+            bad_walker_idx=np.nonzero(bad)[0][:8],
+            bad_theta=x[bad][:8], bad_lnl=lnl[bad][:8],
+            bad_lnp=lnp[bad][:8])
 
     def _run_block(self, st, todo, temps=None):
         """Advance ``st`` by ``todo`` steps (dispatch + commit in one
@@ -1100,6 +1181,15 @@ class PTSampler:
                 pipe.run_pending()
                 snap, cold, cold_lnl, cold_lnp = self._commit_block(
                     st, out, todo)
+                # deep-profiling block boundary: advance any armed
+                # jax.profiler capture window (EWT_PROFILE_CAPTURE)
+                # and refresh the flight recorder's crash position —
+                # both no-ops unless their knobs are set
+                profiling.capture_tick()
+                flight_recorder().note_state(
+                    sampler="ptmcmc", outdir=self.outdir,
+                    step=int(st.step), block_steps=int(todo),
+                    rng_key=np.asarray(snap["key"]).tolist())
 
                 # --- swap-rate-targeted ladder adaptation ------------- #
                 # (critical path: the next dispatch consumes the ladder)
@@ -1178,6 +1268,10 @@ class PTSampler:
         max_lnl = float(np.max(snap["lnl"]))
 
         def work():
+            with span("pt.host_work", step=step_now):
+                _work()
+
+        def _work():
             # --- write cold chains (interleaved walkers) -------------- #
             acc_rate = float(np.mean(accepted[:self.nchains])
                              / max(step_now, 1))
@@ -1261,6 +1355,11 @@ class PTSampler:
                           host_sync_wall_s=round(sync_s, 4),
                           block_bubble_s=round(bubble_s, 4),
                           max_lnl=round(max_lnl, 3))
+                # device-memory watermark gauges (profiling layer):
+                # present only on backends exposing memory_stats()
+                mem = profiling.memory_watermark()
+                if mem is not None:
+                    hb.update(mem)
                 # which Pallas route the likelihood's traces actually
                 # took (pallas / xla-fallback / probe-failed) — a
                 # mid-run transient probe failure shows up here, not

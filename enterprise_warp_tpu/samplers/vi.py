@@ -27,6 +27,7 @@ import numpy as np
 
 from ..utils import telemetry
 from ..utils.logging import get_logger
+from ..utils.profiling import span
 
 _log = get_logger("ewt.vi")
 
@@ -101,21 +102,26 @@ def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
     # would force a host sync every iteration and serialize dispatch
     vals = []
     rec = telemetry.active_recorder()
-    for i in range(steps):
-        key, k = jax.random.split(key)
-        params, opt_state, val = step(params, opt_state, k, _consts)
-        vals.append(val)
-        if (i + 1) % max(steps // 10, 1) == 0:
-            hb = dict(phase="advi", step=i + 1, steps=steps)
-            if verbose:
-                # float(val) is a host sync — only the verbose path
-                # pays it (matching the old print), so the quiet path
-                # stays sync-free per the telemetry contract
-                hb["elbo"] = round(float(val), 2)
-                _log.info("advi step %d/%d elbo=%.2f", i + 1, steps,
-                          hb["elbo"])
-            if rec is not None:
-                rec.heartbeat(**hb)
+    with span("advi.fit", steps=steps) as sp:
+        for i in range(steps):
+            key, k = jax.random.split(key)
+            params, opt_state, val = step(params, opt_state, k,
+                                          _consts)
+            vals.append(val)
+            if (i + 1) % max(steps // 10, 1) == 0:
+                hb = dict(phase="advi", step=i + 1, steps=steps)
+                if verbose:
+                    # float(val) is a host sync — only the verbose path
+                    # pays it (matching the old print), so the quiet
+                    # path stays sync-free per the telemetry contract
+                    hb["elbo"] = round(float(val), 2)
+                    _log.info("advi step %d/%d elbo=%.2f", i + 1,
+                              steps, hb["elbo"])
+                if rec is not None:
+                    rec.heartbeat(**hb)
+        if vals:
+            # the fit's device tail, measured at span close
+            sp.device_sync = vals[-1]
     telemetry.registry().counter("advi_fits").inc()
     trace = np.asarray(jax.device_get(vals))
 

@@ -112,9 +112,10 @@ def throttled_block_worst(block, param_names, last_t, max_kept=256):
     computes), keeping heartbeats off the hot path on fast device
     blocks."""
     import os
-    import time
 
-    now = time.perf_counter()
+    from .profiling import monotonic
+
+    now = monotonic()
     try:
         interval = float(os.environ.get("EWT_TELEMETRY_DIAG_S", "20"))
     except ValueError:

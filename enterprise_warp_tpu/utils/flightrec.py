@@ -1,0 +1,314 @@
+"""Flight recorder: bounded event ring + anomaly forensics dumps.
+
+A multi-hour sampler run that goes non-finite should leave a
+reproducible crime scene, not a stack trace: the parameter vectors that
+produced the bad evaluation, the RNG key, the step/block position, the
+Pallas route verdicts in force, and the recent telemetry tail — enough
+to replay the failure offline. This module provides that:
+
+- :class:`FlightRecorder` — a bounded ring buffer of recent telemetry
+  events (heartbeats, span records, compile events — fed automatically
+  by ``telemetry.RunRecorder.event`` via a module hook) plus
+  last-known sampler state metadata (:meth:`~FlightRecorder.note_state`
+  — step, block, RNG key, outdir), all cheap host-side appends.
+- :meth:`~FlightRecorder.anomaly` — dump ``<run_dir>/anomaly/``:
+  ``anomaly.json`` (via the shared ``atomic_write_json``) carrying the
+  trigger reason, the offending parameter vectors/likelihood values
+  (non-finite floats preserved as ``"NaN"``/``"Infinity"`` strings —
+  strict JSON, information intact), the state metadata, the ring tail,
+  the Pallas probe/route verdicts (``ops.megakernel.mega_status`` +
+  ``ops.cholfuse.probe_status``), the metrics-registry snapshot, and
+  the device-memory watermark + live-buffer attribution. Also arms a
+  ``jax.profiler`` capture window (``EWT_PROFILE_CAPTURE``) so the
+  blocks after the anomaly land in a trace.
+- fatal-exit forensics — when the recorder is bound to a run,
+  ``atexit`` and ``SIGTERM`` handlers dump the ring if the process
+  dies with a run scope still open (a clean ``run_end`` disarms them).
+
+Triggers wired through the samplers: non-finite likelihood/prior
+evaluations (PTMCMC counts them inside the block and escalates at the
+commit sync point; HMC/nested check their already-synced host copies),
+the initial-state redraw exhausting its attempts, and Pallas probe
+failures (``ops.megakernel``). Anything else can call
+``flight_recorder().anomaly(...)`` directly.
+
+Enabled by ``EWT_FLIGHTREC=1`` and master-gated by ``EWT_TELEMETRY``
+(default off: a run without the knobs is bit- and artifact-identical
+to one without this layer). Dumps are capped per process so a
+persistently-NaN likelihood cannot fill the disk with one dump per
+block.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import os
+import signal
+import threading
+
+from . import telemetry
+from .profiling import walltime
+
+__all__ = ["enabled", "flight_recorder", "FlightRecorder",
+           "RING_DEFAULT"]
+
+RING_DEFAULT = 256
+_MAX_DUMPS = 3          # per process — forensics, not a firehose
+
+
+def enabled() -> bool:
+    """Flight recording is opt-in (``EWT_FLIGHTREC=1``) and
+    master-gated by ``EWT_TELEMETRY``."""
+    return telemetry.enabled() \
+        and os.environ.get("EWT_FLIGHTREC", "0") == "1"
+
+
+_INF = float("inf")
+
+
+def _forensic(v, depth=0):
+    """JSON encoding that PRESERVES non-finite values as strings
+    (``"NaN"``/``"Infinity"``/``"-Infinity"``) instead of nulling them
+    like the telemetry stream's sanitizer — the whole point of a
+    forensics dump is to show exactly which entries went bad."""
+    if depth > 6:
+        return str(v)
+    tolist = getattr(v, "tolist", None)
+    if tolist is not None and not isinstance(v, (str, bytes)):
+        v = tolist()
+    if isinstance(v, float):
+        if v != v:
+            return "NaN"
+        if v == _INF:
+            return "Infinity"
+        if v == -_INF:
+            return "-Infinity"
+        return v
+    if isinstance(v, dict):
+        return {str(k): _forensic(x, depth + 1) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_forensic(x, depth + 1) for x in v]
+    if isinstance(v, (int, str, bool)) or v is None:
+        return v
+    return str(v)
+
+
+class FlightRecorder:
+    """See module docstring. One per process (via
+    :func:`flight_recorder`); ``bind``/``unbind`` tie it to the
+    current outermost run scope."""
+
+    def __init__(self, ring_len: int = RING_DEFAULT):
+        self._ring = collections.deque(maxlen=int(ring_len))
+        self._state: dict = {}
+        # re-entrant: a SIGTERM can land while the main thread is
+        # inside anomaly()'s dedup block, and the handler calls
+        # anomaly() again — a plain Lock would self-deadlock there
+        self._lock = threading.RLock()
+        self.run_dir: str | None = None
+        self.dumps = 0
+        self._handlers_installed = False
+
+    # ---------------- recording (hot-adjacent, must stay cheap) ----- #
+    def record(self, type: str, **fields):
+        """Append one record to the ring (host dict append, O(1))."""
+        rec = {"t": round(walltime(), 3), "type": type}
+        rec.update(fields)
+        self._ring.append(rec)
+
+    def record_event(self, rec: dict):
+        """Telemetry-stream hook target: mirror an already-built event
+        dict into the ring without copying its fields twice."""
+        self._ring.append(rec)
+
+    def note_state(self, **meta):
+        """Merge last-known sampler state metadata (step, block, RNG
+        key, sampler name, outdir ...) — what the anomaly dump reports
+        as the crash position."""
+        self._state.update(meta)
+
+    def tail(self, n: int | None = None):
+        items = list(self._ring)
+        return items if n is None else items[-int(n):]
+
+    # ---------------- lifecycle ------------------------------------- #
+    def bind(self, run_dir: str):
+        self.run_dir = run_dir
+        self._install_handlers()
+
+    def unbind(self):
+        self.run_dir = None
+
+    def _install_handlers(self):
+        if self._handlers_installed:
+            return
+        self._handlers_installed = True
+        atexit.register(self._atexit_dump)
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                try:
+                    self.anomaly("fatal_signal", signum=int(signum))
+                finally:
+                    if callable(prev):
+                        prev(signum, frame)
+                    elif prev is signal.SIG_IGN:
+                        # the host deliberately ignored SIGTERM —
+                        # dumping must not convert that into death
+                        pass
+                    else:
+                        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                        os.kill(os.getpid(), signum)
+
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            pass    # non-main thread / restricted env: atexit only
+
+    def _atexit_dump(self):
+        # a clean run_end pops the run scope; a live scope at
+        # interpreter exit means the run died mid-flight
+        if telemetry.active_recorder() is not None \
+                and self.run_dir is not None:
+            self.anomaly("atexit_with_open_run")
+
+    # ---------------- the dump -------------------------------------- #
+    def anomaly(self, reason: str, run_dir: str | None = None,
+                once_key: str | None = None, **payload):
+        """Write ``<run_dir>/anomaly/anomaly.json`` (see module
+        docstring) and arm a post-anomaly profiler capture window.
+        Returns the dump path, or None when disabled / over the dump
+        cap / already dumped for ``once_key``. Never raises."""
+        if not enabled():
+            return None
+        run_dir = run_dir or self.run_dir
+        if run_dir is None or self.dumps >= _MAX_DUMPS:
+            return None
+        with self._lock:
+            key = once_key or reason
+            seen = self._state.setdefault("_dumped_keys", set())
+            if key in seen:
+                return None
+            seen.add(key)
+            self.dumps += 1
+        try:
+            return self._write_dump(reason, run_dir, payload)
+        except Exception as exc:   # noqa: BLE001 — never kill the run
+            from .logging import get_logger
+
+            get_logger("ewt.flightrec").warning(
+                "anomaly dump failed (%r)", exc)
+            return None
+
+    def _write_dump(self, reason, run_dir, payload):
+        from ..io.writers import atomic_write_json
+        from .logging import get_logger
+        from .profiling import (capture_arm, live_buffer_report,
+                                memory_watermark)
+
+        adir = os.path.join(run_dir, "anomaly")
+        os.makedirs(adir, exist_ok=True)
+        state = {k: v for k, v in self._state.items()
+                 if not k.startswith("_")}
+        safe_reason = "".join(c if c.isalnum() or c in "-_" else "_"
+                              for c in reason)[:48]
+        doc = {
+            "reason": reason,
+            "t": round(walltime(), 3),
+            "run_dir": run_dir,
+            "state": _forensic(state),
+            "payload": _forensic(payload),
+            "ring_tail": _forensic(self.tail()),
+            "pallas": self._pallas_verdicts(),
+            "metrics": _forensic(telemetry.registry().snapshot()),
+            "memory": {
+                "watermark": memory_watermark(),
+                "live_buffers": live_buffer_report(),
+            },
+        }
+        # one numbered file per dump so a later trigger (e.g. the
+        # run_scope_error teardown dump after a nonfinite_eval dump)
+        # can never destroy an earlier crime scene; anomaly.json —
+        # the primary postmortem tools/report.py renders — stays the
+        # FIRST dump of the run (closest to the root cause)
+        path = os.path.join(
+            adir, f"anomaly-{self.dumps:02d}-{safe_reason}.json")
+        atomic_write_json(path, doc, default=str)
+        primary = os.path.join(adir, "anomaly.json")
+        if not os.path.exists(primary):
+            atomic_write_json(primary, doc, default=str)
+        # the blocks AFTER an anomaly are the interesting ones — arm a
+        # profiler window (no-op without EWT_PROFILE_CAPTURE)
+        capture_arm()
+        rec = telemetry.active_recorder()
+        if rec is not None:
+            rec.event("anomaly", reason=reason, dump=path)
+            rec.flush()     # the pointer must survive a crash
+        get_logger("ewt.flightrec").warning(
+            "anomaly '%s': forensics dumped to %s", reason, path)
+        return path
+
+    @staticmethod
+    def _pallas_verdicts():
+        out = {}
+        try:
+            from ..ops.megakernel import mega_status
+
+            out["megakernel"] = mega_status()
+        except Exception:   # noqa: BLE001
+            pass
+        try:
+            from ..ops.cholfuse import probe_status
+
+            out["cholfuse"] = probe_status()
+        except Exception:   # noqa: BLE001
+            pass
+        return out
+
+
+class _NoopFlightRecorder:
+    """Inert twin handed out when flight recording is disabled, so the
+    sampler call sites never branch."""
+
+    run_dir = None
+    dumps = 0
+
+    def record(self, *a, **k):
+        pass
+
+    record_event = note_state = record
+
+    def tail(self, n=None):
+        return []
+
+    def bind(self, run_dir):
+        pass
+
+    def unbind(self):
+        pass
+
+    def anomaly(self, *a, **k):
+        return None
+
+
+_NOOP = _NoopFlightRecorder()
+_RECORDER: FlightRecorder | None = None
+
+
+def flight_recorder():
+    """The process-wide flight recorder (the inert twin when
+    disabled). The live instance is created on first enabled access
+    and registered as the telemetry event-stream mirror hook."""
+    global _RECORDER
+    if not enabled():
+        return _NOOP
+    if _RECORDER is None:
+        try:
+            ring_len = int(os.environ.get("EWT_FLIGHTREC_RING",
+                                          str(RING_DEFAULT)))
+        except ValueError:
+            ring_len = RING_DEFAULT
+        _RECORDER = FlightRecorder(ring_len=ring_len)
+        telemetry.set_flight_hook(_RECORDER.record_event)
+    return _RECORDER

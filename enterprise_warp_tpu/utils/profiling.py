@@ -1,0 +1,474 @@
+"""Deep-profiling layer: hierarchical spans, device-memory watermarks,
+profiler capture windows, and the shared timing protocol.
+
+PR 2's telemetry answers *how often* things run (retraces, heartbeats)
+and PR 4's dispatch counters answer *how many kernels* a trace lowers
+to; this module answers *where device time and HBM go* — the question
+every GPU/TPU-speed inference effort reports as the practical
+bottleneck at scale (PAPERS.md: the blackjax-ns GPU nested-sampling
+kernel, arXiv:2509.04336; the "lightning-fast" PTA framework). Four
+pieces, all host-side and zero-cost when disabled:
+
+- :func:`span` — hierarchical timing spans (``EWT_SPANS=1``): a
+  context manager producing nested records (host wall + optional
+  block-until-ready device time) that feed ``span_ms{span=...}``
+  histograms in the metrics registry, ``span`` events in
+  ``events.jsonl`` (open/close pairs, so ``tools/report.py --check``
+  can detect imbalance), and a Chrome-trace/Perfetto JSON export
+  written to ``<run_dir>/trace.json`` when the outermost
+  ``telemetry.run_scope`` closes.
+- :func:`capture_tick` / :func:`capture_arm` — programmatic
+  ``jax.profiler`` capture windows (``EWT_PROFILE_CAPTURE=<dir>``):
+  the first ``EWT_PROFILE_BLOCKS`` sampler blocks are captured on
+  start-up, and :meth:`~.flightrec.FlightRecorder.anomaly` re-arms a
+  window so the blocks *after* an anomaly land in a trace. Sampler
+  code marks block boundaries with ``capture_tick()`` — a no-op when
+  the env var is unset.
+- :func:`memory_watermark` / :func:`live_buffer_report` — per-block
+  ``device.memory_stats()`` watermark gauges (``hbm_peak_bytes``,
+  ``hbm_in_use_bytes``; graceful no-op on backends that lack the API,
+  e.g. CPU) and a live-buffer attribution helper grouping
+  ``jax.live_arrays()`` by shape/dtype.
+- :func:`timeit` — the ONE wall-clock measurement protocol (warmup +
+  block-until-ready + rep loop) shared by ``tools/profile_kernel.py``,
+  ``tools/profile_joint.py`` and ``tools/roofline.py``, recorded
+  through a span so tool timings and sampler timings land in the same
+  histogram namespace.
+
+Everything honors ``EWT_TELEMETRY=0`` (master off) and the scoped
+knobs ``EWT_SPANS`` / ``EWT_PROFILE_CAPTURE``; the disabled ``span()``
+call returns one shared inert object — no per-call allocation on the
+hot path.
+
+This module and ``utils/telemetry.py`` are the only places in the
+package allowed to call ``time.perf_counter()``/``time.time()``
+directly (lint-enforced by ``tests/test_profiling.py``): ad-hoc timing
+is invisible to the histograms/trace export, so all other code routes
+through :func:`monotonic`/:func:`walltime`/:func:`span`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import telemetry
+
+__all__ = ["spans_enabled", "span", "span_records", "reset_spans",
+           "flush_trace", "export_chrome_trace", "monotonic",
+           "walltime", "timeit", "memory_watermark",
+           "live_buffer_report", "capture_dir", "capture_arm",
+           "capture_tick", "capture_stop"]
+
+#: re-exported clocks — the package-wide timing primitives (see module
+#: docstring; everything outside telemetry.py/profiling.py uses these)
+monotonic = time.perf_counter
+walltime = time.time
+
+
+def spans_enabled() -> bool:
+    """Span recording is opt-in (``EWT_SPANS=1``) and master-gated by
+    ``EWT_TELEMETRY`` — a disabled-telemetry run must stay bit- and
+    artifact-identical to one without this layer."""
+    return telemetry.enabled() and os.environ.get("EWT_SPANS", "0") == "1"
+
+
+# ------------------------------------------------------------------ #
+#  hierarchical spans                                                  #
+# ------------------------------------------------------------------ #
+
+# completed span records for the Chrome-trace export, bounded so a
+# pathological caller (a span per likelihood eval) cannot grow host
+# memory without bound on a multi-hour run
+_RECORDS_CAP = 200_000
+_records: list[dict] = []
+_records_dropped = 0
+_records_lock = threading.Lock()
+_seq_lock = threading.Lock()
+_seq = [0]
+_tls = threading.local()
+
+
+def _next_id() -> int:
+    with _seq_lock:
+        _seq[0] += 1
+        return _seq[0]
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class _NoopSpan:
+    """Shared inert span handed out when spans are disabled: supports
+    the full surface (``device_sync`` assignment, ``annotate``) so call
+    sites never branch, and is a singleton so the disabled hot path
+    allocates nothing."""
+
+    __slots__ = ()
+    name = None
+    device_sync = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __setattr__(self, k, v):   # accept and drop device_sync etc.
+        pass
+
+    def annotate(self, **kw):
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One live span (use via :func:`span`). ``device_sync`` may be set
+    inside the body to any jax value/pytree; the close then measures
+    the additional wall spent in ``jax.block_until_ready`` on it —
+    the device-time tail of asynchronously dispatched work."""
+
+    __slots__ = ("name", "id", "parent", "depth", "t0_wall", "t0",
+                 "device_sync", "attrs")
+
+    def __init__(self, name, device_sync=None, **attrs):
+        self.name = name
+        self.device_sync = device_sync
+        self.attrs = attrs or None
+        self.id = _next_id()
+        self.parent = None
+        self.depth = 0
+
+    def annotate(self, **kw):
+        self.attrs = dict(self.attrs or (), **kw)
+
+    def __enter__(self):
+        st = _stack()
+        if st:
+            self.parent = st[-1].id
+            self.depth = st[-1].depth + 1
+        st.append(self)
+        self.t0_wall = walltime()
+        self.t0 = monotonic()
+        rec = telemetry.active_recorder()
+        if rec is not None:
+            rec.event("span", ev="B", id=self.id, name=self.name,
+                      depth=self.depth)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        device_s = 0.0
+        if self.device_sync is not None and exc_type is None:
+            td = monotonic()
+            try:
+                import jax
+
+                jax.block_until_ready(self.device_sync)
+            except Exception:   # noqa: BLE001 — profiling never raises
+                pass
+            device_s = monotonic() - td
+        dur = monotonic() - self.t0
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        elif self in st:        # tolerate out-of-order exits
+            st.remove(self)
+        telemetry.registry().histogram(
+            "span_ms", span=self.name).observe(dur * 1e3)
+        record = {"name": self.name, "id": self.id,
+                  "parent": self.parent, "depth": self.depth,
+                  "t0": self.t0_wall, "dur_s": dur,
+                  "device_s": device_s,
+                  "tid": threading.get_ident()}
+        if self.attrs:
+            record["attrs"] = self.attrs
+        global _records_dropped
+        with _records_lock:
+            if len(_records) < _RECORDS_CAP:
+                _records.append(record)
+            else:
+                _records_dropped += 1
+        rec = telemetry.active_recorder()
+        if rec is not None:
+            ev = dict(ev="E", id=self.id, name=self.name,
+                      depth=self.depth, dur_ms=round(dur * 1e3, 3))
+            if device_s:
+                ev["device_ms"] = round(device_s * 1e3, 3)
+            if self.attrs:
+                ev.update(self.attrs)
+            rec.event("span", **ev)
+        return False
+
+
+def span(name, device_sync=None, **attrs):
+    """Open a hierarchical timing span (see module docstring).
+
+    Returns the shared no-op span when disabled — callers use it
+    unconditionally::
+
+        with span("pt.block", device_sync=out) as s:
+            out = dispatch(...)
+            s.device_sync = out      # measured at close
+    """
+    if not spans_enabled():
+        return _NOOP_SPAN
+    return Span(name, device_sync=device_sync, **attrs)
+
+
+def span_records():
+    """Snapshot of the completed-span records (newest last)."""
+    with _records_lock:
+        return list(_records)
+
+
+def reset_spans():
+    """Drop all recorded spans (tests / fresh measurement windows)."""
+    global _records_dropped
+    with _records_lock:
+        _records.clear()
+        _records_dropped = 0
+
+
+def export_chrome_trace(path: str) -> str | None:
+    """Write the completed spans as a Chrome-trace (Perfetto-loadable)
+    JSON file: one complete (``"ph": "X"``) event per span, pid =
+    process, tid = the recording thread — the double-buffered host
+    pipeline's deferred-work spans run concurrently with the main
+    thread's dispatch spans and must land on separate tracks so the
+    flame graph nests correctly. Returns the path, or None when there
+    is nothing to write."""
+    with _records_lock:
+        recs = list(_records)
+        dropped = _records_dropped
+    if not recs:
+        return None
+    events = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
+               "args": {"name": "enterprise_warp_tpu"}}]
+    for r in recs:
+        ev = {"name": r["name"], "ph": "X", "pid": os.getpid(),
+              "tid": r.get("tid", 0),
+              "ts": round(r["t0"] * 1e6, 1),
+              "dur": round(r["dur_s"] * 1e6, 1),
+              "args": {"id": r["id"], "parent": r["parent"],
+                       "depth": r["depth"],
+                       "device_ms": round(r["device_s"] * 1e3, 3)}}
+        if r.get("attrs"):
+            ev["args"].update(r["attrs"])
+        events.append(ev)
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"spans_dropped": dropped}}
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+    except OSError:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        return None
+    return path
+
+
+def flush_trace(run_dir: str | None) -> str | None:
+    """Export ``<run_dir>/trace.json`` if spans are enabled and any
+    were recorded — called by ``telemetry.run_scope`` when the
+    outermost scope closes, so every instrumented run leaves a
+    loadable trace next to its ``events.jsonl``. The record buffer is
+    cleared after a successful export: a process running several
+    sequential runs (bench legs, per-pulsar drivers) must give each
+    run ITS OWN trace, not an accumulation of every prior run's spans
+    silently eating the shared record cap."""
+    if run_dir is None or not spans_enabled():
+        return None
+    path = export_chrome_trace(os.path.join(run_dir, "trace.json"))
+    if path is not None:
+        reset_spans()
+    return path
+
+
+# ------------------------------------------------------------------ #
+#  shared wall-clock measurement protocol                              #
+# ------------------------------------------------------------------ #
+
+def timeit(fn, *args, reps: int = 10, name: str | None = None):
+    """Per-call wall time of ``fn(*args)`` under the one sync
+    discipline every profiling tool shares: one warmup call, block
+    until ready, then ``reps`` calls timed as a unit with a final
+    block — the protocol behind ROOFLINE.json's phase timings, so
+    per-phase numbers from different tools are comparable. Recorded
+    as a span (name ``timeit.<name>``) when spans are enabled."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    with span(f"timeit.{name or getattr(fn, '__name__', 'fn')}",
+              reps=reps):
+        t0 = monotonic()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        dt = (monotonic() - t0) / reps
+    return dt
+
+
+# ------------------------------------------------------------------ #
+#  device-memory observability                                         #
+# ------------------------------------------------------------------ #
+
+def memory_watermark(device=None):
+    """Current device-memory watermarks as
+    ``{"hbm_in_use_bytes", "hbm_peak_bytes"}`` from
+    ``device.memory_stats()``, with the matching registry gauges set —
+    or None on backends without the API (CPU) or when telemetry is
+    off. Never raises: memory telemetry must not kill a run."""
+    if not telemetry.enabled():
+        return None
+    try:
+        import jax
+
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+    except Exception:   # noqa: BLE001 — API absent / backend quirk
+        return None
+    if not stats:
+        return None
+    in_use = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use", in_use)
+    if in_use is None:
+        return None
+    out = {"hbm_in_use_bytes": int(in_use),
+           "hbm_peak_bytes": int(peak if peak is not None else in_use)}
+    reg = telemetry.registry()
+    reg.gauge("hbm_in_use_bytes").set(out["hbm_in_use_bytes"])
+    reg.gauge("hbm_peak_bytes").set(out["hbm_peak_bytes"])
+    return out
+
+
+def live_buffer_report(top: int = 20):
+    """Attribution of live device buffers: groups
+    ``jax.live_arrays()`` by (shape, dtype), returns the ``top``
+    groups by total bytes plus the grand total — the "where did the
+    HBM go" companion to :func:`memory_watermark`, cheap enough for an
+    anomaly dump but NOT for a per-block heartbeat (it walks every
+    live buffer)."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:   # noqa: BLE001 — API drift / backend quirk
+        return {"total_bytes": None, "groups": [],
+                "error": "live_arrays unavailable"}
+    groups: dict = {}
+    total = 0
+    for a in arrays:
+        try:
+            nbytes = int(a.size * a.dtype.itemsize)
+            key = (str(tuple(a.shape)), str(a.dtype))
+        except Exception:   # noqa: BLE001 — deleted/donated stragglers
+            continue
+        g = groups.setdefault(key, [0, 0])
+        g[0] += 1
+        g[1] += nbytes
+        total += nbytes
+    ranked = sorted(groups.items(), key=lambda kv: -kv[1][1])[:top]
+    return {"total_bytes": total,
+            "n_arrays": sum(g[0] for g in groups.values()),
+            "groups": [{"shape": k[0], "dtype": k[1], "count": g[0],
+                        "bytes": g[1]} for k, g in ranked]}
+
+
+# ------------------------------------------------------------------ #
+#  jax.profiler capture windows                                        #
+# ------------------------------------------------------------------ #
+
+_capture = {"active": False, "blocks_left": 0, "armed": None,
+            "started_once": False}
+_capture_lock = threading.Lock()
+
+
+def capture_dir() -> str | None:
+    """The profiler capture directory (``EWT_PROFILE_CAPTURE``), or
+    None when programmatic capture is disabled."""
+    return os.environ.get("EWT_PROFILE_CAPTURE") or None
+
+
+def _default_blocks() -> int:
+    try:
+        return max(1, int(os.environ.get("EWT_PROFILE_BLOCKS", "2")))
+    except ValueError:
+        return 2
+
+
+def capture_arm(n_blocks: int | None = None):
+    """Arm a capture window: the next ``n_blocks`` sampler blocks run
+    under ``jax.profiler.start_trace(EWT_PROFILE_CAPTURE)``. Called by
+    the flight recorder on anomaly (post-anomaly blocks are the
+    interesting ones) or by tools on demand; a no-op without the env
+    var."""
+    if capture_dir() is None:
+        return
+    with _capture_lock:
+        _capture["armed"] = (n_blocks if n_blocks is not None
+                             else _default_blocks())
+
+
+def capture_tick():
+    """Mark one sampler block boundary. Starts the profiler when a
+    window is armed (or on the first block after start-up when
+    ``EWT_PROFILE_CAPTURE`` is set), counts blocks down, and stops the
+    trace when the window closes. No-op without the env var."""
+    cdir = capture_dir()
+    if cdir is None:
+        return
+    with _capture_lock:
+        if not _capture["started_once"] and _capture["armed"] is None:
+            # auto-arm the first window of the process so `env
+            # EWT_PROFILE_CAPTURE=dir <run>` needs no code changes
+            _capture["armed"] = _default_blocks()
+        if _capture["active"]:
+            _capture["blocks_left"] -= 1
+            if _capture["blocks_left"] <= 0:
+                _stop_locked()
+            return
+        if _capture["armed"] is not None:
+            try:
+                import jax
+
+                jax.profiler.start_trace(cdir)
+                _capture["active"] = True
+                _capture["blocks_left"] = _capture["armed"]
+                _capture["started_once"] = True
+            except Exception as exc:   # noqa: BLE001
+                from .logging import get_logger
+
+                get_logger("ewt.profiling").warning(
+                    "profiler capture start failed (%r); disabling "
+                    "capture for this process", exc)
+                _capture["started_once"] = True
+            _capture["armed"] = None
+
+
+def _stop_locked():
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception:   # noqa: BLE001 — double-stop / backend quirk
+        pass
+    _capture["active"] = False
+    _capture["blocks_left"] = 0
+
+
+def capture_stop():
+    """Force-stop an active capture window (atexit / anomaly paths)."""
+    with _capture_lock:
+        if _capture["active"]:
+            _stop_locked()

@@ -53,7 +53,8 @@ import time
 
 __all__ = ["enabled", "registry", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "traced", "RunRecorder", "run_scope",
-           "active_recorder", "dispatch_stats", "pallas_path_summary"]
+           "active_recorder", "dispatch_stats", "pallas_path_summary",
+           "cost_analysis_enabled", "set_flight_hook"]
 
 
 def enabled() -> bool:
@@ -101,7 +102,14 @@ class Histogram:
     """Streaming histogram: exact count/sum/min/max plus quantiles from
     a bounded deterministic reservoir (every k-th observation once the
     buffer is full — unbiased enough for progress telemetry, O(1) per
-    ``observe`` and bounded memory on million-step runs)."""
+    ``observe`` and bounded memory on million-step runs).
+
+    Edge contract: an EMPTY histogram returns ``None`` from
+    ``quantile``/the summary percentiles (never raises — downstream
+    report folds run on partial streams), and ``summary`` reports
+    ``samples_dropped`` — how many observations the capped reservoir
+    no longer holds — so consumers can judge how honest the
+    percentiles are (0 means they are exact order statistics)."""
 
     __slots__ = ("count", "sum", "min", "max", "_buf", "_cap", "_stride")
 
@@ -127,9 +135,16 @@ class Histogram:
                 self._buf = self._buf[::2]
                 self._stride *= 2
 
+    @property
+    def samples_dropped(self) -> int:
+        """Observations not represented in the reservoir (stride skips
+        plus decimation losses) — the honesty figure for quantiles."""
+        return self.count - len(self._buf)
+
     def quantile(self, q: float):
         if not self._buf:
             return None
+        q = min(max(float(q), 0.0), 1.0)
         s = sorted(self._buf)
         idx = min(int(q * len(s)), len(s) - 1)
         return s[idx]
@@ -138,7 +153,8 @@ class Histogram:
         return {"count": self.count, "sum": self.sum,
                 "min": self.min, "max": self.max,
                 "p50": self.quantile(0.5), "p90": self.quantile(0.9),
-                "p99": self.quantile(0.99)}
+                "p99": self.quantile(0.99),
+                "samples_dropped": self.samples_dropped}
 
 
 class _NoopMetric:
@@ -147,6 +163,7 @@ class _NoopMetric:
     __slots__ = ()
     value = None
     count = 0
+    samples_dropped = 0
 
     def inc(self, n=1):
         pass
@@ -244,7 +261,62 @@ def _arg_shapes(args, limit: int = 24):
     return out
 
 
-def traced(fn, *, name: str | None = None, **jit_kwargs):
+def cost_analysis_enabled() -> bool:
+    """Cost-analysis harvesting (``EWT_COST_ANALYSIS=1``): every
+    retrace at a :func:`traced` site additionally AOT-compiles the
+    program and records XLA's ``cost_analysis()`` (flops /
+    bytes-accessed) — the analytic side of ``tools/roofline.py
+    --analytic``. Opt-in: the harvest pays a second compile per
+    retrace."""
+    return enabled() \
+        and os.environ.get("EWT_COST_ANALYSIS", "0") == "1"
+
+
+def harvest_cost_analysis(jitted, label, args, kwargs):
+    """AOT-compile ``jitted`` on ``args`` and fold its
+    ``cost_analysis()`` into ``cost_flops{fn=}``/``cost_bytes{fn=}``
+    gauges plus a ``cost_analysis`` event. Returns the normalized
+    ``{"flops", "bytes_accessed", ...}`` dict or None; never raises
+    (cost telemetry must not kill a run)."""
+    try:
+        import jax
+
+        def _abstract(x):
+            # the triggering call may have DONATED its array inputs
+            # (sampler blocks) — lower from shape/dtype structs so the
+            # harvest never touches a consumed buffer
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
+            return x
+
+        aargs = jax.tree_util.tree_map(_abstract, args)
+        akwargs = jax.tree_util.tree_map(_abstract, kwargs)
+        compiled = jitted.lower(*aargs, **akwargs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return None
+        flops = ca.get("flops")
+        by = ca.get("bytes accessed", ca.get("bytes_accessed"))
+        out = {"flops": (float(flops) if flops is not None else None),
+               "bytes_accessed": (float(by) if by is not None
+                                  else None)}
+        if out["flops"] is not None:
+            _REGISTRY.gauge("cost_flops", fn=label).set(out["flops"])
+        if out["bytes_accessed"] is not None:
+            _REGISTRY.gauge("cost_bytes", fn=label).set(
+                out["bytes_accessed"])
+        rec = active_recorder()
+        if rec is not None:
+            rec.event("cost_analysis", fn=label, **out)
+        return out
+    except Exception:   # noqa: BLE001 — backend without the API, etc.
+        return None
+
+
+def traced(fn, *, name: str | None = None, cost: bool | None = None,
+           **jit_kwargs):
     """``jax.jit`` with compile/retrace telemetry.
 
     Returns a jitted callable semantically identical to
@@ -258,6 +330,11 @@ def traced(fn, *, name: str | None = None, **jit_kwargs):
     The retrace detection is a host-side flag set inside the traced
     Python body — no private jax API, no extra device work, and the
     steady-state (cache-hit) overhead is one flag check per call.
+
+    ``cost``: harvest XLA ``cost_analysis()`` (flops/bytes) on each
+    retrace — ``None`` (default) defers to ``EWT_COST_ANALYSIS=1``,
+    ``True``/``False`` pins it for this site. See
+    :func:`harvest_cost_analysis`.
 
     With ``EWT_TELEMETRY=0`` this returns the bare jitted function.
     """
@@ -291,6 +368,8 @@ def traced(fn, *, name: str | None = None, **jit_kwargs):
             if rec is not None:
                 rec.event("compile", fn=label, wall_s=round(wall, 4),
                           arg_shapes=_arg_shapes(args))
+            if cost if cost is not None else cost_analysis_enabled():
+                harvest_cost_analysis(jitted, label, args, kwargs)
         return out
 
     call._jitted = jitted
@@ -485,6 +564,21 @@ def _sanitize_dumps(rec) -> str:
     return json.dumps(_sanitize(rec), default=_json_default)
 
 
+# flight-recorder mirror hook (utils/flightrec.py): when flight
+# recording is enabled, every recorded event is also appended to the
+# in-memory ring buffer so an anomaly dump carries the recent
+# telemetry tail. Registered lazily by flightrec.flight_recorder();
+# None costs one comparison per event.
+_FLIGHT_HOOK = None
+
+
+def set_flight_hook(hook):
+    """Install (or clear, with None) the per-event flight-recorder
+    mirror — see ``utils/flightrec.py``."""
+    global _FLIGHT_HOOK
+    _FLIGHT_HOOK = hook
+
+
 class RunRecorder:
     """Structured JSONL event stream for one run directory.
 
@@ -536,6 +630,8 @@ class RunRecorder:
             return
         rec = {"t": round(time.time(), 3), "type": type}
         rec.update(fields)
+        if _FLIGHT_HOOK is not None:
+            _FLIGHT_HOOK(rec)
         self._buf.append(_sanitize_dumps(rec))
         now = time.time()
         if (len(self._buf) >= self._flush_every
@@ -661,6 +757,16 @@ def run_scope(run_dir: str | None, **start_fields):
     rec = RunRecorder(run_dir)
     rec.run_start(**start_fields)
     _ACTIVE.append(rec)
+    # the outermost scope owns the deep-profiling artifacts too: bind
+    # the flight recorder to this run (anomaly dumps land under it)
+    # and export the Chrome trace when the scope closes. Both are
+    # no-ops unless their knobs (EWT_FLIGHTREC / EWT_SPANS) are set.
+    try:
+        from .flightrec import flight_recorder
+
+        flight_recorder().bind(run_dir)
+    except Exception:   # noqa: BLE001 — profiling never kills a run
+        pass
     status = "ok"
     try:
         yield rec
@@ -668,6 +774,31 @@ def run_scope(run_dir: str | None, **start_fields):
         status = "error"
         raise
     finally:
+        # the error-path anomaly dump must fire while this recorder is
+        # still active, so its 'anomaly' event (the on-disk pointer to
+        # the dump) lands in events.jsonl before the stream closes
+        if status == "error":
+            try:
+                from .flightrec import flight_recorder
+
+                flight_recorder().anomaly(
+                    "run_scope_error", run_dir=run_dir,
+                    once_key=f"run_scope_error:{run_dir}")
+            except Exception:   # noqa: BLE001
+                pass
         _ACTIVE.remove(rec)
+        try:
+            from . import profiling
+            from .flightrec import flight_recorder
+
+            flight_recorder().unbind()
+            profiling.flush_trace(run_dir)
+            # finalize any in-flight jax.profiler capture window: a
+            # window armed near the end of the run (e.g. by an
+            # anomaly on one of the last blocks) would otherwise
+            # never be stopped and its trace never written
+            profiling.capture_stop()
+        except Exception:   # noqa: BLE001
+            pass
         rec.run_end(status=status)
         rec.close()

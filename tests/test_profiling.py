@@ -1,0 +1,455 @@
+"""Deep-profiling layer tests (utils/profiling.py + utils/flightrec.py
++ the report --check mode).
+
+Covers the ISSUE-5 acceptance surface: span nesting/ordering
+round-trip, Chrome-trace export schema, the disabled no-op (shared
+inert span, zero events), memory-stats graceful fallback on CPU,
+cost-analysis harvest on a toy traced fn, flight-recorder ring-buffer
+eviction, histogram empty/dropped-samples edge cases, the raw-timing
+lint, events.jsonl schema validation (``tools/report.py --check``),
+and the end-to-end PTMCMC run with an injected NaN producing a valid
+``anomaly/`` forensics dump, a loadable ``trace.json``, and span
+histograms — none of which exist under ``EWT_TELEMETRY=0``.
+"""
+
+import importlib.util
+import json
+import pathlib
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.models.priors import Parameter, Uniform
+from enterprise_warp_tpu.utils import flightrec, profiling, telemetry
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+PKG_DIR = REPO_ROOT / "enterprise_warp_tpu"
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiling(monkeypatch):
+    """Every test starts with telemetry on, spans/flightrec off (the
+    default), a clean registry, and no leftover span records or
+    flight-recorder singleton from another test."""
+    monkeypatch.setenv("EWT_TELEMETRY", "1")
+    monkeypatch.delenv("EWT_SPANS", raising=False)
+    monkeypatch.delenv("EWT_FLIGHTREC", raising=False)
+    monkeypatch.delenv("EWT_PROFILE_CAPTURE", raising=False)
+    monkeypatch.delenv("EWT_COST_ANALYSIS", raising=False)
+    telemetry.registry().reset()
+    profiling.reset_spans()
+    monkeypatch.setattr(flightrec, "_RECORDER", None)
+    telemetry.set_flight_hook(None)
+    yield
+    telemetry.set_flight_hook(None)
+    profiling.reset_spans()
+    telemetry.registry().reset()
+
+
+def _load_report_cli():
+    spec = importlib.util.spec_from_file_location(
+        "ewt_report_cli2", str(REPO_ROOT / "tools" / "report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class BoxLike:
+    """Minimal likelihood; ``nan_above`` poisons lnL on a half-space
+    so proposals crossing it produce genuinely non-finite evals."""
+
+    def __init__(self, nan_above=None):
+        self.ndim = 2
+        self.params = [Parameter(f"p{i}", Uniform(-10.0, 10.0))
+                       for i in range(self.ndim)]
+        self.param_names = [p.name for p in self.params]
+
+        def ll(theta):
+            base = -0.5 * jnp.sum(((theta - 1.0) / 0.5) ** 2)
+            if nan_above is not None:
+                return jnp.where(theta[0] > nan_above, jnp.nan, base)
+            return base
+
+        self.loglike = jax.jit(ll)
+        self.loglike_batch = jax.jit(jax.vmap(ll))
+
+    def log_prior(self, theta):
+        theta = jnp.atleast_1d(theta)
+        out = 0.0
+        for i, p in enumerate(self.params):
+            out = out + p.prior.logpdf(theta[..., i])
+        return out
+
+    def from_unit(self, u):
+        return jnp.stack([p.prior.from_unit(u[..., i])
+                          for i, p in enumerate(self.params)], axis=-1)
+
+    def sample_prior(self, rng, n=1):
+        return rng.uniform(-10.0, 10.0, size=(n, self.ndim))
+
+
+# ------------------------------------------------------------------ #
+#  spans                                                               #
+# ------------------------------------------------------------------ #
+
+def test_span_nesting_and_ordering(monkeypatch, tmp_path):
+    monkeypatch.setenv("EWT_SPANS", "1")
+    with telemetry.run_scope(str(tmp_path), sampler="t") as rec:
+        with profiling.span("outer") as so:
+            with profiling.span("inner") as si:
+                assert si.depth == 1 and si.parent == so.id
+            with profiling.span("inner2"):
+                pass
+        rec.flush()
+        # records inspected INSIDE the scope: the outermost close
+        # exports trace.json and resets the buffer (per-run traces)
+        recs = profiling.span_records()
+        by_name = {r["name"]: r for r in recs}
+        assert set(by_name) == {"outer", "inner", "inner2"}
+        # children close before the parent and point back at it
+        assert [r["name"] for r in recs] == ["inner", "inner2", "outer"]
+        assert by_name["inner"]["parent"] == by_name["outer"]["id"]
+        assert by_name["inner2"]["depth"] == 1
+        assert by_name["outer"]["parent"] is None
+        assert by_name["outer"]["dur_s"] >= by_name["inner"]["dur_s"]
+    # the scope close wrote the per-run trace and cleared the buffer
+    assert (tmp_path / "trace.json").exists()
+    assert profiling.span_records() == []
+    # span histograms persist in the registry across the reset
+    snap = telemetry.registry().snapshot()
+    assert snap["histograms"]["span_ms{span=outer}"]["count"] == 1
+    # the event stream carries balanced B/E pairs
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    sp = [e for e in events if e["type"] == "span"]
+    assert sum(e["ev"] == "B" for e in sp) == 3
+    assert sum(e["ev"] == "E" for e in sp) == 3
+    closes = [e for e in sp if e["ev"] == "E"]
+    assert all(e["dur_ms"] >= 0 for e in closes)
+
+
+def test_span_device_sync_measured(monkeypatch):
+    monkeypatch.setenv("EWT_SPANS", "1")
+    with profiling.span("devwait") as s:
+        out = jnp.ones(64) * 2.0
+        s.device_sync = out
+    r = profiling.span_records()[-1]
+    assert r["name"] == "devwait" and r["device_s"] >= 0.0
+
+
+def test_chrome_trace_export_schema(monkeypatch, tmp_path):
+    monkeypatch.setenv("EWT_SPANS", "1")
+    with profiling.span("a"):
+        with profiling.span("b"):
+            pass
+    path = profiling.export_chrome_trace(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in evs} == {"a", "b"}
+    for e in evs:
+        assert isinstance(e["ts"], (int, float))
+        assert e["dur"] >= 0
+        assert "pid" in e and "tid" in e
+        assert "depth" in e["args"]
+    # the nested span sits inside its parent's interval
+    a = next(e for e in evs if e["name"] == "a")
+    b = next(e for e in evs if e["name"] == "b")
+    assert a["ts"] <= b["ts"]
+    assert b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1.0   # 1us slop
+
+
+def test_spans_disabled_noop(tmp_path):
+    # EWT_SPANS unset: one shared inert object, no records, no events
+    s1 = profiling.span("x")
+    s2 = profiling.span("y", device_sync=jnp.ones(3))
+    assert s1 is s2                    # no per-call object churn
+    with s1 as s:
+        s.device_sync = jnp.ones(2)    # accepted and dropped
+    assert profiling.span_records() == []
+    with telemetry.run_scope(str(tmp_path), sampler="t") as rec:
+        with profiling.span("z"):
+            pass
+        rec.flush()
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    assert not [e for e in events if e["type"] == "span"]
+    assert profiling.flush_trace(str(tmp_path)) is None
+    assert not (tmp_path / "trace.json").exists()
+
+
+def test_timeit_protocol_runs():
+    f = jax.jit(lambda x: x * 2.0)
+    dt = profiling.timeit(f, jnp.ones(8), reps=3, name="toy")
+    assert dt >= 0.0
+
+
+# ------------------------------------------------------------------ #
+#  memory observability                                                #
+# ------------------------------------------------------------------ #
+
+def test_memory_watermark_graceful_on_cpu():
+    # CPU backends may or may not implement memory_stats(); either a
+    # well-formed dict or None is acceptable — never an exception
+    out = profiling.memory_watermark()
+    if out is not None:
+        assert set(out) == {"hbm_in_use_bytes", "hbm_peak_bytes"}
+        assert out["hbm_peak_bytes"] >= 0
+        snap = telemetry.registry().snapshot()
+        assert "hbm_peak_bytes" in snap["gauges"]
+
+
+def test_live_buffer_report_groups():
+    keep = jnp.ones((17, 3))           # noqa: F841 — must stay live
+    rep = profiling.live_buffer_report(top=5)
+    assert rep["total_bytes"] is None or rep["total_bytes"] >= 0
+    if rep["groups"]:
+        g = rep["groups"][0]
+        assert {"shape", "dtype", "count", "bytes"} <= set(g)
+        json.dumps(rep)                # JSON-ready
+
+
+# ------------------------------------------------------------------ #
+#  cost analysis                                                       #
+# ------------------------------------------------------------------ #
+
+def test_cost_analysis_harvest_on_traced_fn(monkeypatch, tmp_path):
+    monkeypatch.setenv("EWT_COST_ANALYSIS", "1")
+    with telemetry.run_scope(str(tmp_path), sampler="t") as rec:
+        fn = telemetry.traced(lambda x: x @ x.T, name="toy_cost")
+        fn(jnp.ones((16, 16)))
+        rec.flush()
+    snap = telemetry.registry().snapshot()
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    ca = [e for e in events if e["type"] == "cost_analysis"]
+    # the harvest is best-effort per backend; when the backend reports
+    # a cost model the gauge and event must both exist and agree
+    if "cost_flops{fn=toy_cost}" in snap["gauges"]:
+        assert ca and ca[0]["fn"] == "toy_cost"
+        assert ca[0]["flops"] == snap["gauges"]["cost_flops{fn=toy_cost}"]
+        assert ca[0]["flops"] > 0
+    else:
+        assert not ca
+
+
+def test_cost_analysis_direct_harvest():
+    jitted = jax.jit(lambda x: jnp.sum(x * x))
+    out = telemetry.harvest_cost_analysis(
+        jitted, "direct", (jnp.ones(128),), {})
+    assert out is None or out["flops"] is None or out["flops"] > 0
+
+
+# ------------------------------------------------------------------ #
+#  histogram edge cases (satellite)                                    #
+# ------------------------------------------------------------------ #
+
+def test_histogram_empty_returns_none():
+    h = telemetry.Histogram()
+    assert h.quantile(0.5) is None
+    s = h.summary()
+    assert s["p50"] is None and s["p99"] is None
+    assert s["count"] == 0 and s["samples_dropped"] == 0
+    json.dumps(s, allow_nan=False)
+
+
+def test_histogram_samples_dropped_honest():
+    h = telemetry.Histogram(cap=256)
+    for v in range(100):
+        h.observe(float(v))
+    assert h.summary()["samples_dropped"] == 0      # exact so far
+    for v in range(20000):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 20100
+    assert s["samples_dropped"] == s["count"] - len(h._buf)
+    assert s["samples_dropped"] > 0
+    assert len(h._buf) <= 256
+
+
+# ------------------------------------------------------------------ #
+#  flight recorder                                                     #
+# ------------------------------------------------------------------ #
+
+def test_flightrec_ring_eviction():
+    fr = flightrec.FlightRecorder(ring_len=4)
+    for i in range(7):
+        fr.record("tick", i=i)
+    tail = fr.tail()
+    assert len(tail) == 4
+    assert [r["i"] for r in tail] == [3, 4, 5, 6]
+    assert [r["i"] for r in fr.tail(2)] == [5, 6]
+
+
+def test_flightrec_disabled_noop(tmp_path):
+    fr = flightrec.flight_recorder()       # EWT_FLIGHTREC unset
+    fr.record("x")
+    fr.note_state(step=1)
+    assert fr.anomaly("nope", run_dir=str(tmp_path)) is None
+    assert not (tmp_path / "anomaly").exists()
+
+
+def test_flightrec_forensic_encoding():
+    enc = flightrec._forensic(
+        {"a": float("nan"), "b": [1.0, float("inf")],
+         "c": np.array([np.nan, 2.0])})
+    assert enc["a"] == "NaN"
+    assert enc["b"] == [1.0, "Infinity"]
+    assert enc["c"] == ["NaN", 2.0]
+    json.dumps(enc, allow_nan=False)       # strict JSON
+
+
+def test_flightrec_anomaly_dump(monkeypatch, tmp_path):
+    monkeypatch.setenv("EWT_FLIGHTREC", "1")
+    fr = flightrec.flight_recorder()
+    fr.record("heartbeat", step=10)
+    fr.note_state(sampler="test", step=10)
+    path = fr.anomaly("unit_test", run_dir=str(tmp_path),
+                      bad_lnl=np.array([np.nan, -1.0]))
+    doc = json.load(open(path))
+    assert doc["reason"] == "unit_test"
+    assert doc["payload"]["bad_lnl"] == ["NaN", -1.0]
+    assert doc["state"]["sampler"] == "test"
+    assert doc["ring_tail"][-1]["type"] == "heartbeat"
+    assert "megakernel" in doc["pallas"]
+    # dedup: the same once-key never dumps twice
+    assert fr.anomaly("unit_test", run_dir=str(tmp_path)) is None
+
+
+# ------------------------------------------------------------------ #
+#  lint: raw timing is banned outside telemetry/profiling              #
+# ------------------------------------------------------------------ #
+
+def test_no_raw_timing_outside_profiling():
+    """``time.perf_counter(`` / ``time.time(`` are banned in the
+    package outside ``utils/telemetry.py`` and ``utils/profiling.py``
+    — ad-hoc timing is invisible to the span histograms and the
+    Chrome-trace export, so all other code routes through
+    ``profiling.monotonic``/``walltime``/``span``."""
+    allowed = {PKG_DIR / "utils" / "telemetry.py",
+               PKG_DIR / "utils" / "profiling.py"}
+    pattern = re.compile(r"time\.perf_counter\(|time\.time\(")
+    offenders = []
+    for path in sorted(PKG_DIR.rglob("*.py")):
+        if path in allowed:
+            continue
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1):
+            if pattern.search(line):
+                offenders.append(f"{path.relative_to(REPO_ROOT)}:"
+                                 f"{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raw time.perf_counter()/time.time() in library code (use "
+        "utils.profiling.monotonic/walltime/span so timing feeds the "
+        "span histograms and trace export):\n" + "\n".join(offenders))
+
+
+# ------------------------------------------------------------------ #
+#  report --check: event-stream schema validation                      #
+# ------------------------------------------------------------------ #
+
+def test_report_check_clean_and_dirty(tmp_path, capsys):
+    report_cli = _load_report_cli()
+    rec = telemetry.RunRecorder(str(tmp_path))
+    rec.run_start(sampler="t")
+    rec.event("span", ev="B", id=1, name="blk", depth=0)
+    rec.heartbeat(step=1)
+    rec.event("span", ev="E", id=1, name="blk", depth=0, dur_ms=1.0)
+    rec.run_end(status="ok")
+    rec.close()
+    assert report_cli.main([str(tmp_path), "--check"]) == 0
+    assert "clean" in capsys.readouterr().out
+
+    # dirty stream: unknown type, torn tail, unclosed span
+    with open(rec.path, "a") as fh:
+        fh.write('{"t": 1.0, "type": "mystery"}\n')
+        fh.write('{"t": 2.0, "type": "span", "ev": "B", "id": 99, '
+                 '"name": "lost", "depth": 0}\n')
+        fh.write('{"t": 3.0, "type": "hea')       # torn record
+    assert report_cli.main([str(tmp_path), "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "unknown event type" in out
+    assert "torn/malformed" in out
+    assert "never closed" in out
+
+
+# ------------------------------------------------------------------ #
+#  end-to-end: PTMCMC + injected NaN -> full forensics surface         #
+# ------------------------------------------------------------------ #
+
+def test_e2e_ptmcmc_nan_anomaly_trace_and_report(monkeypatch,
+                                                 tmp_path, capsys):
+    monkeypatch.setenv("EWT_SPANS", "1")
+    monkeypatch.setenv("EWT_FLIGHTREC", "1")
+    from enterprise_warp_tpu.samplers import PTSampler
+
+    like = BoxLike(nan_above=0.0)
+    d = tmp_path / "run"
+    s = PTSampler(like, str(d), ntemps=1, nchains=4, seed=1,
+                  cov_update=100)
+    s.sample(200, resume=False, verbose=False, block_size=100)
+
+    # ---- anomaly dump: exists, valid strict JSON, right content ----
+    apath = d / "anomaly" / "anomaly.json"
+    assert apath.exists()
+    doc = json.load(open(apath))
+    json.dumps(doc, allow_nan=False)
+    assert doc["reason"] == "nonfinite_eval"
+    assert doc["payload"]["n_bad_evals"] > 0
+    assert doc["state"].get("sampler", "ptmcmc") == "ptmcmc"
+    assert doc["ring_tail"], "ring buffer tail missing from dump"
+    assert "megakernel" in doc["pallas"]
+    snap = telemetry.registry().snapshot()
+    nf = [k for k in snap["counters"] if k.startswith("nonfinite_eval")]
+    assert nf, "nonfinite_eval counter missing"
+
+    # ---- trace.json: loadable Chrome trace with the block spans ----
+    trace = json.load(open(d / "trace.json"))
+    names = {e["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    assert {"pt.dispatch", "pt.commit", "pt.host_work"} <= names
+    # span histograms in the telemetry snapshot
+    assert any(k.startswith("span_ms{") for k in snap["histograms"])
+
+    # ---- events.jsonl: anomaly event recorded, stream check-clean --
+    events = [json.loads(ln) for ln in
+              (d / "events.jsonl").read_text().splitlines()]
+    assert any(e["type"] == "anomaly" for e in events)
+    report_cli = _load_report_cli()
+    assert report_cli.main([str(d), "--check"]) == 0
+    capsys.readouterr()
+
+    # ---- report renders the postmortem + span sections -------------
+    assert report_cli.main([str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "POSTMORTEM" in out
+    assert "nonfinite_eval" in out
+    assert "pt.dispatch" in out
+    rpt = json.load(open(d / "run_report.json"))
+    json.dumps(rpt, allow_nan=False)
+    assert rpt["postmortem"]["reason"] == "nonfinite_eval"
+    assert rpt["spans"]["pt.dispatch"]["count"] >= 1
+    assert rpt["anomalies"]
+
+
+def test_e2e_disabled_creates_no_artifacts(monkeypatch, tmp_path):
+    # EWT_TELEMETRY=0 master-gates EVERYTHING, even with the
+    # profiling knobs explicitly on
+    monkeypatch.setenv("EWT_TELEMETRY", "0")
+    monkeypatch.setenv("EWT_SPANS", "1")
+    monkeypatch.setenv("EWT_FLIGHTREC", "1")
+    from enterprise_warp_tpu.samplers import PTSampler
+
+    like = BoxLike(nan_above=0.0)
+    d = tmp_path / "off"
+    s = PTSampler(like, str(d), ntemps=1, nchains=4, seed=1,
+                  cov_update=60)
+    s.sample(60, resume=False, verbose=False, block_size=60)
+    assert (d / "chain_1.txt").exists()
+    assert not (d / "events.jsonl").exists()
+    assert not (d / "trace.json").exists()
+    assert not (d / "anomaly").exists()
+    assert profiling.span_records() == []
+    assert telemetry.registry().snapshot()["counters"] == {}

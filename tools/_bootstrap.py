@@ -1,0 +1,49 @@
+"""Shared jax-import-free bootstrap for the ``tools/`` scripts.
+
+One place for the repo-path + plugin-site-guard stanza the standalone
+tools need before importing jax (previously duplicated per tool):
+
+- loads ``enterprise_warp_tpu/_pathguard.py`` by FILE PATH (importing
+  it as a package module would pull in the package ``__init__``, which
+  imports jax — exactly what the guard must run before);
+- for CPU-only invocations (``JAX_PLATFORMS=cpu`` /
+  ``EWT_PLATFORM=cpu``) strips PJRT plugin site dirs from ``sys.path``
+  so a dead accelerator tunnel cannot hang jax backend discovery;
+- puts the repo root on ``sys.path`` so ``enterprise_warp_tpu`` and
+  ``__graft_entry__`` import from the checkout.
+
+Usage (top of a tool, before any jax import)::
+
+    import os, sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _bootstrap import ensure_repo_path
+    REPO = ensure_repo_path()
+"""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_pathguard():
+    """The shared plugin-site predicate module, loaded by file path."""
+    spec = importlib.util.spec_from_file_location(
+        "_pathguard", os.path.join(REPO, "enterprise_warp_tpu",
+                                   "_pathguard.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def ensure_repo_path():
+    """Apply the guard (CPU-only invocations) and put the repo root on
+    ``sys.path``. Returns the repo root."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") \
+            or os.environ.get("EWT_PLATFORM") == "cpu":
+        sys.path[:] = load_pathguard().strip_plugin_site(sys.path) \
+            or [""]
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    return REPO

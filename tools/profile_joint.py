@@ -6,20 +6,28 @@ npsr=45 throughput number can be decomposed into Gram / per-pulsar solve /
 TM Schur / coupling / big-S solve shares — the floor analysis the round-2
 verdict asked for.
 
+Measurement protocol: every stage goes through
+``utils.profiling.timeit`` (the one warmup/block/rep discipline shared
+with ``tools/profile_kernel.py`` and ``tools/roofline.py``), so these
+stage shares are directly comparable with ROOFLINE.json's phases.
+
 Usage: python tools/profile_joint.py [npsr] [ntoa] [batch]
 """
 
 import os
 import sys
-import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import ensure_repo_path    # noqa: E402
+
+REPO = ensure_repo_path()
 
 import numpy as np                                        # noqa: E402
 
 import jax                                                # noqa: E402
 import jax.numpy as jnp                                   # noqa: E402
+
+from enterprise_warp_tpu.utils import profiling           # noqa: E402
 
 
 def build(npsr, ntoa):
@@ -58,15 +66,7 @@ def moderate_batch(like, batch, seed=3):
 
 
 def timeit(name, fn, *args, reps=5):
-    out = fn(*args)
-    jax.tree_util.tree_map(lambda x: x.block_until_ready()
-                           if hasattr(x, "block_until_ready") else x, out)
-    t = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.tree_util.tree_map(lambda x: x.block_until_ready()
-                           if hasattr(x, "block_until_ready") else x, out)
-    dt = (time.perf_counter() - t) / reps
+    dt = profiling.timeit(fn, *args, reps=reps, name=name)
     print(f"  {name:28s} {dt*1e3:9.1f} ms/batch")
     return dt
 
@@ -124,6 +124,10 @@ def main():
     print(f"  accounted {acc*1e3:.1f} of {dt_full*1e3:.1f} ms "
           f"(rest: TM Schur f64 products, S assembly, residual ops)")
     print(f"  throughput: {batch/dt_full:.1f} evals/s")
+
+    if profiling.spans_enabled():
+        print("trace:", profiling.export_chrome_trace(
+            "profile_joint_trace.json"))
 
 
 if __name__ == "__main__":

@@ -3,41 +3,42 @@
 Times each computational phase of ``ops.kernel.marginalized_loglike`` in
 isolation over a walker batch, to locate where the batched-eval wall-clock
 goes (VERDICT round-1 item 2: profile before optimizing).
+
+Measurement protocol: every phase goes through
+``utils.profiling.timeit`` — the ONE warmup/block-until-ready/rep-loop
+discipline shared with ``tools/profile_joint.py`` and
+``tools/roofline.py`` (ROOFLINE.json), so per-phase numbers from the
+three tools are directly comparable; with ``EWT_SPANS=1`` each phase
+also lands in the ``span_ms{span=timeit.*}`` histograms and the
+Chrome-trace export.
 """
 
-import os as _os
-import sys as _sys
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
-
 import os
-import time
+import sys
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import ensure_repo_path    # noqa: E402
 
-from enterprise_warp_tpu.models import build_pulsar_likelihood
-from enterprise_warp_tpu.ops.kernel import (_chunked_f32_gram,
-                                            _mixed_psd_solve_logdet,
-                                            _pad_to_chunk, _CHUNK,
-                                            _gram_pair,
-                                            equilibrated_cholesky,
-                                            whiten_inputs)
+REPO = ensure_repo_path()
 
-import __graft_entry__ as g
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+
+from enterprise_warp_tpu.models import build_pulsar_likelihood  # noqa: E402
+from enterprise_warp_tpu.ops.kernel import (  # noqa: E402
+    _chunked_f32_gram, _mixed_psd_solve_logdet, _pad_to_chunk, _CHUNK,
+    _gram_pair, equilibrated_cholesky, whiten_inputs)
+from enterprise_warp_tpu.utils import profiling  # noqa: E402
+
+import __graft_entry__ as g                                 # noqa: E402
 
 BATCH = int(os.environ.get("EWT_PROFILE_BATCH", 1024))
 REPS = int(os.environ.get("EWT_PROFILE_REPS", 10))
 
 
 def timeit(name, fn, *args):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / REPS
+    dt = profiling.timeit(fn, *args, reps=REPS, name=name)
     print(f"{name:42s} {dt*1e3:9.2f} ms/batch")
     return dt
 
@@ -264,6 +265,12 @@ def main():
     timeit("psolve via 2x trisolve", trisolve_psolve, Lf, RHS)
     timeit("residual mm64 (nb x nb x k)", resid_mm64, G64, RHS)
     timeit("residual split gram", resid_split, G64, RHS)
+
+    if profiling.spans_enabled():
+        # EWT_SPANS=1: every phase above is a span — export the
+        # Chrome trace next to the invocation for Perfetto
+        print("trace:", profiling.export_chrome_trace(
+            "profile_kernel_trace.json"))
 
 
 if __name__ == "__main__":

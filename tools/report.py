@@ -18,6 +18,18 @@ prints a human-readable summary:
 - cache-hit provenance (the block-sparse evaluation layer's
   ``cache_hit_rate``) and the final metrics-registry snapshot.
 
+Deep-profiling folds (PR 5): ``span`` events (the hierarchical-span
+layer, ``EWT_SPANS=1``) fold into per-span count/total-ms statistics;
+heartbeat ``hbm_*`` watermarks fold into a ``memory`` section; and an
+``anomaly/`` forensics dump next to the stream (``EWT_FLIGHTREC=1``)
+renders as a postmortem section in both the JSON report and the human
+summary.
+
+``--check`` mode: schema-validate the stream instead of folding it —
+unknown event types, torn/malformed records, and span open/close
+imbalance are reported and exit non-zero, so CI can gate on stream
+integrity.
+
 Tolerates an in-flight run (no ``run_end`` yet) and skips corrupt
 lines (a kill mid-append leaves at most one partial line, which the
 atomic-append contract confines to the tail).
@@ -29,6 +41,13 @@ import argparse
 import json
 import os
 import sys
+
+#: the typed-event vocabulary (docs/observability.md). ``--check``
+#: flags anything else as unknown.
+KNOWN_EVENT_TYPES = frozenset({
+    "run_start", "run_end", "compile", "heartbeat", "checkpoint",
+    "span", "cost_analysis", "anomaly",
+})
 
 
 def _atomic_write_json(path, obj):
@@ -93,6 +112,8 @@ def build_report(events, dropped=0):
     compiles = by_type.get("compile", [])
     heartbeats = by_type.get("heartbeat", [])
     checkpoints = by_type.get("checkpoint", [])
+    spans = by_type.get("span", [])
+    anomalies = by_type.get("anomaly", [])
 
     t0 = starts[0]["t"] if starts else (events[0]["t"] if events
                                         else None)
@@ -145,6 +166,43 @@ def build_report(events, dropped=0):
     evals_total = max((hb.get("evals_total", 0) for hb in heartbeats),
                       default=0)
 
+    # ---- span folds (hierarchical-span layer, EWT_SPANS=1) ---------- #
+    # open/close pairing by id (a stream whose head was lost may hold
+    # E events with no B — those must not drive the open count
+    # negative; check_stream reports them separately)
+    span_stats: dict = {}
+    open_ids: set = set()
+    for ev in spans:
+        if ev.get("ev") == "B":
+            open_ids.add(ev.get("id"))
+            continue
+        if ev.get("ev") != "E":
+            continue
+        open_ids.discard(ev.get("id"))
+        d = span_stats.setdefault(
+            ev.get("name", "?"),
+            {"count": 0, "total_ms": 0.0, "device_ms": 0.0,
+             "max_ms": 0.0})
+        ms = float(ev.get("dur_ms") or 0.0)
+        d["count"] += 1
+        d["total_ms"] = round(d["total_ms"] + ms, 3)
+        d["max_ms"] = round(max(d["max_ms"], ms), 3)
+        d["device_ms"] = round(d["device_ms"]
+                               + float(ev.get("device_ms") or 0.0), 3)
+
+    # ---- device-memory watermarks (profiling layer) ----------------- #
+    hbm_peaks = [hb["hbm_peak_bytes"] for hb in heartbeats
+                 if hb.get("hbm_peak_bytes") is not None]
+    hbm_last = [hb["hbm_in_use_bytes"] for hb in heartbeats
+                if hb.get("hbm_in_use_bytes") is not None]
+    memory = None
+    if hbm_peaks or hbm_last:
+        memory = {
+            "hbm_peak_bytes": max(hbm_peaks) if hbm_peaks else None,
+            "hbm_last_in_use_bytes": (hbm_last[-1] if hbm_last
+                                      else None),
+        }
+
     report = {
         "run": dict(starts[0], t=None) if starts else {},
         "status": (ends[-1].get("status") if ends else "in_flight"),
@@ -187,11 +245,32 @@ def build_report(events, dropped=0):
         "cache_hit_rate": cache_hit,
         "pallas_path": pallas_path,
         "checkpoints": len(checkpoints),
+        "spans": (span_stats or None),
+        "spans_open_at_end": (len(open_ids) if spans else None),
+        "memory": memory,
+        "anomalies": [{"t_s": (round(a["t"] - t0, 2)
+                               if t0 is not None else None),
+                       "reason": a.get("reason"),
+                       "dump": a.get("dump")} for a in anomalies]
+        or None,
         "metrics": (ends[-1].get("metrics") if ends else None),
     }
     report["run"].pop("t", None)
     report["run"].pop("type", None)
     return report
+
+
+def load_postmortem(run_dir):
+    """The anomaly forensics dump (``<run_dir>/anomaly/anomaly.json``,
+    written by ``utils/flightrec.py``) or None."""
+    path = os.path.join(run_dir, "anomaly", "anomaly.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except ValueError:
+        return {"error": f"unparseable anomaly dump at {path}"}
 
 
 def _human_summary(report, out=sys.stdout):
@@ -236,8 +315,110 @@ def _human_summary(report, out=sys.stdout):
                                    for path, n in sorted(paths.items()))
             for kern, paths in sorted(report["pallas_path"].items()))
         p(f"pallas routes: {routes}")
+    if report.get("spans"):
+        p("spans (host wall per block-level phase):")
+        for name, d in sorted(report["spans"].items(),
+                              key=lambda kv: -kv[1]["total_ms"]):
+            dev = (f" (device tail {d['device_ms']}ms)"
+                   if d.get("device_ms") else "")
+            p(f"  {name:28s} x{d['count']:<5d} {d['total_ms']}ms "
+              f"total, max {d['max_ms']}ms{dev}")
+        if report.get("spans_open_at_end"):
+            p(f"  WARNING: {report['spans_open_at_end']} span(s) "
+              "never closed (crash mid-span or torn stream)")
+    mem = report.get("memory")
+    if mem and mem.get("hbm_peak_bytes") is not None:
+        p(f"device memory: peak {mem['hbm_peak_bytes'] / 2**20:.1f} "
+          f"MiB HBM"
+          + (f", last in-use "
+             f"{mem['hbm_last_in_use_bytes'] / 2**20:.1f} MiB"
+             if mem.get("hbm_last_in_use_bytes") is not None else ""))
     p(f"checkpoints: {report['checkpoints']}, heartbeats: "
       f"{report['events'].get('heartbeat', 0)}")
+    pm = report.get("postmortem")
+    if pm:
+        p("-- POSTMORTEM (anomaly forensics dump) --")
+        p(f"  reason: {pm.get('reason')}")
+        state = pm.get("state") or {}
+        if state:
+            pos = ", ".join(f"{k}={state[k]}" for k in
+                            ("sampler", "step", "iteration", "block_steps")
+                            if k in state)
+            if pos:
+                p(f"  position: {pos}")
+        payload = pm.get("payload") or {}
+        for k in ("n_bad_evals", "n_bad", "bad_walker_idx", "bad_lnl"):
+            if k in payload:
+                p(f"  {k}: {payload[k]}")
+        ring = pm.get("ring_tail") or []
+        p(f"  ring tail: {len(ring)} recent events"
+          + (f", last: {ring[-1].get('type')}" if ring else ""))
+        pal = pm.get("pallas") or {}
+        if pal:
+            routes = "; ".join(
+                f"{kern}: {st.get('last_path') or st.get('reason')}"
+                for kern, st in sorted(
+                    (pal.get("megakernel") or {}).items()))
+            if routes:
+                p(f"  pallas routes at crash: {routes}")
+
+
+def check_stream(path, out=sys.stdout):
+    """``--check``: schema-validate an events.jsonl — unknown event
+    types, torn/malformed records, and span open/close imbalance.
+    Returns the number of problems found (0 = clean) and prints a
+    verdict line per problem class."""
+    events, dropped = load_events(path)
+    problems = 0
+
+    def p(msg):
+        print(msg, file=out)
+
+    if dropped:
+        problems += dropped
+        p(f"CHECK: {dropped} torn/malformed record(s) dropped")
+    unknown = {}
+    for ev in events:
+        t = ev.get("type")
+        if t not in KNOWN_EVENT_TYPES:
+            unknown[t] = unknown.get(t, 0) + 1
+    if unknown:
+        problems += sum(unknown.values())
+        p(f"CHECK: unknown event type(s): "
+          + ", ".join(f"{t} x{n}" for t, n in sorted(unknown.items())))
+    # span open/close pairing: every E must match an open B id; B's
+    # without an E at stream end are unclosed (crash mid-span)
+    open_ids = {}
+    bad_close = 0
+    for ev in events:
+        if ev.get("type") != "span":
+            continue
+        if ev.get("ev") == "B":
+            open_ids[ev.get("id")] = ev.get("name")
+        elif ev.get("ev") == "E":
+            if ev.get("id") in open_ids:
+                open_ids.pop(ev.get("id"))
+            else:
+                bad_close += 1
+        else:
+            problems += 1
+            p(f"CHECK: span event without B/E marker: {ev}")
+    if bad_close:
+        problems += bad_close
+        p(f"CHECK: {bad_close} span close(s) without a matching open")
+    if open_ids:
+        problems += len(open_ids)
+        p(f"CHECK: {len(open_ids)} span(s) opened but never closed: "
+          + ", ".join(sorted(set(str(v) for v in open_ids.values()))))
+    # basic field schema on the events every consumer relies on
+    for ev in events:
+        if "t" not in ev or not isinstance(ev.get("t"), (int, float)):
+            problems += 1
+            p(f"CHECK: event missing/invalid 't': {ev}")
+            break
+    p(f"CHECK: {len(events)} events, "
+      + ("clean" if problems == 0 else f"{problems} problem(s)"))
+    return problems
 
 
 def main(argv=None):
@@ -249,6 +430,10 @@ def main(argv=None):
                          "run_report.json)")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="write the JSON report only, no summary")
+    ap.add_argument("--check", action="store_true",
+                    help="schema-validate the stream (unknown event "
+                         "types, torn records, span imbalance) and "
+                         "exit non-zero on problems; writes no report")
     opts = ap.parse_args(argv)
 
     path = opts.path
@@ -257,11 +442,14 @@ def main(argv=None):
     if not os.path.exists(path):
         print(f"no event stream at {path}", file=sys.stderr)
         return 1
+    if opts.check:
+        return 1 if check_stream(path) else 0
     events, dropped = load_events(path)
     if not events:
         print(f"{path}: no parseable events", file=sys.stderr)
         return 1
     report = build_report(events, dropped)
+    report["postmortem"] = load_postmortem(os.path.dirname(path))
 
     out_path = opts.output or os.path.join(os.path.dirname(path),
                                            "run_report.json")

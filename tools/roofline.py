@@ -35,6 +35,15 @@ backend even while the TPU tunnel is down. ``--dispatch`` updates the
 existing ROOFLINE.json in place (keeps the recorded device timings,
 fixes the phase bookkeeping fields, adds/refreshes ``dispatch``).
 
+Analytic section (``--analytic``, runs on any backend): harvests
+XLA's own ``cost_analysis()`` (flops / bytes accessed) of the compiled
+full-kernel program via ``utils.telemetry.harvest_cost_analysis`` and
+combines it with the measured evals/s into MODEL-vs-measured roofline
+entries (``ROOFLINE.json["analytic"]``) — the compiler's work model
+cross-checks the hand-derived one above, so future perf PRs are
+measured against analytic ceilings instead of wall-clock folklore.
+``--analytic`` updates the existing ROOFLINE.json in place.
+
 Writes ROOFLINE.json at the repo root and a human-readable summary to
 stdout. Run on the device (the measurement chain does); on CPU the
 timing mode still runs but the ceilings are meaningless — the record
@@ -48,8 +57,10 @@ import time
 
 import numpy as np
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import ensure_repo_path                     # noqa: E402
+
+REPO = ensure_repo_path()
 
 import jax                                                  # noqa: E402
 import jax.numpy as jnp                                     # noqa: E402
@@ -58,6 +69,7 @@ from enterprise_warp_tpu.models import build_pulsar_likelihood  # noqa: E402
 from enterprise_warp_tpu.ops.kernel import (  # noqa: E402
     _CHUNK, _mixed_psd_solve_logdet, build_pair_program,
     pair_program_grams, whiten_inputs)
+from enterprise_warp_tpu.utils import profiling, telemetry  # noqa: E402
 
 import __graft_entry__ as g                                 # noqa: E402
 
@@ -73,13 +85,9 @@ HBM_BW = 819e9
 
 
 def timeit(fn, *args):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / REPS
+    # the shared measurement protocol (utils.profiling.timeit): phase
+    # numbers here and in tools/profile_*.py come from one discipline
+    return profiling.timeit(fn, *args, reps=REPS, name="roofline")
 
 
 def phase_bookkeeping(t_full_ms, t_gram_ms, t_solve_ms):
@@ -246,9 +254,83 @@ def main():
     rec.update(phase_bookkeeping(t_full * 1e3, t_gram * 1e3,
                                  t_solve * 1e3))
     rec["dispatch"] = dispatch_section(r_w, M_w, T_w, cs2)
+    rec["analytic"] = analytic_section(like, thetas, t_full)
     with open(os.path.join(REPO, "ROOFLINE.json"), "w") as fh:
         json.dump(rec, fh, indent=1)
     print(json.dumps(rec, indent=1))
+
+
+def analytic_section(like, thetas, t_full_s):
+    """Model-vs-measured roofline entry from XLA's own cost model:
+    harvest ``cost_analysis()`` of the compiled batched eval (flops,
+    bytes accessed — per BATCH call), derive analytic time ceilings
+    against the nominal chip specs, and compare with the measured
+    wall. Backend-independent (the compiler reports its estimate for
+    whatever backend compiled the program); on CPU the ceilings use
+    TPU specs and the record is flagged, but the flops/bytes model
+    itself is still the compiler's, not folklore."""
+    batch_fn = like.loglike_batch
+    jitted = getattr(batch_fn, "_jitted", None)
+    if jitted is None:
+        jitted = (batch_fn if hasattr(batch_fn, "lower")
+                  else jax.jit(batch_fn))
+    # the traced jit takes (thetas) on closure-built likelihoods and
+    # (thetas, consts) on protocol-built ones; harvest_cost_analysis
+    # returns None on a signature mismatch, so probe both
+    ca = telemetry.harvest_cost_analysis(
+        jitted, "roofline_full_kernel", (thetas,), {})
+    if ca is None and getattr(like, "consts", None) is not None:
+        ca = telemetry.harvest_cost_analysis(
+            jitted, "roofline_full_kernel", (thetas, like.consts), {})
+    out = {
+        "method": ("XLA cost_analysis() of the compiled batched eval "
+                   "(per-BATCH-call flops / bytes accessed) vs the "
+                   "measured wall under the shared timeit protocol"),
+        "counted_on": jax.devices()[0].platform,
+        "batch": int(thetas.shape[0]),
+        "measured_ms": round(t_full_s * 1e3, 3),
+        "model": ca,
+    }
+    if not ca or ca.get("flops") is None:
+        out["note"] = "cost_analysis unavailable on this backend"
+        return out
+    flops, by = ca["flops"], ca.get("bytes_accessed")
+    t_flops = flops / PEAK_F32
+    out["flops_ceiling_ms"] = round(t_flops * 1e3, 3)
+    out["achieved_flops_per_s"] = round(flops / t_full_s, 1)
+    out["flops_roofline_fraction"] = round(t_flops / t_full_s, 4)
+    if by is not None:
+        t_bw = by / HBM_BW
+        out["bandwidth_ceiling_ms"] = round(t_bw * 1e3, 3)
+        out["bw_roofline_fraction"] = round(t_bw / t_full_s, 4)
+        roof = max(t_flops, t_bw)
+        out["binding_resource"] = (
+            "flops" if t_flops >= t_bw else "bandwidth")
+        out["model_vs_measured"] = round(roof / t_full_s, 4)
+    return out
+
+
+def analytic_only():
+    """``--analytic``: refresh the model-vs-measured section of the
+    EXISTING ROOFLINE.json (measuring the full kernel only — cheap
+    enough to run per PR on any backend) without touching the recorded
+    phase timings."""
+    path = os.path.join(REPO, "ROOFLINE.json")
+    rec = {}
+    if os.path.exists(path):
+        with open(path) as fh:
+            rec = json.load(fh)
+
+    psr, terms = g._flagship_single_pulsar()
+    like = build_pulsar_likelihood(psr, terms)
+    rng = np.random.default_rng(1)
+    thetas = jnp.asarray(like.sample_prior(rng, BATCH))
+    t_full = timeit(like.loglike_batch, thetas)
+    rec["analytic"] = analytic_section(like, thetas, t_full)
+    rec["analytic"]["counted_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(path, "w") as fh:
+        json.dump(rec, fh, indent=1)
+    print(json.dumps(rec["analytic"], indent=1))
 
 
 def dispatch_only():
@@ -291,5 +373,7 @@ def dispatch_only():
 if __name__ == "__main__":
     if "--dispatch" in sys.argv:
         dispatch_only()
+    elif "--analytic" in sys.argv:
+        analytic_only()
     else:
         main()
