@@ -1,0 +1,34 @@
+"""``ewt-lint`` — the tracer-safety static-analysis engine.
+
+An AST rule engine enforcing the contracts the samplers live by:
+device-ownership of donated buffers, single-use RNG keys, host-sync
+discipline on the hot path, purity of traced function bodies, and the
+kernel precision contract — plus the four textual bans (``print``,
+bare ``jax.jit``, raw ``pallas_call``, raw timing) that previously
+lived as per-test grep loops.
+
+Pure stdlib: importing this package never imports jax, so the linter
+runs in any environment (CI, a box with a dead accelerator tunnel);
+a full-package run takes a few seconds.
+
+Entry points:
+
+- :func:`run_lint` — library API (the tier-1 test and ``tools/lint.py``
+  both call it).
+- ``python tools/lint.py`` — the CLI (``--json``, ``--rule``,
+  non-zero exit on findings).
+
+Suppressions are inline comments — ``# ewt: allow-<rule> — <reason>``
+— and the reason is mandatory: a suppression without one is itself a
+finding. See ``docs/static-analysis.md`` for the rule catalog.
+"""
+
+from .core import (Finding, LintResult, Rule, all_rules, iter_target_files,
+                   run_lint)
+
+# importing the rule modules populates the registry
+from . import rules_style as _rules_style          # noqa: F401,E402
+from . import rules_tracer as _rules_tracer        # noqa: F401,E402
+
+__all__ = ["Finding", "LintResult", "Rule", "all_rules",
+           "iter_target_files", "run_lint"]

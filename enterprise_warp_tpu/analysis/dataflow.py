@@ -1,0 +1,433 @@
+"""Shared semantic indexes the JAX rules build on: import-alias
+resolution, traced-region detection, and a deliberately simple
+per-function dataflow (reaching definitions + parameter taint).
+
+Everything here is best-effort intra-module analysis: the rules are
+written so that *unresolvable* constructs stay silent (no finding)
+while the idioms this codebase actually uses — ``telemetry.traced``
+factories, ``jax.lax.scan`` step functions, ``key, k = jax.random.
+split(key)`` — resolve exactly.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+
+
+# ------------------------------------------------------------------ #
+#  import aliases                                                    #
+# ------------------------------------------------------------------ #
+
+
+class Aliases:
+    """Maps local names to dotted module/function paths.
+
+    ``import jax.numpy as jnp`` -> ``jnp: jax.numpy``;
+    ``from jax import random`` -> ``random: jax.random``;
+    ``from ..utils import telemetry`` -> ``telemetry: utils.telemetry``
+    (relative imports keep only the suffix — callers match with
+    :meth:`resolves`, which is suffix-aware).
+    """
+
+    def __init__(self, tree):
+        self.map = {}
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.map[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+                    if a.asname is None and "." in a.name:
+                        # ``import jax.numpy`` binds ``jax`` but the
+                        # full path is reachable as written
+                        self.map.setdefault(a.name.split(".")[0],
+                                            a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.map[a.asname or a.name] = \
+                        f"{base}.{a.name}" if base else a.name
+
+    def dotted(self, node):
+        """The dotted path of a Name/Attribute chain with the root
+        alias substituted, e.g. ``jr.split`` -> ``jax.random.split``,
+        ``self._block`` -> ``self._block``. None when the chain roots
+        in a call/subscript."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.map.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+    def resolves(self, node, *paths, suffixes=()):
+        """True when ``node``'s dotted path equals one of ``paths`` or
+        ends with one of ``suffixes`` (suffix matching handles
+        relative imports: ``utils.telemetry.traced`` matches suffix
+        ``telemetry.traced``)."""
+        d = self.dotted(node)
+        if d is None:
+            return False
+        if d in paths:
+            return True
+        return any(d == s or d.endswith("." + s) for s in suffixes)
+
+
+# ------------------------------------------------------------------ #
+#  traced-region detection                                           #
+# ------------------------------------------------------------------ #
+
+#: callables that turn a python function into a traced/staged one —
+#: their function-valued arguments execute under a jax trace.
+_TRACE_ENTRY_SUFFIXES = (
+    "jax.jit", "telemetry.traced", "jax.vmap", "jax.pmap",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.checkpoint", "jax.remat",
+    "jax.grad", "jax.value_and_grad", "jax.custom_vjp",
+    "jax.custom_jvp", "jax.custom_batching.custom_vmap",
+    "jax.linearize", "jax.jvp", "jax.vjp",
+)
+_TRACE_ENTRY_BARE = ("jit", "traced", "vmap", "pmap", "scan",
+                     "while_loop", "fori_loop", "custom_vmap")
+
+
+def _is_trace_entry(aliases, func):
+    d = aliases.dotted(func)
+    if d is None:
+        return False
+    if d in _TRACE_ENTRY_BARE:
+        return True
+    return any(d == s or d.endswith("." + s)
+               for s in _TRACE_ENTRY_SUFFIXES)
+
+
+class TracedIndex:
+    """Which function bodies execute under a jax trace.
+
+    A function is traced when it (a) is decorated with a trace entry
+    (``@traced``, ``@jax.jit``, ``@partial(jax.jit, ...)``), (b) is
+    passed by name into a trace-entry call (``jax.lax.scan(one_step,
+    ...)``, ``telemetry.traced(block, ...)``), (c) is lexically nested
+    inside a traced function, or (d) is a local function *called from*
+    a traced body (it inlines into the trace) — iterated to a
+    fixpoint.
+    """
+
+    def __init__(self, tree, aliases, parents=None):
+        self.aliases = aliases
+        self.funcs = []           # all FunctionDef/Lambda nodes
+        self.traced = set()       # id(node) of traced functions
+        self.direct = set()       # subset wrapped BY NAME/decorator:
+        #                           their parameters provably receive
+        #                           tracers (scan carries, jit args);
+        #                           call-propagated functions may take
+        #                           static config params instead
+        self._nodes_by_id = {}
+        if tree is None:
+            self.ranges = []
+            return
+        by_name = {}
+        if parents is None:
+            parents = {}
+            for parent in ast.walk(tree):
+                for child in ast.iter_child_nodes(parent):
+                    parents[id(child)] = parent
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                self.funcs.append(node)
+                self._nodes_by_id[id(node)] = node
+                if not isinstance(node, ast.Lambda):
+                    by_name.setdefault(node.name, []).append(node)
+
+        # (a) decorators
+        for node in self.funcs:
+            for dec in getattr(node, "decorator_list", []):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _is_trace_entry(aliases, target):
+                    self.traced.add(id(node))
+                    self.direct.add(id(node))
+                elif (isinstance(dec, ast.Call)
+                      and aliases.resolves(dec.func, "functools.partial",
+                                           suffixes=("partial",))
+                      and dec.args
+                      and _is_trace_entry(aliases, dec.args[0])):
+                    self.traced.add(id(node))
+                    self.direct.add(id(node))
+
+        # (b) passed into a trace-entry call (by name, or a lambda /
+        # nested call argument)
+        for call in ast.walk(tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if not _is_trace_entry(aliases, call.func):
+                continue
+            cand = list(call.args)
+            # jax.lax.switch takes a LIST of branch callables
+            cand.extend(itertools.chain.from_iterable(
+                a.elts for a in call.args if isinstance(a, ast.List)))
+            for arg in cand:
+                if isinstance(arg, ast.Name):
+                    for fn in by_name.get(arg.id, []):
+                        self.traced.add(id(fn))
+                        self.direct.add(id(fn))
+                elif isinstance(arg, ast.Lambda):
+                    self.traced.add(id(arg))
+                    self.direct.add(id(arg))
+                elif isinstance(arg, ast.Call):
+                    # e.g. traced(jax.vmap(eval_fn)) — the inner
+                    # name is traced too
+                    for inner in ast.walk(arg):
+                        if isinstance(inner, ast.Name):
+                            for fn in by_name.get(inner.id, []):
+                                self.traced.add(id(fn))
+                                self.direct.add(id(fn))
+
+        # (c) lexical nesting + (d) called-from-traced, to fixpoint
+        def enclosing_func(node):
+            p = parents.get(id(node))
+            while p is not None and not isinstance(
+                    p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.Lambda)):
+                p = parents.get(id(p))
+            return p
+
+        callee_names = {}       # id(fn) -> {called-by-Name names}
+        for fn in self.funcs:
+            names = set()
+            for call in ast.walk(fn):
+                if isinstance(call, ast.Call) and \
+                        isinstance(call.func, ast.Name):
+                    names.add(call.func.id)
+            callee_names[id(fn)] = names
+
+        changed = True
+        while changed:
+            changed = False
+            for node in self.funcs:
+                if id(node) in self.traced:
+                    continue
+                enc = enclosing_func(node)
+                if enc is not None and id(enc) in self.traced:
+                    self.traced.add(id(node))
+                    changed = True
+            for tid in list(self.traced):
+                for name in callee_names[tid]:
+                    for cand in by_name.get(name, []):
+                        if id(cand) not in self.traced:
+                            self.traced.add(id(cand))
+                            changed = True
+
+        self.ranges = sorted(
+            ((n.lineno, n.end_lineno or n.lineno, n)
+             for n in self.funcs if id(n) in self.traced),
+            key=lambda t: t[:2])
+
+    def is_traced(self, node):
+        return id(node) in self.traced
+
+    def is_direct(self, node):
+        return id(node) in self.direct
+
+    def traced_funcs(self):
+        return [self._nodes_by_id[i] for i in self.traced]
+
+    def line_in_traced(self, line):
+        return any(lo <= line <= hi for lo, hi, _ in self.ranges)
+
+
+# ------------------------------------------------------------------ #
+#  per-function helpers                                              #
+# ------------------------------------------------------------------ #
+
+
+def param_names(fn):
+    a = fn.args
+    names = [p.arg for p in itertools.chain(
+        a.posonlyargs, a.args, a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def local_names(fn):
+    """Every name the function binds: params plus any Store target
+    (needed to tell closure mutation from local mutation)."""
+    names = set(param_names(fn))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            names.add(node.name)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            names -= set(node.names)
+    return names
+
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "weak_type"}
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
+
+
+def _static_ids(expr):
+    """ids() of Name nodes inside ``expr`` whose use is static at
+    trace time — under ``x.shape``/``x.ndim``/``x.dtype``, inside
+    ``len(x)``/``isinstance(x, ...)``, or compared against a string
+    constant (a mode selector can never be a tracer)."""
+    static = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _STATIC_ATTRS:
+            for n in ast.walk(node.value):
+                static.add(id(n))
+        elif isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) \
+                else node.func.attr if isinstance(node.func,
+                                                  ast.Attribute) \
+                else None
+            if fname in _STATIC_CALLS:
+                for a in node.args:
+                    for n in ast.walk(a):
+                        static.add(id(n))
+        elif isinstance(node, ast.Compare):
+            comparators = [node.left] + list(node.comparators)
+            if any(isinstance(c, ast.Constant)
+                   and isinstance(c.value, str)
+                   for c in comparators):
+                for c in comparators:
+                    for n in ast.walk(c):
+                        static.add(id(n))
+            # identity tests are static at trace time (a tracer is
+            # never None), and membership in a tuple/list of string
+            # constants is mode selection, not tracer arithmetic
+            elif all(isinstance(op, (ast.Is, ast.IsNot))
+                     for op in node.ops):
+                for c in comparators:
+                    for n in ast.walk(c):
+                        static.add(id(n))
+            elif all(isinstance(op, (ast.In, ast.NotIn))
+                     for op in node.ops) and all(
+                    isinstance(c, (ast.Tuple, ast.List, ast.Set))
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in c.elts)
+                    for c in node.comparators):
+                for c in comparators:
+                    for n in ast.walk(c):
+                        static.add(id(n))
+    return static
+
+
+def tainted_uses(expr, taint):
+    """Tainted Name nodes inside ``expr``, excluding static-at-trace
+    uses (see :func:`_static_ids`)."""
+    static = _static_ids(expr)
+    return [n for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and n.id in taint
+            and id(n) not in static]
+
+
+def tainted_names(fn, seed=None, include_params=True):
+    """Names (transitively) derived from the function's parameters —
+    under a trace these hold tracers. A linear walk with the loop
+    bodies visited twice (cheap cross-iteration propagation).
+    ``include_params=False`` seeds only from ``seed`` (for call-
+    propagated traced functions whose params may be static config).
+    Values reached only through ``.shape``/``len()`` do not taint."""
+    taint = set(seed or ())
+    if include_params:
+        taint |= param_names(fn)
+
+    def expr_tainted(expr):
+        return bool(tainted_uses(expr, taint))
+
+    def visit(stmts):
+        for st in stmts:
+            if isinstance(st, (ast.Assign, ast.AugAssign,
+                               ast.AnnAssign)):
+                value = st.value
+                if value is not None and expr_tainted(value):
+                    targets = st.targets if isinstance(st, ast.Assign) \
+                        else [st.target]
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                taint.add(n.id)
+            elif isinstance(st, (ast.For, ast.While)):
+                if isinstance(st, ast.For) and \
+                        expr_tainted(st.iter):
+                    for n in ast.walk(st.target):
+                        if isinstance(n, ast.Name):
+                            taint.add(n.id)
+                visit(st.body)
+                visit(st.body)      # second pass: loop-carried taint
+                visit(st.orelse)
+            elif isinstance(st, ast.If):
+                visit(st.body)
+                visit(st.orelse)
+            elif isinstance(st, ast.With):
+                visit(st.body)
+            elif isinstance(st, ast.Try):
+                visit(st.body)
+                for h in st.handlers:
+                    visit(h.body)
+                visit(st.orelse)
+                visit(st.finalbody)
+    if isinstance(fn.body, list):       # Lambda bodies are a bare expr
+        visit(fn.body)
+    return taint
+
+
+def tainted_in_test(test, taint):
+    """Tainted Name nodes inside a branch test, *excluding* uses that
+    are static at trace time: ``x.shape``/``x.ndim``/``x.dtype``,
+    ``len(x)``, ``isinstance(x, ...)``, string-constant comparisons —
+    branching on those is shape/config programming, not a tracer
+    boolean."""
+    return tainted_uses(test, taint)
+
+
+def assignments_in(fn_or_body):
+    """Linear (lineno-ordered) list of ``(target_dotted, value_node,
+    lineno)`` for simple assignments — the reaching-definition table
+    the donation rule uses. Attribute targets keep their dotted path
+    (``st.x``)."""
+    body = fn_or_body.body if hasattr(fn_or_body, "body") \
+        else fn_or_body
+    out = []
+    for node in ast.walk(ast.Module(body=list(body),
+                                    type_ignores=[])):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                d = _target_dotted(t)
+                if d is not None:
+                    out.append((d, node.value, node.lineno))
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        dd = _target_dotted(el)
+                        if dd is not None:
+                            out.append((dd, None, node.lineno))
+    out.sort(key=lambda x: x[2])
+    return out
+
+
+def _target_dotted(t):
+    parts = []
+    while isinstance(t, ast.Attribute):
+        parts.append(t.attr)
+        t = t.value
+    if isinstance(t, ast.Name):
+        parts.append(t.id)
+        return ".".join(reversed(parts))
+    return None

@@ -1,0 +1,166 @@
+"""The four migrated textual bans, re-grounded in the AST.
+
+These started life as per-test grep loops (PRs 2-5). As AST rules
+they no longer fire on comments/docstrings, they see through import
+aliases (``from jax import jit``), and they share the engine's
+suppression/audit machinery with the tracer rules.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PKG_NAME, Rule, register
+
+
+def _calls(mod):
+    return mod.calls
+
+
+def _decorators(mod):
+    """``(decorator_node, target_expr)`` for every decorator:
+    ``target_expr`` is the callable being applied — the decorator
+    itself for ``@jax.jit``, the first ``partial`` argument for
+    ``@partial(jax.jit, ...)``. Call-form decorators
+    (``@jax.jit(static_argnums=...)``) are omitted: they already
+    surface through :func:`_calls`."""
+    if mod.tree is None:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if isinstance(dec, (ast.Name, ast.Attribute)):
+                yield dec, dec
+            elif isinstance(dec, ast.Call) and mod.aliases.resolves(
+                    dec.func, "functools.partial",
+                    suffixes=("partial",)) and dec.args:
+                yield dec, dec.args[0]
+
+
+@register
+class NoPrintRule(Rule):
+    name = "no-print"
+    severity = "error"
+    summary = "print() in library code — log or emit telemetry"
+    contract = (
+        "Library output goes through utils.logging.get_logger or the "
+        "telemetry event stream; only the user-facing CLI layers "
+        "(cli.py, results/__main__.py, the tools/ scripts, bench.py, "
+        "__graft_entry__.py) own stdout.")
+
+    ALLOWED = (f"{PKG_NAME}/cli.py", f"{PKG_NAME}/results/__main__.py",
+               "tools/", "bench.py", "__graft_entry__.py")
+
+    def check(self, mod):
+        if mod.rel.startswith(self.ALLOWED):
+            return
+        for call in _calls(mod):
+            if isinstance(call.func, ast.Name) and \
+                    call.func.id == "print":
+                yield self.finding(
+                    mod, call,
+                    "print() in library code — use "
+                    "utils.logging.get_logger or a telemetry event")
+
+
+@register
+class NoBareJitRule(Rule):
+    name = "no-bare-jit"
+    severity = "error"
+    summary = "bare jax.jit — use telemetry.traced so retraces are " \
+              "counted"
+    contract = (
+        "Every hot jit goes through utils.telemetry.traced() so its "
+        "compiles/retraces land in the retraces{fn=} counter and the "
+        "compile event stream — a silent retrace is a multi-second "
+        "stall the event stream exists to expose. The standalone "
+        "harnesses (tools/, bench.py, __graft_entry__.py) are exempt: "
+        "several deliberately jit the classic path to count its "
+        "dispatches without the traced() wrapper in the jaxpr.")
+
+    ALLOWED = (f"{PKG_NAME}/utils/telemetry.py", "tools/", "bench.py",
+               "__graft_entry__.py")
+
+    def check(self, mod):
+        if mod.rel.startswith(self.ALLOWED):
+            return
+        for call in _calls(mod):
+            if mod.aliases.resolves(call.func, "jax.jit"):
+                yield self.finding(
+                    mod, call,
+                    "bare jax.jit() — wrap with telemetry.traced() so "
+                    "compiles/retraces are counted")
+        # decorator forms: @jax.jit and @partial(jax.jit, ...)
+        for dec, target in _decorators(mod):
+            if mod.aliases.resolves(target, "jax.jit"):
+                yield self.finding(
+                    mod, dec,
+                    "bare @jax.jit decorator — wrap with "
+                    "telemetry.traced() so compiles/retraces are "
+                    "counted")
+
+
+@register
+class NoRawPallasCallRule(Rule):
+    name = "no-raw-pallas-call"
+    severity = "error"
+    summary = "raw pallas_call outside ops/ — kernels live behind " \
+              "the probe/fallback dispatch ladder"
+    contract = (
+        "Every Pallas kernel lives behind the ops/ probe ladder "
+        "(compile-and-run probe per tile class, custom_vmap routing, "
+        "EWT_PALLAS master hatch, pallas_path telemetry). A raw call "
+        "site elsewhere puts an unprobed Mosaic compile inside a hot "
+        "jit, exactly where its failure cannot be caught.")
+
+    ALLOWED = (f"{PKG_NAME}/ops/",)
+
+    def check(self, mod):
+        if mod.rel.startswith(self.ALLOWED):
+            return
+        for call in _calls(mod):
+            if mod.aliases.resolves(
+                    call.func, suffixes=("pallas.pallas_call",
+                                         "pl.pallas_call")) or (
+                    isinstance(call.func, (ast.Name, ast.Attribute))
+                    and (getattr(call.func, "id", None) == "pallas_call"
+                         or getattr(call.func, "attr", None)
+                         == "pallas_call")):
+                yield self.finding(
+                    mod, call,
+                    "raw pallas_call() outside ops/ — route through "
+                    "the ops/ probe/fallback dispatch ladder")
+
+
+@register
+class NoRawTimingRule(Rule):
+    name = "no-raw-timing"
+    severity = "error"
+    summary = "raw time.perf_counter()/time.time() — use the " \
+              "profiling clocks"
+    contract = (
+        "Ad-hoc timing is invisible to the span histograms and the "
+        "Chrome-trace export; everything outside utils/telemetry.py "
+        "and utils/profiling.py routes through profiling.monotonic/"
+        "walltime/span/timeit. The standalone measurement harnesses "
+        "(tools/, bench.py, __graft_entry__.py) are exempt — their "
+        "timing IS their output, measured by their own committed "
+        "protocols.")
+
+    ALLOWED = (f"{PKG_NAME}/utils/telemetry.py",
+               f"{PKG_NAME}/utils/profiling.py",
+               "tools/", "bench.py", "__graft_entry__.py")
+    _BANNED = ("time.perf_counter", "time.time", "time.perf_counter_ns",
+               "time.monotonic", "time.monotonic_ns")
+
+    def check(self, mod):
+        if mod.rel.startswith(self.ALLOWED):
+            return
+        for call in _calls(mod):
+            if mod.aliases.resolves(call.func, *self._BANNED):
+                yield self.finding(
+                    mod, call,
+                    f"raw {mod.aliases.dotted(call.func)}() — use "
+                    "utils.profiling.monotonic/walltime/span/timeit so "
+                    "timing feeds the span histograms and trace export")

@@ -1,0 +1,843 @@
+"""The JAX tracer-safety rules: donation ownership, RNG key reuse,
+host-sync discipline, trace purity, and the kernel precision
+contract.
+
+These are the rules token greps can never express — the PR 3
+heap-corruption bug (a zero-copy numpy import donated into the block
+jit) is invisible to grep because ``np.asarray`` and
+``donate_argnums`` sit in different functions. The engine stitches
+them together with module-level dataflow (``analysis.dataflow``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PKG_NAME, Rule, register
+from . import dataflow
+
+
+def _enclosing_func(parents, node):
+    p = parents.get(id(node))
+    while p is not None and not isinstance(
+            p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        p = parents.get(id(p))
+    return p
+
+
+def _enclosing_stmt(parents, node):
+    """The statement that contains ``node`` (for 'after the call'
+    line arithmetic)."""
+    prev = node
+    p = parents.get(id(node))
+    while p is not None and not isinstance(p, ast.stmt):
+        prev, p = p, parents.get(id(p))
+    return p if isinstance(p, ast.stmt) else prev
+
+
+# ------------------------------------------------------------------ #
+#  donation-safety                                                   #
+# ------------------------------------------------------------------ #
+
+#: numpy constructors that may return views of memory the numpy
+#: allocator (or a file mapping) owns — donating such a buffer lets
+#: XLA overwrite and free memory it does not own: heap corruption.
+_ZERO_COPY = ("numpy.asarray", "numpy.ascontiguousarray",
+              "numpy.asfortranarray", "numpy.frombuffer",
+              "numpy.memmap", "numpy.load", "numpy.atleast_1d",
+              "numpy.atleast_2d")
+_JIT_SUFFIXES = ("telemetry.traced", "jax.jit")
+_JIT_BARE = ("traced", "jit")
+
+
+def _is_jit_ctor(aliases, func):
+    d = aliases.dotted(func)
+    if d is None:
+        return False
+    return d in _JIT_BARE or any(
+        d == s or d.endswith("." + s) for s in _JIT_SUFFIXES)
+
+
+def _jit_ctor_call(aliases, call):
+    """True when ``call`` constructs a jit'd callable — directly
+    (``traced(f, ...)`` / ``jax.jit(f, ...)``) or through
+    ``functools.partial(jax.jit, ...)`` (the decorator idiom)."""
+    if _is_jit_ctor(aliases, call.func):
+        return True
+    return (aliases.resolves(call.func, "functools.partial",
+                             suffixes=("partial",))
+            and bool(call.args)
+            and _is_jit_ctor(aliases, call.args[0]))
+
+
+@register
+class DonationSafetyRule(Rule):
+    name = "donation-safety"
+    severity = "error"
+    summary = "zero-copy host buffer donated, or donated buffer " \
+              "read after donation"
+    contract = (
+        "An argument at a donate_argnums position must be an XLA-"
+        "owned copy (jnp.array / devicestate.place_resident): a "
+        "donated zero-copy numpy import (np.asarray, np.load, "
+        "np.frombuffer...) is heap corruption — XLA overwrites and "
+        "frees memory the numpy allocator owns (the PR 3 malloc-"
+        "metadata crash). A donated binding is dead after the call: "
+        "its buffer now aliases the output.")
+
+    def check(self, mod):
+        tree, al = mod.tree, mod.aliases
+        parents = mod.parents
+
+        donated = {}      # dotted binding -> set(positions)
+        factories = {}    # function name -> set(positions)
+        jit_calls = {}    # id(call) -> set(positions)
+        defs_cache = {}   # id(scope node) -> assignments_in result
+
+        def defs_for(scope):
+            key = id(scope)
+            if key not in defs_cache:
+                defs_cache[key] = dataflow.assignments_in(scope)
+            return defs_cache[key]
+
+        def resolve_positions(expr, fn):
+            if isinstance(expr, ast.Constant) and \
+                    isinstance(expr.value, int):
+                return {expr.value}
+            if isinstance(expr, (ast.Tuple, ast.List)):
+                out = set()
+                for e in expr.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, int):
+                        out.add(e.value)
+                    else:
+                        return None
+                return out
+            if isinstance(expr, ast.IfExp):
+                a = resolve_positions(expr.body, fn)
+                b = resolve_positions(expr.orelse, fn)
+                if a is None or b is None:
+                    return None
+                return a | b
+            if isinstance(expr, ast.Name) and fn is not None:
+                out = None
+                for tgt, val, _line in defs_for(fn):
+                    if tgt == expr.id and val is not None:
+                        r = resolve_positions(val, fn)
+                        out = r if r is not None else out
+                return out
+            return None
+
+        # pass 1: traced()/jax.jit()/partial(jax.jit, ...) ctors
+        # carrying donate_argnums
+        for call in mod.calls:
+            if not _jit_ctor_call(al, call):
+                continue
+            kw = next((k for k in call.keywords
+                       if k.arg == "donate_argnums"), None)
+            if kw is None:
+                continue
+            fn = _enclosing_func(parents, call)
+            pos = resolve_positions(kw.value, fn)
+            if not pos:
+                continue        # unresolvable or empty: nothing provable
+            jit_calls[id(call)] = pos
+            # decorator form: the donated callable IS the decorated
+            # function — its call sites donate by the function's name
+            parent = parents.get(id(call))
+            if isinstance(parent, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) and \
+                    call in parent.decorator_list:
+                donated[parent.name] = donated.get(parent.name,
+                                                   set()) | pos
+                continue
+            stmt = _enclosing_stmt(parents, call)
+            if isinstance(stmt, ast.Return) and isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                factories[fn.name] = factories.get(fn.name,
+                                                   set()) | pos
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    d = dataflow._target_dotted(t)
+                    if d is not None:
+                        donated[d] = donated.get(d, set()) | pos
+
+        # pass 2: bindings produced by a factory
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                f = node.value.func
+                last = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else None
+                if last in factories:
+                    for t in node.targets:
+                        d = dataflow._target_dotted(t)
+                        if d is not None:
+                            donated[d] = donated.get(d, set()) \
+                                | factories[last]
+
+        # pass 3: call sites of donated callables
+        for call in mod.calls:
+            pos = None
+            d = al.dotted(call.func)
+            if d is not None and d in donated:
+                pos = donated[d]
+            elif isinstance(call.func, ast.Call) and \
+                    id(call.func) in jit_calls:
+                pos = jit_calls[id(call.func)]   # traced(f, ...)(args)
+            if pos is None:
+                continue
+            fn = _enclosing_func(parents, call)
+            defs = defs_for(fn if fn is not None else tree)
+            yield from self._check_site(mod, call, pos, defs, parents)
+
+    def _zero_copy_call(self, al, expr):
+        if not isinstance(expr, ast.Call):
+            return False
+        if al.resolves(expr.func, *_ZERO_COPY,
+                       suffixes=("np.asarray",)):
+            return True
+        if al.resolves(expr.func, "numpy.array"):
+            for k in expr.keywords:
+                if k.arg == "copy" and isinstance(k.value,
+                                                  ast.Constant) \
+                        and k.value.value is False:
+                    return True
+        if isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr == "view":
+            return True
+        return False
+
+    def _check_site(self, mod, call, positions, defs, parents):
+        al = mod.aliases
+        stmt = _enclosing_stmt(parents, call)
+        after = (stmt.end_lineno or stmt.lineno) if stmt is not None \
+            else call.lineno
+        fn = _enclosing_func(parents, call)
+        for p in sorted(positions):
+            if p >= len(call.args):
+                continue
+            arg = call.args[p]
+            # (1) provably zero-copy host source at a donated position
+            bad = None
+            if self._zero_copy_call(al, arg):
+                bad = (arg.lineno, al.dotted(arg.func)
+                       or getattr(arg.func, "attr", "view"))
+            else:
+                dotted = dataflow._target_dotted(arg) if isinstance(
+                    arg, (ast.Name, ast.Attribute)) else None
+                if dotted is not None:
+                    reach = None
+                    for tgt, val, line in defs:
+                        if tgt == dotted and line <= call.lineno:
+                            reach = val
+                    if reach is not None and \
+                            self._zero_copy_call(al, reach):
+                        bad = (reach.lineno, al.dotted(reach.func)
+                               or getattr(reach.func, "attr", "view"))
+            if bad is not None:
+                src_line, src = bad
+                yield self.finding(
+                    mod, arg,
+                    f"zero-copy host buffer ({src}, line {src_line}) "
+                    f"flows into donated position {p} — donate only "
+                    "XLA-owned copies (jnp.array / "
+                    "devicestate.place_resident); XLA freeing numpy-"
+                    "owned memory is heap corruption")
+            # (2) use of the donated binding after the call
+            dotted = dataflow._target_dotted(arg) if isinstance(
+                arg, (ast.Name, ast.Attribute)) else None
+            if dotted is None or fn is None:
+                continue
+            # the canonical idiom rebinds the donated names from the
+            # call's own outputs (``u, lnl, key = iteration(u, lnl,
+            # key)``) — that IS the discipline, not a violation
+            if isinstance(stmt, ast.Assign) and any(
+                    dataflow._target_dotted(n) == dotted
+                    for t in stmt.targets for n in ast.walk(t)
+                    if isinstance(n, (ast.Name, ast.Attribute))):
+                continue
+            rebind = min((line for tgt, _v, line in defs
+                          if tgt == dotted and line > after),
+                         default=None)
+            # match both Name loads (``x``) and attribute-rooted
+            # loads (``st.x`` — how PTSampler actually holds the
+            # ensemble state) against the donated dotted path
+            for node in ast.walk(fn):
+                if not (isinstance(node, (ast.Name, ast.Attribute))
+                        and isinstance(node.ctx, ast.Load)):
+                    continue
+                nd = dataflow._target_dotted(node)
+                if nd != dotted:
+                    continue
+                if node.lineno > after and (rebind is None
+                                            or node.lineno < rebind):
+                    yield self.finding(
+                        mod, node,
+                        f"{dotted!r} was donated at line "
+                        f"{call.lineno} and read here — a donated "
+                        "buffer is dead after the call (its memory "
+                        "aliases the output)")
+                    break
+
+
+# ------------------------------------------------------------------ #
+#  rng-key-reuse                                                     #
+# ------------------------------------------------------------------ #
+
+_KEY_PRODUCERS = ("PRNGKey", "key", "split", "fold_in",
+                  "wrap_key_data", "clone")
+
+
+@register
+class RngKeyReuseRule(Rule):
+    name = "rng-key-reuse"
+    severity = "error"
+    summary = "PRNG key consumed twice without split/fold_in"
+    contract = (
+        "A jax.random key is single-use: every consumption (any "
+        "jax.random.* call, or passing the key on to another "
+        "function) must be followed by a rebind from split/fold_in "
+        "before the next one — reusing a spent key silently "
+        "correlates draws that must be independent.")
+
+    def check(self, mod):
+        seen = set()
+        for f in self._check_all(mod):
+            key = (f.line, f.col)
+            if key not in seen:
+                seen.add(key)
+                yield f
+
+    def _check_all(self, mod):
+        tree = mod.tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                yield from self._exec_block(mod, list(node.body), {})
+        # module-level statements too
+        yield from self._exec_block(
+            mod, [s for s in tree.body
+                  if not isinstance(s, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.ClassDef))], {})
+
+    def _consumptions(self, mod, stmt, state):
+        """(name, node, via) for every key consumption inside one
+        statement, in source order."""
+        al = mod.aliases
+        out = []
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call):
+                continue
+            if isinstance(call.func, (ast.FunctionDef,)):
+                continue
+            d = al.dotted(call.func)
+            if d is not None and d.startswith("jax.random."):
+                tail = d.rsplit(".", 1)[-1]
+                key_arg = None
+                if call.args:
+                    key_arg = call.args[0]
+                for k in call.keywords:
+                    if k.arg == "key":
+                        key_arg = k.value
+                # fold_in DERIVES a child key — folding distinct data
+                # off one parent is the documented stream-derivation
+                # idiom, not a reuse
+                if isinstance(key_arg, ast.Name) and \
+                        tail not in ("PRNGKey", "key",
+                                     "wrap_key_data", "fold_in"):
+                    out.append((key_arg.id, key_arg, d))
+            else:
+                # passing a tracked key into any other callable
+                # consumes it (the callee draws from it)
+                for a in call.args:
+                    if isinstance(a, ast.Name) and a.id in state:
+                        out.append((a.id, a, d or "call"))
+        out.sort(key=lambda t: (t[1].lineno, t[1].col_offset))
+        return out
+
+    def _producer_assign(self, mod, stmt):
+        """Names freshly bound from a key-producing call in this
+        statement. Only the OUTERMOST value expression counts:
+        ``x = normal(fold_in(key, 1), ...)`` binds samples, not a key,
+        even though a producer call appears nested inside."""
+        al = mod.aliases
+        fresh = set()
+        if isinstance(stmt, ast.Assign) and \
+                isinstance(stmt.value, ast.Call):
+            d = al.dotted(stmt.value.func)
+            if d is not None and d.startswith("jax.random.") \
+                    and d.rsplit(".", 1)[-1] in _KEY_PRODUCERS:
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            fresh.add(n.id)
+        return fresh
+
+    def _exec_block(self, mod, stmts, state):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue        # nested defs analyzed separately
+            if isinstance(stmt, ast.If):
+                s1, s2 = dict(state), dict(state)
+                yield from self._visit_expr(mod, stmt.test, state)
+                s1.update(state)
+                s2.update(state)
+                yield from self._exec_block(mod, stmt.body, s1)
+                yield from self._exec_block(mod, stmt.orelse, s2)
+                for k in set(s1) | set(s2):
+                    if s1.get(k) == "spent" or s2.get(k) == "spent":
+                        state[k] = "spent"
+                    elif k in s1 or k in s2:
+                        state[k] = s1.get(k, s2.get(k))
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                header = stmt.iter if isinstance(stmt, ast.For) \
+                    else stmt.test
+                yield from self._visit_expr(mod, header, state)
+                if isinstance(stmt, ast.For):
+                    for n in ast.walk(stmt.target):
+                        if isinstance(n, ast.Name) and n.id in state:
+                            state[n.id] = "fresh"
+                # two passes over the body: catches a key consumed on
+                # iteration i and not rebound before iteration i+1
+                inner = [s for s in stmt.body]
+                yield from self._exec_block(mod, inner, state)
+                for f in self._exec_block(mod, inner, state):
+                    f.message += " (reuse across loop iterations)"
+                    yield f
+                yield from self._exec_block(mod, stmt.orelse, state)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    yield from self._visit_expr(mod,
+                                                item.context_expr,
+                                                state)
+                yield from self._exec_block(mod, stmt.body, state)
+                continue
+            if isinstance(stmt, ast.Try):
+                yield from self._exec_block(mod, stmt.body, state)
+                for h in stmt.handlers:
+                    yield from self._exec_block(mod, h.body, state)
+                yield from self._exec_block(mod, stmt.orelse, state)
+                yield from self._exec_block(mod, stmt.finalbody, state)
+                continue
+            yield from self._visit_stmt_leaf(mod, stmt, state)
+
+    def _visit_expr(self, mod, expr, state):
+        wrapper = ast.Expr(value=expr)
+        ast.copy_location(wrapper, expr)
+        yield from self._visit_stmt_leaf(mod, wrapper, state)
+
+    def _visit_stmt_leaf(self, mod, stmt, state):
+        for name, node, via in self._consumptions(mod, stmt, state):
+            if state.get(name) == "spent":
+                yield self.finding(
+                    mod, node,
+                    f"PRNG key {name!r} reused by {via} — it was "
+                    "already consumed; jax.random.split/fold_in it "
+                    "first (reused keys correlate draws)")
+            else:
+                state[name] = "spent"
+        for name in self._producer_assign(mod, stmt):
+            state[name] = "fresh"
+        # any other rebind also clears the spent mark
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name) and n.id in state \
+                            and state[n.id] == "spent":
+                        state[n.id] = "fresh"
+
+
+# ------------------------------------------------------------------ #
+#  host-sync-in-hot-path                                             #
+# ------------------------------------------------------------------ #
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_CONVERTERS = ("numpy.asarray", "numpy.array",
+               "numpy.ascontiguousarray", "jax.device_get")
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync"
+    severity = "warning"
+    escalates_to = "error"      # in-trace sync/branch findings
+    summary = "host sync / host conversion on the hot path"
+    contract = (
+        "In the hot modules (ops/, samplers/, parallel/) every "
+        "device->host transfer must be an annotated design point — "
+        "the block-boundary commit, the sanctioned host_snapshot — "
+        "because each one stalls the dispatch pipeline. Inside a "
+        "traced function the same constructs are errors: float()/"
+        "np.asarray()/.item() on a tracer forces a sync or fails, "
+        "and a Python `if` on a tracer-typed value must be "
+        "jax.lax.cond/jnp.where. ops/ outside traced code is exempt "
+        "from the conversion checks: build-time coercion there is "
+        "host-numpy-in/host-numpy-out by construction.")
+
+    def check(self, mod):
+        tree, al = mod.tree, mod.aliases
+        traced = mod.traced
+        seen = set()
+
+        def emit(node, msg, sev=None):
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                return None
+            seen.add(key)
+            f = self.finding(mod, node, msg)
+            if sev:
+                f.severity = sev
+            return f
+
+        # ---- A: module-wide boundary syncs in hot modules ---------- #
+        if mod.hot:
+            in_ops = mod.in_dir(f"{PKG_NAME}/ops/")
+            for node in mod.calls:
+                if traced.line_in_traced(node.lineno):
+                    continue
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _SYNC_METHODS:
+                    f = emit(node, f".{node.func.attr}() is a device "
+                                   "sync — annotate if this boundary "
+                                   "is intentional")
+                    if f:
+                        yield f
+                elif not in_ops and al.resolves(node.func,
+                                                *_CONVERTERS):
+                    f = emit(node,
+                             f"{al.dotted(node.func)}() on the hot "
+                             "path — a device->host pull when the "
+                             "value is a jax array; annotate the "
+                             "intentional block-boundary syncs")
+                    if f:
+                        yield f
+
+        # ---- B: traced regions, package-wide ----------------------- #
+        parents = mod.parents
+
+        def walk_traced(fn, inherited):
+            # parameters provably carry tracers only for DIRECTLY
+            # wrapped functions (scan bodies, traced()/vmap targets);
+            # call-propagated helpers take static config params
+            # (mode strings, toggles) and seed from closures only
+            taint = dataflow.tainted_names(
+                fn, seed=inherited,
+                include_params=traced.is_direct(fn))
+            own_nodes = []
+            nested = []
+            for child in ast.walk(fn):
+                if child is fn:
+                    continue
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    enc = _enclosing_func(parents, child)
+                    if enc is fn:
+                        nested.append(child)
+            skip_lines = [(n.lineno, n.end_lineno or n.lineno)
+                          for n in nested]
+
+            def in_nested(node):
+                ln = getattr(node, "lineno", None)
+                return ln is not None and any(
+                    lo <= ln <= hi for lo, hi in skip_lines)
+
+            def arg_tainted(call):
+                return any(
+                    dataflow.tainted_uses(a, taint)
+                    for a in list(call.args)
+                    + [k.value for k in call.keywords])
+
+            for node in ast.walk(fn):
+                if in_nested(node) or node is fn:
+                    continue
+                if isinstance(node, ast.Call):
+                    fname = node.func.id if isinstance(
+                        node.func, ast.Name) else None
+                    if fname in _CAST_BUILTINS and arg_tainted(node):
+                        f = emit(node, f"{fname}() on a tracer inside "
+                                       "a traced function — forces a "
+                                       "host sync (or fails under "
+                                       "jit)", "error")
+                        if f:
+                            yield f
+                    elif isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in _SYNC_METHODS:
+                        f = emit(node, f".{node.func.attr}() inside a "
+                                       "traced function — a device "
+                                       "sync in the middle of the "
+                                       "trace", "error")
+                        if f:
+                            yield f
+                    else:
+                        d = al.dotted(node.func)
+                        if d is not None and (
+                                d.startswith("numpy.")
+                                or d == "jax.device_get") \
+                                and arg_tainted(node):
+                            f = emit(node, f"{d}() applied to a "
+                                           "tracer inside a traced "
+                                           "function — numpy cannot "
+                                           "consume tracers; use jnp",
+                                     "error")
+                            if f:
+                                yield f
+                elif isinstance(node, (ast.If, ast.While)):
+                    # `x is None` / mode-string membership are static
+                    # at trace time — excluded inside tainted_in_test
+                    for hit in dataflow.tainted_in_test(node.test,
+                                                        taint):
+                        f = emit(hit, f"Python branch on tracer-typed "
+                                      f"{hit.id!r} inside a traced "
+                                      "function — use jax.lax.cond / "
+                                      "jnp.where (a tracer has no "
+                                      "truth value)", "error")
+                        if f:
+                            yield f
+            for child in nested:
+                if traced.is_traced(child):
+                    yield from walk_traced(child, taint)
+
+        for fn in traced.traced_funcs():
+            if isinstance(fn, ast.Lambda):
+                continue
+            enc = _enclosing_func(parents, fn)
+            if enc is not None and traced.is_traced(enc):
+                continue        # visited via its outermost ancestor
+            yield from walk_traced(fn, set())
+
+
+# ------------------------------------------------------------------ #
+#  jit-purity                                                        #
+# ------------------------------------------------------------------ #
+
+# NOTE: no "update" — the functional optimizer idiom
+# (``opt.update(grads, state)`` returning NEW state) is pure and
+# ubiquitous in jax code; dict.update on a closure is rare enough
+# that flagging it is not worth poisoning every optimizer step.
+_MUTATORS = {"append", "extend", "insert", "add", "pop",
+             "popitem", "clear", "remove", "discard", "setdefault",
+             "write", "writelines", "writerow"}
+_EFFECT_METHODS = {"inc", "observe", "event", "heartbeat", "record",
+                   "anomaly", "info", "debug", "warning", "error",
+                   "exception", "log"}
+_EFFECT_CALLS = ("builtins.open", "open", "numpy.save", "numpy.savez",
+                 "numpy.savez_compressed", "numpy.savetxt",
+                 "jax.experimental.io_callback", "io_callback",
+                 "jax.pure_callback", "jax.experimental.host_callback."
+                 "call")
+_ALLOWED_EFFECTS = ("jax.debug.print", "jax.debug.callback",
+                    "jax.named_scope", "jax.profiler.annotate")
+
+
+@register
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    severity = "error"
+    summary = "side effect inside a traced function"
+    contract = (
+        "A traced body runs ONCE at trace time and never again: "
+        "mutating closed-over state, appending to captured "
+        "containers, writing files, or calling the telemetry/"
+        "logging surface from inside it either silently does nothing "
+        "on later calls or corrupts host state from inside the "
+        "tracer. Telemetry leaves a traced region as scan/jit "
+        "OUTPUTS (the emit_nf pattern) or through jax.debug.*; "
+        "everything else is a finding. Subscript stores into a "
+        "PARAMETER of an enclosing function are exempt: that is the "
+        "Pallas Ref idiom (out_ref[...] = ... from inside a "
+        "fori_loop body) — Ref stores are the kernel's only write "
+        "mechanism, and a plain jax array would raise on item "
+        "assignment anyway.")
+
+    def check(self, mod):
+        traced = mod.traced
+        parents = mod.parents
+        seen = set()
+
+        def emit(node, msg):
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                return None
+            seen.add(key)
+            return self.finding(mod, node, msg)
+
+        for fn in traced.traced_funcs():
+            if isinstance(fn, ast.Lambda):
+                continue
+            locs = set(dataflow.local_names(fn))
+            # parameters of every enclosing function count as local
+            # write targets: a subscript store into one is the Pallas
+            # Ref plumbing (out_ref handed down into a loop body),
+            # not host-state mutation
+            enc = _enclosing_func(parents, fn)
+            while enc is not None:
+                if not isinstance(enc, ast.Lambda):
+                    locs |= dataflow.param_names(enc)
+                enc = _enclosing_func(parents, enc)
+            nested = [c for c in ast.walk(fn)
+                      if c is not fn and isinstance(
+                          c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda))
+                      and _enclosing_func(parents, c) is fn]
+            skip_lines = [(n.lineno, n.end_lineno or n.lineno)
+                          for n in nested]
+
+            def in_nested(node):
+                ln = getattr(node, "lineno", None)
+                return ln is not None and any(
+                    lo <= ln <= hi for lo, hi in skip_lines)
+
+            for node in ast.walk(fn):
+                if node is fn or in_nested(node):
+                    continue
+                f = self._check_node(mod, node, locs, emit)
+                if f is not None:
+                    yield f
+
+    def _root_name(self, node):
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def _check_node(self, mod, node, locs, emit):
+        al = mod.aliases
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            return emit(node,
+                        f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                        "write inside a traced function — the "
+                        "mutation happens once at trace time, never "
+                        "on later calls")
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    return emit(t, "attribute mutation "
+                                   f"('{ast.unparse(t)} = ...') inside "
+                                   "a traced function — object state "
+                                   "written at trace time leaks "
+                                   "across calls")
+                if isinstance(t, ast.Subscript):
+                    root = self._root_name(t)
+                    if root is not None and root not in locs:
+                        return emit(
+                            t, f"subscript store into closed-over "
+                               f"{root!r} inside a traced function — "
+                               "host container mutation at trace "
+                               "time")
+            return None
+        if isinstance(node, ast.Call):
+            if al.resolves(node.func, *_ALLOWED_EFFECTS,
+                           suffixes=("debug.print", "debug.callback",
+                                     "named_scope")):
+                return None
+            if al.resolves(node.func, *_EFFECT_CALLS,
+                           suffixes=("telemetry.registry",
+                                     "flightrec.flight_recorder",
+                                     "logging.get_logger")):
+                return emit(node,
+                            f"{al.dotted(node.func)}() inside a "
+                            "traced function — host I/O or telemetry "
+                            "from a traced body runs at trace time "
+                            "only; route it through scan outputs or "
+                            "jax.debug.*")
+            if isinstance(node.func, ast.Attribute):
+                root = self._root_name(node.func)
+                if root is not None and root in al.map:
+                    return None     # module attribute (jnp.log, ...)
+                if node.func.attr in _MUTATORS and root is not None \
+                        and root not in locs:
+                    return emit(node,
+                                f".{node.func.attr}() on closed-over "
+                                f"{root!r} inside a traced function — "
+                                "the append/update happens at trace "
+                                "time only")
+                if node.func.attr in _EFFECT_METHODS and \
+                        root is not None and root not in locs:
+                    return emit(node,
+                                f"telemetry/logging call "
+                                f"{root}.{node.func.attr}() inside a "
+                                "traced function — emit via scan "
+                                "outputs (the emit_nf pattern) or "
+                                "jax.debug.*")
+        return None
+
+
+# ------------------------------------------------------------------ #
+#  precision-contract                                                #
+# ------------------------------------------------------------------ #
+
+
+@register
+class PrecisionContractRule(Rule):
+    name = "precision"
+    severity = "warning"
+    summary = "f64 usage outside the documented genuine-f64 islands"
+    contract = (
+        "The kernel class is f32 (docs/kernels.md): f64 survives "
+        "only at the documented islands — equilibration scales, the "
+        "skinny M/r Grams, the TM-Schur eigensolve — each annotated "
+        "with WHY it needs the extra mantissa. An unannotated "
+        "float64 in hot code silently doubles memory traffic and "
+        "falls off the TPU fast path. The jax_enable_x64 switch is "
+        "set exactly once, in the package __init__.")
+
+    X64_ALLOWED = (f"{PKG_NAME}/__init__.py",)
+
+    # ewt: allow-precision — the string below is this rule's own
+    # pattern constant, not a config toggle
+    def check(self, mod):
+        tree, al = mod.tree, mod.aliases
+        # the x64 switch: package-wide check
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and \
+                    node.value == "jax_enable_x64" and \
+                    not mod.rel.startswith(self.X64_ALLOWED):
+                yield self.finding(
+                    mod, node,
+                    "jax_enable_x64 toggled outside the package "
+                    "__init__ — the x64 mode is process-global and "
+                    "set exactly once at import")
+        if not mod.hot:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr == "float64" and \
+                    al.resolves(node.value, "numpy", "jax.numpy",
+                                suffixes=("numpy",)):
+                yield self.finding(
+                    mod, node,
+                    f"{al.dotted(node)} in hot code — the kernel "
+                    "class is f32; annotate a genuine f64 island "
+                    "with why it needs the mantissa "
+                    "(docs/kernels.md precision contract)")
+        # dtype string literals only in dtype contexts (``dtype=`` /
+        # ``.astype(...)``) — a bare "f64" string is usually a mode
+        # selector, and mode selection is the split-path contract
+        for call in mod.calls:
+            cands = []
+            for k in call.keywords:
+                if k.arg == "dtype":
+                    cands.append(k.value)
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in ("astype", "view"):
+                cands.extend(call.args)
+            for c in cands:
+                if isinstance(c, ast.Constant) and \
+                        c.value in ("float64", "f64", "d", ">f8",
+                                    "<f8"):
+                    yield self.finding(
+                        mod, c,
+                        f"dtype literal {c.value!r} in hot code — "
+                        "the kernel class is f32; annotate a genuine "
+                        "f64 island with why it needs the mantissa "
+                        "(docs/kernels.md precision contract)")

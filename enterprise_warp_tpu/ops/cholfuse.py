@@ -265,6 +265,8 @@ def _is_transient(exc):
     return any(m in text for m in _TRANSIENT_MARKERS)
 
 
+# ewt: allow-precision — probe fixtures are built in f64 so the XLA
+# twin comparison has a trustworthy reference
 def _probe_matrix(n):
     """The probe's SPD test matrix (equilibrated f32 cast) and its f64
     reference Cholesky factor (upper, at the tier-1 jitter) — one
@@ -280,6 +282,7 @@ def _probe_matrix(n):
     return S32, ref
 
 
+# ewt: allow-precision — probe-time f64 reference, as _probe_matrix
 def _probe_one_shape(n, interpret=False):
     """Compile and run the real kernel on one (T(n), n, n) tile batch and
     check it against the float64 reference factorization. Raises on any
@@ -293,6 +296,7 @@ def _probe_one_shape(n, interpret=False):
                                    atol=1e-4))
 
 
+# ewt: allow-precision — probe-time f64 reference, as _probe_matrix
 def _probe_once(interpret=False):
     """Probe every tile class (see ``_PROBE_SHAPES``), then the
     outer-vmap composition. Raises on compile/execution failure; returns

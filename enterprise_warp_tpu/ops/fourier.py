@@ -12,6 +12,11 @@ reference at ``/root/reference/enterprise_warp/enterprise_models.py:190-254``):
 These builders run host-side in float64 (numpy); the likelihood layer decides
 the on-device dtype.
 """
+# ewt: allow-precision module — build-time basis construction is
+# host f64 END TO END: frequencies span ~1e-9..1e-7 Hz against
+# ~1e9 s TOAs, and sin/cos of (2 pi f t) needs the f64 mantissa to
+# keep phase; the likelihood layer owns any downcast
+
 
 from __future__ import annotations
 

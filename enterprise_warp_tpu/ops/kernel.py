@@ -53,6 +53,9 @@ _HIGH = jax.lax.Precision.HIGHEST
 _CHUNK = 256  # TOA-axis chunk length for f64 accumulation of f32 partials
 
 
+# ewt: allow-precision — build-time whitening: TOA residuals span
+# ~1e-6 s on ~1e9 s baselines — the dynamic range NEEDS the f64
+# mantissa (the documented genuine-f64 island, docs/kernels.md)
 def whiten_inputs(residuals, toaerrs, M, T):
     """Host-side whitening/normalization (float64 numpy).
 
@@ -115,6 +118,9 @@ def _gram_pair(S, B, mode):
             + _chunked_f32_gram(Sl, Bh))
 
 
+# ewt: allow-precision — pair-program construction stays f64: the
+# hi/lo split that feeds the f32 kernels is DERIVED from these
+# exact f64 inputs (docs/kernels.md split-precision contract)
 def build_pair_program(r_w, M_w, T_w):
     """Static pair-product matrix for the Gram-as-matmul fast path.
 
@@ -173,6 +179,9 @@ def build_pair_program(r_w, M_w, T_w):
                 nb=nb, ntm=nu - 1, nu=nu, ntoa=ntoa, n_pad=n_pad)
 
 
+# ewt: allow-precision — the split-Gram f64 accumulator: hi/lo
+# partial products recombine in f64 to recover ~1e-13 rel accuracy
+# (the core of the split-precision contract)
 def pair_program_grams(w, prog):
     """All Gram blocks at weight vector ``w`` (f64, ntoa) via the pair
     program: returns ``(G, H, P, X, q, rwr)`` with the same values and
@@ -203,6 +212,8 @@ def pair_program_grams(w, prog):
     return G, H, P, X, q, rwr
 
 
+# ewt: allow-precision — f32 partials accumulate into an f64 sum:
+# the chunk reduction is exactly the documented f64 island
 def _chunked_f32_gram(x, y):
     """x^T y of two f32 (row-padded) matrices on the MXU, with per-chunk
     partials accumulated in f64. The building block of split mode; also
@@ -595,6 +606,11 @@ def gram_blocks(nw, r_w, M_w, T_w, mask=None, gram_mode="split",
     return G, H, P, X, q, rwr
 
 
+# ewt: allow-no-bare-jit — inner kernel jit invoked from INSIDE the
+# traced()-wrapped likelihood entry points (models/build.py, the
+# megakernel classic fallback): a traced() wrapper here would count
+# every outer-trace inlining as a retrace and emit phantom compile
+# events; the real XLA compiles are already counted at the entry.
 @partial(jax.jit, static_argnames=("gram_mode", "blocked_chol",
                                    "refine", "mega"))
 def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
@@ -646,6 +662,8 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
     solve_mega = False if mega is False else None
     if mega is None:
         if (gram_mode in ("split", "f32") and grams is None
+                # ewt: allow-host-sync — blocked_chol is a static
+                # route pin (build-time Python bool, never a tracer)
                 and M_w is not None and not blocked_chol):
             # the route decision sees the call's CONCRETE shapes, so
             # an over-cap pulsar (VMEM budget, docs/kernels.md)
@@ -654,6 +672,9 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
             mega = mega_like_route(T_w.shape[0], T_w.shape[1])
         else:
             mega = False
+    # ewt: allow-host-sync — mega is a static route pin resolved above
+    # (Python bool / 'interpret'); the branch picks the staged program
+    # once at trace time, exactly like the EWT_PALLAS dispatch ladder
     if mega:
         if M_w is None or grams is not None:
             raise ValueError(

@@ -7,6 +7,10 @@ build and dense Cholesky — O(ntoa^3), test-sized data only. This is the
 strategy (SURVEY.md §4): the JAX kernel must match it to tight tolerance at
 matched parameters.
 """
+# ewt: allow-precision module — the oracle IS the dense f64
+# reference the f32/mixed kernels are validated against; downcasting
+# anything here would destroy the test oracle's authority
+
 
 from __future__ import annotations
 

@@ -11,6 +11,8 @@ from .orf import (dipole_matrix, hd_matrix, monopole_matrix,  # noqa: F401
 from .pta import PTALikelihood, build_pta_likelihood  # noqa: F401
 
 
+# ewt: allow-host-sync — np.array over the DEVICE LIST to build the
+# mesh; jax.devices() returns host objects, not arrays
 def make_psr_mesh(n_devices=None, axis="psr"):
     """A 1-D device mesh over the pulsar axis."""
     import jax

@@ -25,6 +25,8 @@ def _cos_angles(pos: np.ndarray) -> np.ndarray:
     return np.clip(c, -1.0, 1.0)
 
 
+# ewt: allow-host-sync,precision — build-time ORF geometry from host
+# pulsar positions; f64 because angle cosines near 1 cancel in f32
 def hd_matrix(pos: np.ndarray, auto: bool = True) -> np.ndarray:
     """Hellings–Downs correlation matrix.
 
@@ -41,6 +43,8 @@ def hd_matrix(pos: np.ndarray, auto: bool = True) -> np.ndarray:
     return orf
 
 
+# ewt: allow-host-sync,precision — build-time ORF geometry, same
+# contract as hd_matrix above
 def dipole_matrix(pos: np.ndarray) -> np.ndarray:
     orf = _cos_angles(np.asarray(pos, dtype=np.float64)).copy()
     np.fill_diagonal(orf, 1.0 + _DIAG_JITTER)

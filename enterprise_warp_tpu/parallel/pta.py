@@ -152,6 +152,8 @@ class PTALikelihood(PriorMixin):
 #  build-time compilation of the parameter-evaluation program            #
 # --------------------------------------------------------------------- #
 
+# ewt: allow-host-sync,precision — build-time const assembly: psrs
+# enter as host f64 per the whiten_inputs contract, before sharding
 def _refs_to_arrays(refs):
     """List of ('theta', i) / ('const', v) refs -> vectorized gather arrays
     (is_theta, idx, const)."""
@@ -655,6 +657,9 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
     # sampler evaluation protocol (samplers/evalproto.py)
     _sh = dict(R=R_j, T=T_j, mask=mask_j)
 
+    # ewt: allow-precision — stage-1 Gram leaves the split-precision
+    # accumulation in f64: the Sigma assembly downstream subtracts
+    # near-equal blocks (docs/kernels.md genuine-f64 island)
     def _common(theta, sh):
         """Shared front end: nw/phi evaluation, dynamic basis rescale,
         whitened Grams. Returns (G, X, rwr_p, logdet_n, logphi,
@@ -804,6 +809,8 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         cache = dict(st, rwr=rwr_p, ldn=logdet_n, lphi=logphi)
         return _stage3(theta, cache), cache
 
+    # ewt: allow-precision — single-site Gram recompute, same f64
+    # island as _common above
     def _cache_site(theta, psr_idx, cache, sh):
         """Single-site update: only pulsar ``psr_idx``'s parameters
         changed (declared by the sampler's update_mask, validated by

@@ -66,6 +66,8 @@ def _chol(cov, nd):
         return np.linalg.cholesky(cov), cov
 
 
+# ewt: allow-host-sync — per-round elite refit reads the committed
+# batch at the round boundary; CEM is host-driven by design
 def fit_cem(like, rounds=None, batch=256, inflate=1.5, seed=0,
             search_rounds=35, refine_rounds=15, boost=9.0,
             elite_frac=0.25, smooth=0.7, anneal_T0=8.0, anneal_tau=8.0,
@@ -104,6 +106,8 @@ def fit_cem(like, rounds=None, batch=256, inflate=1.5, seed=0,
     from .evalproto import prior_protocol
     lnp_batch = prior_protocol(like)
 
+    # ewt: allow-host-sync — CEM elite selection needs concrete lnL
+    # per round: the pull is the round boundary (one sync per round)
     def eval_batch(x):
         lnl = np.asarray(like.loglike_batch(jnp.asarray(x)))
         lnp = np.asarray(lnp_batch(jnp.asarray(x)))

@@ -47,6 +47,8 @@ def chains_from_file(chain_path, nchains, ndim, burn_frac=0.25):
     return c[:, nsteps - keep:]
 
 
+# ewt: allow-host-sync — reads chain FILES from disk; np.array here
+# wraps parsed text rows, never a device buffer
 def _robust_loadtxt(path):
     """Chain-file load tolerating a partial final line (kill mid-append):
     rows that fail float parsing — wrong token count OR a token truncated

@@ -90,6 +90,9 @@ def place_resident(v, placement):
     return jax.device_put(jnp.array(v), placement)
 
 
+# ewt: allow-host-sync — this IS the sanctioned snapshot helper: the
+# donation-safe real-copy device->host pull every sampler routes
+# boundary reads through (docs/performance.md device-state contract)
 def host_snapshot(tree):
     """Donation-safe host copy of a pytree of (device or host) arrays.
 

@@ -114,6 +114,8 @@ def install_protocol(like, eval_fn, consts, public=True, name=None):
     return like
 
 
+# ewt: allow-host-sync — protocol install runs once at build time;
+# the coercion normalizes caller-supplied arrays, not live buffers
 def install_masked_protocol(like, init_fn, site_fn, common_fn,
                             param_blocks, name=None):
     """Install the update_mask contract (see module docstring) from pure
@@ -133,6 +135,8 @@ def install_masked_protocol(like, init_fn, site_fn, common_fn,
     return like
 
 
+# ewt: allow-host-sync — mask derivation compares two HOST parameter
+# vectors (the proposal layer owns them); no device value involved
 def derive_update_mask(param_blocks, theta_prev, theta_new):
     """The minimal correct update_mask for a theta transition: compares
     the vectors elementwise and maps the changed dimensions through
@@ -178,6 +182,8 @@ class CachedEvaluator:
     acceptance rates.
     """
 
+    # ewt: allow-host-sync — evaluator construction coerces the
+    # initial theta once, before any cached evaluation
     def __init__(self, like, theta0=None):
         if not hasattr(like, "_cache_init"):
             raise TypeError(
@@ -203,6 +209,9 @@ class CachedEvaluator:
         if theta0 is not None:
             self.reset(theta0)
 
+    # ewt: allow-host-sync,precision — theta enters the cache as a
+    # host f64 vector BY CONTRACT (parameter vectors are f64; the
+    # update_mask staleness check compares host floats)
     def reset(self, theta):
         """Full recompute: (re)build the cache at ``theta``."""
         import jax.numpy as jnp
@@ -230,6 +239,8 @@ class CachedEvaluator:
         self.counters["rejected"] += 1
         return self.lnl
 
+    # ewt: allow-host-sync — stale-mask validation compares host
+    # parameter vectors; .tolist() reads an already-host array
     def _validate(self, theta, update_mask):
         changed = np.nonzero(self.theta != theta)[0]
         blocks = set(int(b) for b in self.param_blocks[changed])
@@ -245,6 +256,8 @@ class CachedEvaluator:
                 "declared block — a masked evaluation here would reuse "
                 "invalidated cached factorizations")
 
+    # ewt: allow-host-sync,precision — same contract as reset:
+    # host-f64 theta in, masked recompute out
     def update(self, theta, update_mask=None):
         """Evaluate at ``theta`` given what the proposal declared it
         touched. ``update_mask``: ``None`` (full), ``("psr", a)``,

@@ -75,6 +75,8 @@ class HMCSampler:
     :class:`PulsarLikelihood` or any PriorMixin likelihood).
     """
 
+    # ewt: allow-host-sync — construction-time setup before the first
+    # leapfrog block is dispatched; nothing to pipeline yet
     def __init__(self, like, outdir, nchains=64, seed=0, n_leapfrog=16,
                  target_accept=0.8, warmup=1000, init_eps=0.1,
                  eps_jitter=0.1, jitter_L=True, mass0=None, z0=None,
@@ -150,6 +152,8 @@ class HMCSampler:
         os.makedirs(outdir, exist_ok=True)
 
     # ---------------- init / checkpoint -------------------------------- #
+    # ewt: allow-host-sync — initial-ensemble draw/redraw guard must
+    # see concrete lnp values before sampling starts
     def _fresh_state(self):
         rng = np.random.default_rng(self.seed)
         if self.z0 is not None:
@@ -232,6 +236,10 @@ class HMCSampler:
         jitter_L = self.jitter_L
         l_min = max(1, n_leap // 2)
 
+        # ewt: allow-precision — dual-averaging step-size adaptation:
+        # the h_bar/log-eps running means accumulate O(1/t) terms over
+        # the whole run and drift visibly in f32 (docs/kernels.md
+        # f64-island list)
         def one_step(carry, t_glob):
             (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar, mass, acc,
              ndiv, mu, ngrad, consts) = carry
@@ -360,6 +368,9 @@ class HMCSampler:
         return throttled_block_worst(thetas_block,
                                      self.like.param_names, diag_t)
 
+    # ewt: allow-host-sync — the outer block loop commits each finished
+    # block's snapshot at its boundary (the one designed sync per
+    # block), mirroring the PTMCMC devicestate pipeline
     def _sample_impl(self, nsamp, resume, verbose, block_size, collect,
                      rec):
         meter = EvalRateMeter()
@@ -563,6 +574,8 @@ class HMCSampler:
         return self.W
 
 
+# ewt: allow-host-sync — entry-point wrapper: final chain assembly and
+# result serialization happen after sampling has finished
 def run_hmc(like, outdir, nsamp, params=None, resume=True, seed=0,
             verbose=True, advi_init=True, **kw):
     """Convenience entry honoring paramfile sampler kwargs.

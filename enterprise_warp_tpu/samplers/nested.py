@@ -48,6 +48,8 @@ def slide_effective(like, slide_moves=None):
     return bool(slide_moves) and avail
 
 
+# ewt: allow-host-sync — one-time refill-protocol setup: coerces the
+# static bounds to host arrays before the loop compiles
 def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
     """One jitted NS iteration: delete the K worst, refill by constrained
     random walks from random survivors. Likelihood device arrays flow in
@@ -216,6 +218,12 @@ def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
                             donate_argnums=donate)
 
 
+# ewt: allow-host-sync — the NS outer loop harvests each iteration's
+# dead points at the iteration boundary: that per-iteration commit IS
+# the nested-sampling design (evidence accumulation is host-side)
+# ewt: allow-precision — live points / lnZ ledger stay f64: the
+# shrinkage arithmetic (ln X after ~n*H iterations) loses the
+# evidence tail in f32 (docs/kernels.md f64-island list)
 def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
                kbatch=None, seed=0, max_iter=100000, verbose=True,
                label="result", resume=True, checkpoint_every=50,
@@ -317,6 +325,8 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
         it = 0
         lnz = -np.inf      # running logsumexp of dead-point weights
 
+    # ewt: allow-host-sync — checkpoint serialization pulls the live
+    # set once per checkpoint interval, at an iteration boundary
     def _write_ckpt():
         if ckpt_path is None or not is_primary():
             return
@@ -509,6 +519,8 @@ def _params_fingerprint(like):
     return "|".join(parts)
 
 
+# ewt: allow-host-sync,precision — host-side evidence reduction over
+# the completed dead-point ledger; f64 because lnZ spans ~1e3 nats
 def _logsumexp(x):
     x = np.asarray(x, dtype=np.float64)
     m = np.max(x)

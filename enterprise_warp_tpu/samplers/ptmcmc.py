@@ -83,6 +83,9 @@ class PTSampler:
     :class:`HyperModelLikelihood`).
     """
 
+    # ewt: allow-host-sync — construction-time setup: warm-start
+    # coercion and the initial prior-draw/redraw guard run before
+    # the first block is ever dispatched, so no pipeline to stall
     def __init__(self, like, outdir, ntemps=2, nchains=8, seed=0,
                  scam_weight=30, am_weight=15, de_weight=50,
                  prior_weight=10, cov_update=1000, swap_every=10,
@@ -257,6 +260,9 @@ class PTSampler:
         os.makedirs(outdir, exist_ok=True)
 
     # ---------------- initialization / resume -------------------------- #
+    # ewt: allow-host-sync — initial-ensemble draw: the redraw guard
+    # must see concrete lnl values to count/redraw non-finite
+    # starters before any block is dispatched (PR 5 escalation)
     def _fresh_state(self):
         if getattr(self, "_anneal_state", None) is not None:
             st = self._anneal_state
@@ -331,6 +337,8 @@ class PTSampler:
         np.savez(tmp, **payload)
         os.replace(tmp, self._ckpt_path)
 
+    # ewt: allow-host-sync — checkpoint resume: np.load hands back
+    # host arrays; the pull happens once, before sampling restarts
     def _load_state(self):
         z = np.load(self._ckpt_path)
         # per-rung counters + adapted ladder; checkpoints from before the
@@ -825,6 +833,9 @@ class PTSampler:
         return (eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL, lam,
                 cg_rows, kde_pts, kde_bw)
 
+    # ewt: allow-host-sync — the sanctioned device->host snapshot
+    # accessor: resume/diagnostics pull the ensemble here, at a
+    # block boundary, never mid-block
     def _x_host(self, st):
         """Host numpy view of the walker positions. Host-resident
         ``st.x`` (fresh/loaded/annealed state) wins; a device-resident
@@ -915,6 +926,9 @@ class PTSampler:
             self._t_ready = None
         return out
 
+    # ewt: allow-host-sync — THE block-boundary commit: one designed
+    # sync per block pulls the finished block's snapshot while the
+    # next block is already dispatched (devicestate pipeline)
     def _commit_block(self, st, out, todo):
         """Wait for one dispatched block, take the donation-safe host
         snapshot (the ONLY host copy of the ensemble state this block —
@@ -971,6 +985,9 @@ class PTSampler:
             self._escalate_nonfinite(snap, st, todo)
         return snap, snap["cold"], snap["cold_lnl"], snap["cold_lnp"]
 
+    # ewt: allow-host-sync — anomaly forensics: reads the committed
+    # host snapshot (already synced at the commit boundary) to dump
+    # the crime scene; no extra device traffic
     def _escalate_nonfinite(self, snap, st, todo):
         """Flight-recorder escalation of in-block non-finite
         evaluations (see the ``emit_nf`` emission in
@@ -1024,6 +1041,9 @@ class PTSampler:
             st.history = snap["history"]
         return cold, cold_lnl, cold_lnp
 
+    # ewt: allow-host-sync — annealing warm-up: covariance adaptation
+    # between stages reads committed block emissions at stage
+    # boundaries, same cadence as the commit sync
     def anneal_init(self, schedule=None, steps_per=100, resample=True,
                     ess_frac=0.5, verbose=True):
         """SMC-style tempered initialization of the walker ensemble.
@@ -1136,6 +1156,9 @@ class PTSampler:
             return self._sample_impl(nsamp, resume, verbose, thin,
                                      block_size, collect, rec)
 
+    # ewt: allow-host-sync — the outer block loop: ladder adaptation
+    # and flight-recorder position updates read the committed
+    # snapshot at block boundaries (the one sync per block)
     def _sample_impl(self, nsamp, resume, verbose, thin, block_size,
                      collect, rec):
         meter = EvalRateMeter()
@@ -1246,6 +1269,9 @@ class PTSampler:
             pipe.flush()
         return st
 
+    # ewt: allow-host-sync — deferred host work on snapshots already
+    # pulled at commit: runs double-buffered behind the next
+    # dispatched block, touching no live device buffer
     def _block_host_work(self, nsamp, todo, chain_path, collect, rec,
                          meter, diag_t, verbose, snap, full_x, full_l,
                          full_p, payload, step_now, ladder_now, sync_s,

@@ -32,6 +32,8 @@ from ..utils.profiling import span
 _log = get_logger("ewt.vi")
 
 
+# ewt: allow-host-sync — ADVI pulls the final params/ELBO trace once
+# after the optimization loop (device_get at the run boundary)
 def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
     """Fit a mean-field Gaussian in unconstrained space.
 
@@ -69,8 +71,7 @@ def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
 
     opt = optax.adam(lr)
 
-    @jax.jit
-    def step(params, opt_state, key, consts):
+    def _step(params, opt_state, key, consts):
         mu, log_sig = params
         sig = jnp.exp(log_sig)
         eps = jax.random.normal(key, (mc, nd))
@@ -94,6 +95,8 @@ def fit_advi(like, steps=2000, mc=16, lr=0.02, seed=0, verbose=False):
         g_ls = jnp.where(any_ok, g_ls, 0.0)
         updates, opt_state = opt.update((-g_mu, -g_ls), opt_state)
         return optax.apply_updates(params, updates), opt_state, val
+
+    step = telemetry.traced(_step, name="advi.step")
 
     params = (jnp.zeros(nd), jnp.full(nd, -1.0))
     opt_state = opt.init(params)

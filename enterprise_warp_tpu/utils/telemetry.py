@@ -344,6 +344,9 @@ def traced(fn, *, name: str | None = None, cost: bool | None = None,
     tracing = [False]
 
     def _inner(*args, **kwargs):
+        # ewt: allow-jit-purity — this trace-time-only store IS the
+        # retrace detector: the flag flips exactly when jax re-runs
+        # the Python body, which is the event being counted
         tracing[0] = True
         return fn(*args, **kwargs)
 

@@ -1,0 +1,19 @@
+"""Donation done right: device-owned copies in, rebind-from-outputs
+after. Placed at enterprise_warp_tpu/samplers/donation_neg.py."""
+import jax.numpy as jnp
+from ..utils import telemetry
+
+
+def _step(x, key):
+    return x + 1.0, key
+
+
+def run_block(chain_state, key):
+    # forced device copy: XLA owns the donated buffer
+    x = jnp.array(chain_state)
+    block = telemetry.traced(_step, donate_argnums=(0, 1))
+    # the canonical idiom: donated names rebound from the call's own
+    # outputs — the old buffers are dead and the names prove it
+    x, key = block(x, key)
+    x, key = block(x, key)
+    return x, key

@@ -1,0 +1,54 @@
+"""Seeded donation-safety violations — the PR 3 heap-corruption class.
+
+Placed (by the test) at enterprise_warp_tpu/samplers/donation_pos.py.
+"""
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from ..utils import telemetry
+
+
+def _step(x, key):
+    return x + 1.0, key
+
+
+def run_block(chain_state, key):
+    # zero-copy host view: numpy owns this memory
+    x = np.asarray(chain_state)
+    block = telemetry.traced(_step, donate_argnums=(0, 1))
+    # VIOLATION 1: donating a zero-copy numpy buffer — XLA will
+    # overwrite and free memory the numpy allocator owns
+    out, key2 = block(x, jnp.array(key))
+    return out, key2
+
+
+def use_after_donation(x0, key):
+    x = jnp.array(x0)
+    block = telemetry.traced(_step, donate_argnums=(0,))
+    out, key = block(x, key)
+    # VIOLATION 2: reading a donated binding after the call — its
+    # buffer now aliases the output
+    return out + x.sum()
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _dec_step(x, key):
+    return x + 1.0, key
+
+
+def run_decorated(key):
+    x = np.load("state.npy")
+    # VIOLATION 3: zero-copy np.load donated through the
+    # partial(jax.jit, ...) DECORATOR form
+    out, key = _dec_step(x, key)
+    return out, key
+
+
+def attribute_read_after_donation(st, key):
+    block = telemetry.traced(_step, donate_argnums=(0,))
+    out, key = block(st.x, key)
+    # VIOLATION 4: attribute-rooted donated binding (st.x — how
+    # PTSampler holds the ensemble) read after the call
+    return out + st.x.sum()

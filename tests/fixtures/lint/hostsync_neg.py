@@ -1,0 +1,35 @@
+"""Host-sync discipline done right. Placed at
+enterprise_warp_tpu/samplers/hostsync_neg.py."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from ..utils import telemetry
+
+
+@telemetry.traced
+def shape_branch(x):
+    # static-at-trace: shape/ndim/dtype programming is fine
+    if x.ndim == 1:
+        x = x[None, :]
+    if x.shape[0] > 4:
+        return x[:4]
+    return x
+
+
+@telemetry.traced
+def mode_branch(x, mode="fast", cfg=None):
+    # string-constant comparison and `is None` are trace-static
+    if mode == "fast" or cfg is None:
+        return x * 2.0
+    return x * 3.0
+
+
+@telemetry.traced
+def cond_branch(x):
+    return jax.lax.cond(jnp.sum(x) > 0, lambda v: v, lambda v: -v, x)
+
+
+# ewt: allow-host-sync — block-boundary commit: the one designed sync
+# per block, pulled while the next block is already dispatched
+def commit(dev_arr):
+    return np.asarray(dev_arr)

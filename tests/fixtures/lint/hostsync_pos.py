@@ -1,0 +1,38 @@
+"""Seeded host-sync violations. Placed at
+enterprise_warp_tpu/samplers/hostsync_pos.py (a hot module)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def traced_cast(x):
+    # VIOLATION (error): float() on a tracer forces a sync / fails
+    s = float(jnp.sum(x))
+    return x * s
+
+
+@jax.jit
+def traced_branch(x):
+    # VIOLATION (error): Python branch on a tracer boolean
+    if x.sum() > 0:
+        return x
+    return -x
+
+
+@jax.jit
+def traced_numpy(x):
+    # VIOLATION (error): numpy cannot consume tracers
+    return jnp.asarray(np.asarray(x) * 2.0)
+
+
+def boundary_pull(dev_arr):
+    # VIOLATION (warning): unannotated device->host pull in a hot
+    # module outside any traced region
+    host = np.asarray(dev_arr)
+    return host.sum()
+
+
+def item_pull(dev_arr):
+    # VIOLATION (warning): .item() is a device sync
+    return dev_arr.item()

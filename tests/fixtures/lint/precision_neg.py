@@ -1,0 +1,14 @@
+"""Precision contract respected. Placed at
+enterprise_warp_tpu/ops/precision_neg.py."""
+import numpy as np
+import jax.numpy as jnp
+
+
+# ewt: allow-precision — fixture island: accumulating f32 partials in
+# f64 is the documented split-precision contract
+def documented_island(parts):
+    return np.sum(parts, dtype=np.float64)
+
+
+def f32_kernel(x):
+    return jnp.asarray(x, dtype=jnp.float32) * 2.0

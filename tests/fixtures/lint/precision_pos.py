@@ -1,0 +1,23 @@
+"""Seeded precision-contract violations. Placed at
+enterprise_warp_tpu/ops/precision_pos.py (a hot module)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def unannotated_f64(x):
+    # VIOLATION: f64 island with no justification
+    acc = np.zeros(4, dtype=np.float64)
+    return acc + x
+
+
+def dtype_literal(x):
+    # VIOLATION: dtype string literal in hot code
+    return x.astype("float64")
+
+
+def toggle_x64():
+    # VIOLATION: the x64 switch is set exactly once, in the package
+    # __init__
+    jax.config.update("jax_enable_x64", True)
+    return jnp.ones(3)

@@ -1,0 +1,29 @@
+"""Pure traced bodies, including the Pallas Ref idiom. Placed at
+enterprise_warp_tpu/samplers/purity_neg.py."""
+import jax
+import jax.numpy as jnp
+from ..utils import telemetry
+
+
+@telemetry.traced
+def local_accumulate(x):
+    # locals are fair game: the list never escapes the trace
+    parts = []
+    for i in range(3):
+        parts.append(x * i)
+    return sum(parts)
+
+
+def kernel(x_ref, out_ref):
+    # the Pallas Ref idiom: subscript stores into a parameter of an
+    # enclosing function are the kernel's write mechanism
+    def body(k, carry):
+        out_ref[k] = x_ref[k] * 2.0
+        return carry
+    jax.lax.fori_loop(0, 4, body, 0)
+
+
+@telemetry.traced
+def debug_ok(x):
+    jax.debug.print("x sum {s}", s=jnp.sum(x))
+    return x

@@ -1,0 +1,39 @@
+"""Seeded jit-purity violations. Placed at
+enterprise_warp_tpu/samplers/purity_pos.py."""
+import jax
+
+_LOG = []
+_COUNT = 0
+
+
+@jax.jit
+def append_to_closure(x):
+    # VIOLATION: host container mutated at trace time only
+    _LOG.append(float(0.0))
+    return x * 2.0
+
+
+@jax.jit
+def global_write(x):
+    # VIOLATION: global rebound at trace time only
+    global _COUNT
+    _COUNT = _COUNT + 1
+    return x
+
+
+_CACHE = {}
+
+
+@jax.jit
+def memo_write(x):
+    # VIOLATION: module-level dict written at trace time only
+    _CACHE["last"] = 1
+    return x + 1.0
+
+
+@jax.jit
+def telemetry_inside(x):
+    from ..utils import telemetry
+    # VIOLATION: telemetry from a traced body runs at trace time only
+    telemetry.registry().counter("evals").inc()
+    return x

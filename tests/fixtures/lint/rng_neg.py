@@ -1,0 +1,27 @@
+"""Disciplined key handling: split/fold_in between consumptions.
+Placed at enterprise_warp_tpu/samplers/rng_neg.py."""
+import jax
+
+
+def split_rebind(key):
+    key, k0 = jax.random.split(key)
+    a = jax.random.normal(k0, (3,))
+    key, k1 = jax.random.split(key)
+    b = jax.random.uniform(k1, (3,))
+    return a + b
+
+
+def fold_in_streams(key, n):
+    # deriving independent streams off one parent via fold_in is the
+    # documented idiom, not a reuse
+    out = 0.0
+    for i in range(n):
+        out = out + jax.random.normal(jax.random.fold_in(key, i), ())
+    return out
+
+
+def loop_rebind(key, n):
+    for _ in range(n):
+        key, k = jax.random.split(key)
+        _ = jax.random.normal(k, ())
+    return key

@@ -1,0 +1,19 @@
+"""Seeded rng-key-reuse violations. Placed at
+enterprise_warp_tpu/samplers/rng_pos.py."""
+import jax
+
+
+def double_draw(key):
+    a = jax.random.normal(key, (3,))
+    # VIOLATION: key already consumed by the draw above
+    b = jax.random.uniform(key, (3,))
+    return a + b
+
+
+def loop_reuse(key, n):
+    out = 0.0
+    for _ in range(n):
+        # VIOLATION (second iteration): consumed on iteration i,
+        # never rebound before iteration i+1
+        out = out + jax.random.normal(key, ())
+    return out

@@ -1,0 +1,18 @@
+"""Style rules respected — and a docstring/comment trap the old grep
+tests would have tripped on. Placed at
+enterprise_warp_tpu/samplers/style_neg.py.
+
+A docstring may say print("hello") or time.time() or jax.jit(f) or
+even pallas_call(...) without the AST rules caring.
+"""
+from ..utils import telemetry
+from ..utils.logging import get_logger
+
+_log = get_logger("fixture")
+
+
+def quiet(x):
+    # a comment mentioning print("x") is not a call
+    _log.info("x = %s", x)
+    f = telemetry.traced(lambda v: v * 2, name="fixture")
+    return f(x)

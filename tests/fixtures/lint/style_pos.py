@@ -1,0 +1,23 @@
+"""Seeded style-rule violations (the four migrated textual bans).
+Placed at enterprise_warp_tpu/samplers/style_pos.py."""
+import time
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def noisy(x):
+    # VIOLATION no-print
+    print("x =", x)
+    # VIOLATION no-raw-timing
+    t0 = time.perf_counter()
+    # VIOLATION no-bare-jit
+    f = jax.jit(lambda v: v * 2)
+    y = f(x)
+    dt = time.time() - t0          # second no-raw-timing hit
+    return y, t0, dt
+
+
+def rogue_kernel(kern, shape):
+    # VIOLATION no-raw-pallas-call (outside ops/)
+    return pl.pallas_call(kern, out_shape=shape)

@@ -8,6 +8,8 @@ form, so equality is asserted on *differences* of lnL across parameter
 points (the sampling-relevant quantity).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -634,7 +636,13 @@ class TestConfig3Scale:
             record.setdefault("corner_lnl", []).append(
                 v if np.isfinite(v) else "-inf")
 
+        # The committed CONFIG3_SCALE.json is a curated benchmark record;
+        # routine test runs must not clobber it with this box's timings.
+        # Refresh it deliberately with EWT_WRITE_BENCH=1.
         import pathlib
-        repo = pathlib.Path(__file__).resolve().parents[1]
-        with open(repo / "CONFIG3_SCALE.json", "w") as fh:
+        if os.environ.get("EWT_WRITE_BENCH") == "1":
+            out = pathlib.Path(__file__).resolve().parents[1]
+        else:
+            out = tmp_path
+        with open(out / "CONFIG3_SCALE.json", "w") as fh:
             json.dump(record, fh, indent=1)

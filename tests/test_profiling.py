@@ -15,7 +15,6 @@ histograms — none of which exist under ``EWT_TELEMETRY=0``.
 import importlib.util
 import json
 import pathlib
-import re
 
 import jax
 import jax.numpy as jnp
@@ -323,27 +322,16 @@ def test_flightrec_anomaly_dump(monkeypatch, tmp_path):
 # ------------------------------------------------------------------ #
 
 def test_no_raw_timing_outside_profiling():
-    """``time.perf_counter(`` / ``time.time(`` are banned in the
-    package outside ``utils/telemetry.py`` and ``utils/profiling.py``
-    — ad-hoc timing is invisible to the span histograms and the
-    Chrome-trace export, so all other code routes through
-    ``profiling.monotonic``/``walltime``/``span``."""
-    allowed = {PKG_DIR / "utils" / "telemetry.py",
-               PKG_DIR / "utils" / "profiling.py"}
-    pattern = re.compile(r"time\.perf_counter\(|time\.time\(")
-    offenders = []
-    for path in sorted(PKG_DIR.rglob("*.py")):
-        if path in allowed:
-            continue
-        for lineno, line in enumerate(
-                path.read_text().splitlines(), start=1):
-            if pattern.search(line):
-                offenders.append(f"{path.relative_to(REPO_ROOT)}:"
-                                 f"{lineno}: {line.strip()}")
-    assert not offenders, (
-        "raw time.perf_counter()/time.time() in library code (use "
-        "utils.profiling.monotonic/walltime/span so timing feeds the "
-        "span histograms and trace export):\n" + "\n".join(offenders))
+    """Raw ``time.perf_counter()``/``time.time()`` are banned outside
+    ``utils/telemetry.py``/``utils/profiling.py`` — ad-hoc timing is
+    invisible to the span histograms and the Chrome-trace export.
+    Enforced by the ``no-raw-timing`` engine rule (PR 6: the grep loop
+    this test used to carry lives on as an AST rule in
+    ``enterprise_warp_tpu.analysis.rules_style``)."""
+    from enterprise_warp_tpu.analysis import run_lint
+    res = run_lint(rules=["no-raw-timing"])
+    bad = [f.format() for f in res.active if f.rule == "no-raw-timing"]
+    assert not bad, "\n".join(bad)
 
 
 # ------------------------------------------------------------------ #

@@ -11,7 +11,6 @@ import importlib.util
 import json
 import os
 import pathlib
-import re
 
 import jax
 import jax.numpy as jnp
@@ -199,73 +198,40 @@ def test_disabled_is_noop(tmp_path, monkeypatch):
 
 
 # ------------------------------------------------------------------ #
-#  print lint: library code must log, not print                       #
+#  style lints — thin wrappers over the ewt-lint engine (PR 6): the   #
+#  grep loops these tests used to carry live on as AST rules in       #
+#  enterprise_warp_tpu.analysis.rules_style                           #
 # ------------------------------------------------------------------ #
 
+def _lint_rule(rule):
+    from enterprise_warp_tpu.analysis import run_lint
+    res = run_lint(rules=[rule])
+    return [f.format() for f in res.active if f.rule == rule]
+
+
 def test_no_print_outside_cli():
-    """Statement-level ``print(`` is banned in the package outside the
-    two user-facing CLIs (``cli.py``, ``results/__main__.py``) — all
-    library output goes through ``utils.logging.get_logger`` or the
-    telemetry event stream."""
-    allowed = {PKG_DIR / "cli.py", PKG_DIR / "results" / "__main__.py"}
-    pattern = re.compile(r"^\s*print\(")
-    offenders = []
-    for path in sorted(PKG_DIR.rglob("*.py")):
-        if path in allowed:
-            continue
-        for lineno, line in enumerate(
-                path.read_text().splitlines(), start=1):
-            if pattern.match(line):
-                offenders.append(f"{path.relative_to(REPO_ROOT)}:"
-                                 f"{lineno}: {line.strip()}")
-    assert not offenders, (
-        "bare print() in library code (use get_logger or telemetry "
-        "events):\n" + "\n".join(offenders))
+    """``print()`` is banned in library code — all library output goes
+    through ``utils.logging.get_logger`` or the telemetry event
+    stream. Enforced by the ``no-print`` engine rule (AST-based: no
+    longer fooled by comments/docstrings)."""
+    assert not _lint_rule("no-print"), "\n".join(_lint_rule("no-print"))
 
 
 def test_no_bare_jax_jit_outside_telemetry():
-    """``jax.jit(`` is banned in the package outside
-    ``utils/telemetry.py`` — every hot jit must go through the
-    ``traced()`` wrapper so its compiles/retraces are counted (the
-    traced-jit contract; a silent retrace is a multi-second stall the
-    event stream exists to expose)."""
-    allowed = {PKG_DIR / "utils" / "telemetry.py"}
-    offenders = []
-    for path in sorted(PKG_DIR.rglob("*.py")):
-        if path in allowed:
-            continue
-        for lineno, line in enumerate(
-                path.read_text().splitlines(), start=1):
-            if "jax.jit(" in line:
-                offenders.append(f"{path.relative_to(REPO_ROOT)}:"
-                                 f"{lineno}: {line.strip()}")
-    assert not offenders, (
-        "bare jax.jit() in library code (use utils.telemetry.traced so "
-        "compiles/retraces are counted):\n" + "\n".join(offenders))
+    """Bare ``jax.jit`` is banned outside ``utils/telemetry.py`` —
+    every hot jit must go through ``traced()`` so compiles/retraces
+    are counted. Enforced by the ``no-bare-jit`` engine rule (alias-
+    aware: sees ``from jax import jit`` too)."""
+    assert not _lint_rule("no-bare-jit"), \
+        "\n".join(_lint_rule("no-bare-jit"))
 
 
 def test_no_raw_pallas_call_outside_ops():
-    """``pl.pallas_call(`` is banned in the package outside ``ops/`` —
-    every Pallas kernel must live behind the probe/fallback dispatch
-    ladder (``ops.megakernel`` / ``ops.cholfuse``: custom_vmap routing,
-    compile-and-run probe per tile class, transient-error re-probe,
-    ``EWT_PALLAS`` master hatch, ``pallas_path`` telemetry). A raw call
-    site elsewhere would put an unprobed Mosaic compile inside a hot
-    jit, exactly where its failure cannot be caught."""
-    allowed_dir = PKG_DIR / "ops"
-    offenders = []
-    for path in sorted(PKG_DIR.rglob("*.py")):
-        if allowed_dir in path.parents:
-            continue
-        for lineno, line in enumerate(
-                path.read_text().splitlines(), start=1):
-            if "pallas_call(" in line:
-                offenders.append(f"{path.relative_to(REPO_ROOT)}:"
-                                 f"{lineno}: {line.strip()}")
-    assert not offenders, (
-        "raw pallas_call outside ops/ (route kernels through the "
-        "ops.megakernel/ops.cholfuse probe+fallback ladder):\n"
-        + "\n".join(offenders))
+    """Raw ``pallas_call`` is banned outside ``ops/`` — kernels live
+    behind the probe/fallback dispatch ladder. Enforced by the
+    ``no-raw-pallas-call`` engine rule."""
+    assert not _lint_rule("no-raw-pallas-call"), \
+        "\n".join(_lint_rule("no-raw-pallas-call"))
 
 
 # ------------------------------------------------------------------ #

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""``ewt-lint`` CLI — run the tracer-safety rule engine.
+
+Usage::
+
+    python tools/lint.py                    # package + tools + bench
+    python tools/lint.py path/to/file.py    # explicit targets
+    python tools/lint.py --rule donation-safety --rule rng-key-reuse
+    python tools/lint.py --json             # machine-readable report
+    python tools/lint.py --list-rules       # catalog
+    python tools/lint.py --show-suppressed  # audit the annotations
+
+Exit status: 0 when no unsuppressed finding, 1 otherwise, 2 on usage
+errors. The engine is pure stdlib — this never imports jax, so it is
+safe on a box with a dead accelerator tunnel and a full-package run
+costs a few seconds in CI (it still routes through tools/_bootstrap
+so the package imports from the checkout).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import ensure_repo_path                  # noqa: E402
+
+REPO = ensure_repo_path()
+
+from enterprise_warp_tpu.analysis import (all_rules,     # noqa: E402
+                                          run_lint)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="ewt-lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "package, tools/, bench.py, "
+                         "__graft_entry__.py)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the JSON report on stdout")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="restrict to this rule (repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="include suppressed findings in the human "
+                         "output (the annotation audit record)")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for name, rule in rules.items():
+            sev = rule.severity + (f"->{rule.escalates_to}"
+                                   if rule.escalates_to else "")
+            print(f"{name:20s} [{sev}] {rule.summary}")
+        return 0
+
+    try:
+        res = run_lint(paths=args.paths or None, root=REPO,
+                       rules=args.rule)
+    except ValueError as e:
+        print(f"ewt-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps(res.to_json(), indent=2, sort_keys=True))
+    else:
+        print(res.format_human(show_suppressed=args.show_suppressed))
+    return 1 if res.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# ewt: allow-precision — standalone prototype process: it sets x64 at
+# startup for its own f64 reference arithmetic and is never imported
+# as a library, so the process-global toggle cannot leak
 jax.config.update("jax_enable_x64", True)
 
 BATCH = 1024
@@ -46,6 +49,9 @@ def f64_reference(S, B):
     return Z, logdet
 
 
+# ewt: allow-host-sync — logdet_terms is a static Python int unroll
+# count bound before trace; the >= branches select how many trace
+# expansion terms are STAGED, they never see a tracer
 def mixed_solve_logdet(S, B, jitter=1e-6, jitter2=3e-5, refine=2,
                        logdet_terms=4, resid_mode="f64"):
     """S: (nb,nb) f64 PSD, B: (nb,k) f64. Returns (Z, logdet)."""

@@ -137,7 +137,8 @@ def main():
         Gf = G.astype(jnp.float32)
         return jax.vmap(lambda S: equilibrated_cholesky(S, 0.0))(Gf)
 
-    X = jax.random.normal(key, (BATCH, nb), dtype=jnp.float64)
+    X = jax.random.normal(jax.random.fold_in(key, 1), (BATCH, nb),
+                          dtype=jnp.float64)
     L64, _, _ = chol_f64_nojit(G64)
 
     @jax.jit
@@ -151,7 +152,8 @@ def main():
             Li, xi, lower=True))(L.astype(jnp.float32),
                                  X.astype(jnp.float32))
 
-    Hb = jax.random.normal(key, (BATCH, nb, ntm), dtype=jnp.float64)
+    Hb = jax.random.normal(jax.random.fold_in(key, 2), (BATCH, nb, ntm),
+                           dtype=jnp.float64)
 
     @jax.jit
     def trisolve_mat_f64(L, H):
@@ -187,7 +189,8 @@ def main():
     timeit("trisolve f64 (nb x nb) x ntm", trisolve_mat_f64, L64, Hb)
 
     # ---- mixed-solve internals (the TPU hot path after the grams) ----
-    RHS = jax.random.normal(key, (BATCH, nb, ntm + 1), dtype=jnp.float64)
+    RHS = jax.random.normal(jax.random.fold_in(key, 3),
+                            (BATCH, nb, ntm + 1), dtype=jnp.float64)
     Lf = chol_f32(G64)[0]          # (BATCH, nb, nb) f32 factors
 
     @jax.jit

@@ -182,7 +182,8 @@ def main():
     gram_pp = jax.jit(jax.vmap(lambda wi: pair_program_grams(wi, prog)))
 
     Gs = gram_pp(w)[0] + 3.0 * jnp.eye(nb, dtype=jnp.float64)
-    RHS = jax.random.normal(key, (B, nb, nu), dtype=jnp.float64)
+    RHS = jax.random.normal(jax.random.fold_in(key, 1), (B, nb, nu),
+                            dtype=jnp.float64)
     solve = jax.jit(jax.vmap(lambda S, R: _mixed_psd_solve_logdet(
         S, R, 3e-6, refine=3, delta_mode="split")))
 
