@@ -405,6 +405,8 @@ def assignments_in(fn_or_body):
     (``st.x``)."""
     body = fn_or_body.body if hasattr(fn_or_body, "body") \
         else fn_or_body
+    if isinstance(body, ast.expr):
+        return []    # lambda body: an expression holds no assignments
     out = []
     for node in ast.walk(ast.Module(body=list(body),
                                     type_ignores=[])):

@@ -188,6 +188,11 @@ class DonationSafetyRule(Rule):
             if pos is None:
                 continue
             fn = _enclosing_func(parents, call)
+            # a lambda owns no assignments — the reaching-definition
+            # table lives in the nearest real def (supervised-dispatch
+            # thunks: ``sup.call(lambda: block(donated...))``)
+            while isinstance(fn, ast.Lambda):
+                fn = _enclosing_func(parents, fn)
             defs = defs_for(fn if fn is not None else tree)
             yield from self._check_site(mod, call, pos, defs, parents)
 

@@ -90,6 +90,56 @@ def main(argv=None):
     from .utils import telemetry
     with open(opts.prfile, "rb") as fh:
         config_hash = hashlib.sha256(fh.read()).hexdigest()[:16]
+    # graceful preemption (resilience/supervisor.py): SIGTERM lets the
+    # in-flight block finish, forces a final checkpoint, and closes the
+    # run scope with a clean run_end(reason="preempted") ahead of the
+    # flight-recorder ring dump — instead of dying mid-block
+    from .resilience.supervisor import (EXIT_DEMOTED, PlatformDemotion,
+                                        install_graceful_sigterm)
+    install_graceful_sigterm()
+    try:
+        _run_samplers(params, opts, resume, likes, first_id,
+                      config_hash)
+    except PlatformDemotion as d:
+        # the samplers already applied every in-process rung
+        # (megakernel -> classic XLA); reaching here means the run must
+        # re-enter one level down through a fresh process — the
+        # checkpoint is on disk, resume picks it up. ``cpu`` re-enters
+        # immediately by re-exec'ing this CLI with JAX_PLATFORMS=cpu
+        # (EWT_DEMOTION_EXEC=0 opts out); the ladder bottom exits 75
+        # (EX_TEMPFAIL) for an external supervisor to restart.
+        print(f"platform demotion: {d}", file=sys.stderr)
+        if d.to_level == "cpu" and \
+                os.environ.get("EWT_DEMOTION_EXEC", "1") != "0":
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            argv_full = list(sys.argv[1:]) if argv is None \
+                else list(argv)
+            # strip -w/--wipe_old_output: replaying it would rmtree
+            # the output dir and destroy the very checkpoint the
+            # re-entry resumes from
+            clean = []
+            skip = False
+            for a in argv_full:
+                if skip:
+                    skip = False
+                    continue
+                if a in ("-w", "--wipe_old_output"):
+                    skip = True
+                    continue
+                if a.startswith("--wipe_old_output=") or (
+                        a.startswith("-w") and a[2:].lstrip("=").isdigit()):
+                    continue
+                clean.append(a)
+            os.execve(sys.executable,
+                      [sys.executable, "-m", "enterprise_warp_tpu.cli"]
+                      + clean, env)
+        return EXIT_DEMOTED
+    return 0
+
+
+def _run_samplers(params, opts, resume, likes, first_id, config_hash):
+    from .utils import telemetry
     with telemetry.run_scope(params.output_dir, sampler=params.sampler,
                              config_hash=config_hash,
                              prfile=os.path.abspath(opts.prfile),
@@ -160,7 +210,6 @@ def main(argv=None):
                        label=params.label,
                        nlive=int(kw.get("nlive", 500)),
                        dlogz=float(kw.get("dlogz", 0.1)), resume=resume)
-    return 0
 
 
 if __name__ == "__main__":

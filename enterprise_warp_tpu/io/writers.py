@@ -26,28 +26,92 @@ from .pulsar import Pulsar
 from .tim import TimFile
 
 
+def fsync_dir(path: str):
+    """fsync the directory holding ``path`` so a just-renamed entry
+    survives a power loss / hard kill (POSIX: ``rename`` alone orders
+    nothing against the directory's own durability). Platform-tolerant:
+    filesystems/OSes that refuse ``open(dir)`` or directory fsync
+    (some network mounts, Windows) degrade to a no-op — the rename is
+    still atomic, just not yet durable."""
+    try:
+        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def durable_replace(tmp: str, path: str):
+    """``os.replace`` plus source-file and directory fsync: the
+    durability tail every atomic-write path in the package shares
+    (JSON artifacts here, the samplers' ``state.npz`` checkpoints).
+    The tmp file's DATA must be on disk before the rename makes it
+    reachable, and the rename itself must be on disk before a caller
+    treats the checkpoint as taken."""
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    fsync_dir(path)
+
+
 def atomic_write_json(path: str, obj, indent: int = 1, sort_keys=False,
                       default=None):
-    """Write ``obj`` as JSON to ``path`` atomically (tmp file + rename).
+    """Write ``obj`` as JSON to ``path`` atomically AND durably (tmp
+    file + fsync + rename + directory fsync).
 
     The shared write path for every run artifact refreshed while a run
     is live (``mask_stats.json``, nested result JSON, ``run_report.json``,
     bench records): a kill mid-write must never leave a truncated file
     where a consumer — a resumed run, a results process tailing the
     directory — expects valid JSON. ``os.replace`` is atomic on POSIX
-    within one filesystem, which the same-directory tmp name guarantees.
+    within one filesystem, which the same-directory tmp name guarantees;
+    the fsyncs (:func:`durable_replace`) close the remaining hole where
+    a crash AFTER the rename could still surface a zero-length or torn
+    file because neither the tmp's data nor the directory entry had
+    reached disk.
 
     ``default`` falls back to ``float`` coercion for numpy scalars (the
     dominant non-JSON type in run artifacts) when not given.
+
+    Fault-injection site ``io.atomic_json`` (resilience harness):
+    ``torn`` truncates the serialized payload (a short write that
+    still goes through the rename — the torn-artifact regression
+    fixture), ``kill`` writes the truncated tmp and SIGKILLs *before*
+    the rename — which is exactly the crash the atomicity contract
+    defends against, so the destination must keep its previous
+    content.
     """
     if default is None:
         default = float
+    from ..resilience import faults
+    spec = faults.fire("io.atomic_json", write=True, path=path)
+    data = json.dumps(obj, indent=indent, sort_keys=sort_keys,
+                      default=default)
+    if spec is not None and spec.kind in ("torn", "kill"):
+        data = faults.torn_bytes(spec, data)
     tmp = path + ".tmp"
     try:
         with open(tmp, "w") as fh:
-            json.dump(obj, fh, indent=indent, sort_keys=sort_keys,
-                      default=default)
-        os.replace(tmp, path)
+            fh.write(data)
+            fh.flush()
+            try:
+                os.fsync(fh.fileno())
+            except OSError:
+                pass    # platform-tolerant: durability degrades,
+                #         atomicity does not
+        if spec is not None and spec.kind == "kill":
+            faults.kill_now(spec)
+        durable_replace(tmp, path)
     except BaseException:
         # a failed dump must not leave a stray tmp next to the artifact
         if os.path.exists(tmp):

@@ -99,6 +99,11 @@ def build_terms_for_model(params_model, psrs, noise_model_obj,
     universal = getattr(params_model, "universal", {}) or {}
 
     for psr in psrs:
+        # resilience injection site: the CLI's per-pulsar model-build
+        # loop — a kill/error here exercises startup-crash recovery
+        # (nothing sampled yet, the rerun rebuilds from scratch)
+        from ..resilience import faults
+        faults.fire("cli.per_pulsar", psr=str(psr.name))
         model = noise_model_obj(psr=psr, params=params_model)
         terms = TermList(psr)
         for term_name, option in common_signals.items():

@@ -342,6 +342,10 @@ def pallas_chol_available():
         from ..utils.logging import get_logger
         _log = get_logger("ewt.cholfuse")
         try:
+            # resilience injection site: injected errors classify as
+            # transient transport failures, driving the re-probe path
+            from ..resilience import faults
+            faults.fire("cholfuse.probe")
             _PROBE_RESULT = _probe_once()
             if _PROBE_RESULT:
                 _PROBE_REASON = "probe passed"

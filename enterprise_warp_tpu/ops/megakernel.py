@@ -867,6 +867,11 @@ def _available(kernel):
     _log = get_logger("ewt.megakernel")
     _fr = flight_recorder()
     try:
+        # resilience injection site: an injected 'error' here reads as
+        # a transient transport failure, exercising the re-probe /
+        # transient-cap ladder below exactly as a relay hiccup would
+        from ..resilience import faults
+        faults.fire("mega.probe", kernel=kernel)
         ok = _PROBES[kernel]()
         st["result"] = ok
         st["reason"] = ("probe passed" if ok

@@ -1,0 +1,47 @@
+"""Supervised execution: fault injection, dispatch supervision, and
+mid-run platform demotion.
+
+The accelerator in this environment is reached through a relay that can
+die mid-campaign, turning every device call into an untimed futex wait
+(``utils/deviceprobe.py``), and long unattended campaigns also face
+preemption, full disks, and transient transport errors. This package is
+the layer that makes those failures survivable — and, just as
+important, *exercisable*:
+
+- :mod:`~enterprise_warp_tpu.resilience.faults` — a deterministic
+  fault-injection harness (``EWT_FAULT_PLAN``) with named injection
+  sites threaded through the samplers, the Pallas probes, the
+  checkpoint/event writers and the CLI model-build loop. Fully inert
+  when no plan is set.
+- :mod:`~enterprise_warp_tpu.resilience.supervisor` — the supervised
+  dispatch wrapper the samplers route device blocks through: a
+  wall-clock watchdog that converts a hung dispatch into a typed
+  :class:`~enterprise_warp_tpu.resilience.supervisor.DispatchHang`,
+  bounded retry with backoff for transient dispatch errors, and a
+  circuit breaker that checkpoints, re-probes the device, and demotes
+  the run down the platform ladder (megakernel -> classic XLA ->
+  forced-CPU re-entry through the existing resume path). Also owns the
+  graceful-preemption (SIGTERM) flag the CLI and samplers honor.
+
+``tools/chaos.py`` drives an end-to-end campaign under a seeded storm
+of these faults and asserts the recovered run is bit-equal to the
+uninterrupted one (the ``CHAOS.json`` artifact). See
+``docs/resilience.md`` for the fault-plan schema and the supervisor
+contract.
+"""
+
+from .faults import (FaultPlan, FaultSpec, InjectedFault, fire,
+                     install_plan, plan)
+from .supervisor import (BlockSupervisor, DispatchHang, PlatformDemotion,
+                         apply_demotion, current_level,
+                         install_graceful_sigterm, next_level,
+                         preemption_requested, request_preemption)
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "InjectedFault", "fire", "install_plan",
+    "plan",
+    "BlockSupervisor", "DispatchHang", "PlatformDemotion",
+    "apply_demotion", "current_level", "next_level",
+    "install_graceful_sigterm", "preemption_requested",
+    "request_preemption",
+]

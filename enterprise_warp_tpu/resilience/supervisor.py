@@ -1,0 +1,422 @@
+"""Supervised dispatch: watchdog, bounded retry, platform demotion.
+
+``utils/deviceprobe.py`` documents the failure this module exists for:
+when the accelerator relay dies mid-campaign, device calls "block
+forever on a futex — no error, no timeout". The startup probe catches a
+relay that is already dead; this wrapper catches one that dies *during*
+the run, at the only place the process can still act: the sampler's
+block boundary.
+
+Every sampler routes its device-block call through a
+:class:`BlockSupervisor`:
+
+- **watchdog** — the block call runs on a daemon worker thread and the
+  main thread waits ``EWT_WATCHDOG_S`` wall seconds for it; a call that
+  never returns becomes a typed :class:`DispatchHang` instead of an
+  eternal futex wait. Off by default (``EWT_WATCHDOG_S=0``): in that
+  case, and with no fault plan armed, :meth:`BlockSupervisor.call` is
+  a direct inline invocation — the dispatched block program and the
+  host-sync pattern are exactly the unsupervised ones.
+- **bounded retry** — transient dispatch errors (injected faults, and
+  transport-style errors matching the same markers the Pallas probe
+  ladder treats as transient) are retried ``EWT_DISPATCH_RETRIES``
+  times with exponential backoff plus deterministic jitter, counted as
+  ``dispatch_retry{site=}``.
+- **circuit breaker** — a hang, an exhausted retry budget, or
+  ``EWT_DISPATCH_STRIKES`` blocks that each needed retries trips the
+  breaker: the supervisor flushes the sampler's pending checkpoint
+  (``on_checkpoint``), re-probes the device through
+  ``utils.deviceprobe``, dumps a flight-recorder anomaly, records
+  ``demotion{from=,to=}``, and raises :class:`PlatformDemotion` — the
+  typed request to re-enter the run one rung down the platform ladder
+  (megakernel -> classic XLA -> forced-CPU re-entry through the
+  existing checkpoint/resume path). ``run_ptmcmc``/``run_hmc``/
+  ``run_nested`` apply in-process demotions (megakernel -> classic);
+  the CLI handles the CPU re-entry by re-exec'ing itself with
+  ``JAX_PLATFORMS=cpu`` (or exiting 75 for an external supervisor to
+  restart) — either way the run resumes from its checkpoint.
+
+This module also owns graceful preemption: the CLI installs
+:func:`install_graceful_sigterm`, the samplers poll
+:func:`preemption_requested` at their block boundaries, finish the
+in-flight block, force a final checkpoint, and the run scope closes
+with a clean ``run_end(reason="preempted")`` before the flight-recorder
+ring dump.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import faults
+
+__all__ = ["DispatchHang", "PlatformDemotion", "BlockSupervisor",
+           "current_level", "next_level", "apply_demotion",
+           "request_preemption", "preemption_requested",
+           "install_graceful_sigterm", "EXIT_DEMOTED"]
+
+#: exit status the CLI uses when a demotion cannot be applied
+#: in-process (bottom of the ladder, or re-exec disabled): EX_TEMPFAIL
+#: — "try again", which for an external supervisor (chaos driver, k8s)
+#: means restart-and-resume.
+EXIT_DEMOTED = 75
+
+
+class DispatchHang(RuntimeError):
+    """A supervised device call exceeded the watchdog wall clock — the
+    typed version of the dead-relay futex hang."""
+
+    def __init__(self, site: str, waited_s: float):
+        super().__init__(
+            f"dispatch at site {site!r} exceeded the {waited_s:.1f}s "
+            f"watchdog (device call hung — dead accelerator tunnel?)")
+        self.site = site
+        self.waited_s = waited_s
+
+
+class PlatformDemotion(RuntimeError):
+    """The circuit breaker's verdict: re-enter the run one rung down
+    the platform ladder. ``to_level`` is None at the bottom (nothing
+    left to demote to in-process — restart/resume is the only path)."""
+
+    def __init__(self, from_level: str, to_level: str | None,
+                 site: str, cause: BaseException | None = None,
+                 device_ok=None):
+        target = to_level or "restart"
+        super().__init__(
+            f"demoting run at site {site!r}: {from_level} -> {target}"
+            + (f" (cause: {cause!r})" if cause is not None else ""))
+        self.from_level = from_level
+        self.to_level = to_level
+        self.site = site
+        self.cause = cause
+        self.device_ok = device_ok
+
+
+# ------------------------------------------------------------------ #
+#  platform ladder                                                    #
+# ------------------------------------------------------------------ #
+
+_LADDER = ("mega", "classic", "cpu")
+
+
+def current_level() -> str:
+    """Where this process sits on the platform ladder: ``mega``
+    (accelerator + Pallas megakernel enabled), ``classic``
+    (accelerator, pure-XLA path), or ``cpu``."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return "cpu"
+    from ..ops.megakernel import _mega_enabled
+
+    return "mega" if _mega_enabled() else "classic"
+
+
+def next_level(level: str) -> str | None:
+    """One rung down, or None at the bottom."""
+    i = _LADDER.index(level)
+    return _LADDER[i + 1] if i + 1 < len(_LADDER) else None
+
+
+def apply_demotion(demotion: PlatformDemotion) -> bool:
+    """Apply an in-process demotion. ``mega -> classic`` flips the
+    package-wide Pallas hatch (``EWT_PALLAS=0`` — the documented
+    bit-equal XLA fallback; a fresh sampler retraces onto it). A
+    ``cpu`` target cannot be applied to a live process (the backend is
+    already initialized) — returns False, meaning the caller must
+    re-enter through the resume path (re-exec with
+    ``JAX_PLATFORMS=cpu``, or exit :data:`EXIT_DEMOTED`)."""
+    if demotion.to_level == "classic":
+        os.environ["EWT_PALLAS"] = "0"
+        return True
+    return False
+
+
+# ------------------------------------------------------------------ #
+#  graceful preemption (SIGTERM)                                      #
+# ------------------------------------------------------------------ #
+
+_PREEMPT = threading.Event()
+
+
+def request_preemption():
+    """Ask the running samplers to stop at the next block boundary."""
+    _PREEMPT.set()
+
+
+def preemption_requested() -> bool:
+    return _PREEMPT.is_set()
+
+
+def install_graceful_sigterm():
+    """Install the graceful-preemption SIGTERM handler: set the flag
+    and return, letting the in-flight block finish, the sampler
+    checkpoint, and the run scope emit ``run_end(reason="preempted")``
+    — instead of the default ring-dump-and-die. Main thread only; a
+    no-op elsewhere. Returns True when installed."""
+    import signal
+
+    def _on_term(signum, frame):
+        request_preemption()
+        from ..utils.flightrec import flight_recorder
+        from ..utils.logging import get_logger
+
+        flight_recorder().record("preempt_signal", signum=int(signum))
+        get_logger("ewt.supervisor").warning(
+            "SIGTERM: finishing the in-flight block, then "
+            "checkpointing and shutting down cleanly")
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+        return True
+    except (ValueError, OSError):
+        return False
+
+
+# ------------------------------------------------------------------ #
+#  the supervisor                                                     #
+# ------------------------------------------------------------------ #
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+# transport-style error markers shared in spirit with the Pallas probe
+# ladder's transient classification: these justify a retry, anything
+# else propagates unchanged (a shape error retried forever is a bug
+# hidden, not a fault survived)
+_TRANSIENT_MARKERS = (
+    "injected dispatch fault", "deadline exceeded", "unavailable",
+    "connection reset", "connection refused", "socket closed",
+    "transport", "rpc error", "aborted", "internal: failed to connect",
+)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    if isinstance(exc, faults.InjectedFault):
+        return True
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(m in text for m in _TRANSIENT_MARKERS)
+
+
+class BlockSupervisor:
+    """Supervised execution of one sampler's device-block calls.
+
+    One instance per sampler, named by its injection ``site``
+    (``pt.dispatch``, ``hmc.dispatch``, ``nested.iteration``).
+    ``on_checkpoint`` — a callable the circuit breaker invokes before
+    demoting, so the last committed state is durable (the PT sampler
+    binds its host-pipeline flush here).
+
+    **Transparency contract**: with the watchdog off (the default) and
+    no fault plan armed, :meth:`call` is ``return thunk()`` — no
+    thread, no timer, no extra host sync; the dispatched block program
+    is byte-identical to the unsupervised one.
+    """
+
+    def __init__(self, site: str, on_checkpoint=None,
+                 watchdog_s: float | None = None,
+                 retries: int | None = None,
+                 strike_limit: int | None = None,
+                 backoff_s: float | None = None):
+        self.site = site
+        self.on_checkpoint = on_checkpoint
+        self.watchdog_s = (_env_float("EWT_WATCHDOG_S", 0.0)
+                           if watchdog_s is None else float(watchdog_s))
+        self.retries = (int(_env_float("EWT_DISPATCH_RETRIES", 2))
+                        if retries is None else int(retries))
+        self.strike_limit = (int(_env_float("EWT_DISPATCH_STRIKES", 3))
+                             if strike_limit is None
+                             else int(strike_limit))
+        self.backoff_s = (_env_float("EWT_DISPATCH_BACKOFF_S", 0.05)
+                          if backoff_s is None else float(backoff_s))
+        self.strikes = 0
+        self.calls = 0
+
+    # -------------------------------------------------------------- #
+    def supervised(self) -> bool:
+        """Whether :meth:`call` takes the supervised path (watchdog
+        armed or a fault plan active) — False is the inline
+        zero-overhead fast path."""
+        return self.watchdog_s > 0 or faults.plan() is not None
+
+    def call(self, thunk, retryable: bool = True,
+             site: str | None = None, **ctx):
+        """Run one supervised block call (see class docstring).
+        ``retryable=False`` (commit-side syncs whose inputs a retry
+        could not reconstruct) skips the retry loop: transient errors
+        and hangs go straight to the circuit breaker. ``site``
+        overrides the supervisor's default injection-site name for
+        this call (the PT sampler shares one supervisor — one strike
+        ledger — between its dispatch and commit sites)."""
+        if not self.supervised():
+            return thunk()
+        site = site or self.site
+        self.calls += 1
+        if self.strikes >= self.strike_limit:
+            # breaker already tripped by repeated flaky blocks: demote
+            # at this clean boundary instead of dispatching again
+            self._demote(None, site)
+
+        def attempt():
+            faults.fire(site, **ctx)
+            return thunk()
+
+        tries = self.retries if retryable else 0
+        delay = self.backoff_s
+        n_retry = 0
+        while True:
+            try:
+                out = self._watched(attempt, site)
+                if n_retry:
+                    self.strikes += 1
+                return out
+            except DispatchHang as exc:
+                self._record_hang(exc)
+                self._demote(exc, site)
+            except Exception as exc:   # noqa: BLE001 — classified below
+                if not _is_transient(exc):
+                    if n_retry:
+                        # a retry re-invocation failed non-transiently:
+                        # the thunk's inputs may be gone (a donating
+                        # dispatch whose first attempt consumed its
+                        # buffers before erroring) — the only safe exit
+                        # is the breaker's checkpoint/resume path, not
+                        # a raw crash with no checkpoint
+                        self.strikes += 1
+                        self._demote(exc, site)
+                    raise
+                n_retry += 1
+                if n_retry > tries:
+                    self.strikes += 1
+                    self._demote(exc, site)
+                self._record_retry(exc, n_retry, site)
+                # deterministic jitter: crc-derived fraction of the
+                # delay, so concurrent supervisors (distinct sites /
+                # call counts) de-synchronize but a rerun of the same
+                # plan reproduces the same schedule (hash() would not:
+                # PYTHONHASHSEED randomizes it per process)
+                import zlib
+
+                jitter = (zlib.crc32(
+                    f"{site}:{self.calls}:{n_retry}".encode())
+                    % 1000) / 1000.0
+                time.sleep(delay * (1.0 + jitter))
+                delay *= 2.0
+
+    def _watched(self, fn, site):
+        """Run ``fn`` under the wall-clock watchdog (inline when the
+        watchdog is off). The worker is a daemon thread: a genuinely
+        hung device call cannot be cancelled, only abandoned — process
+        teardown must not join it."""
+        if self.watchdog_s <= 0:
+            return fn()
+        box: dict = {}
+        done = threading.Event()
+
+        def runner():
+            try:
+                box["value"] = fn()
+            except BaseException as exc:   # noqa: BLE001 — re-raised
+                box["error"] = exc
+            finally:
+                done.set()
+
+        t = threading.Thread(target=runner, daemon=True,
+                             name=f"ewt-dispatch-{site}")
+        t.start()
+        if not done.wait(self.watchdog_s):
+            raise DispatchHang(site, self.watchdog_s)
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    # ------------------------- telemetry --------------------------- #
+    def _record_retry(self, exc, n_retry, site):
+        from ..utils import telemetry
+        from ..utils.flightrec import flight_recorder
+        from ..utils.logging import get_logger
+
+        telemetry.registry().counter("dispatch_retry",
+                                     site=site).inc()
+        flight_recorder().record("dispatch_retry", site=site,
+                                 attempt=n_retry, error=repr(exc)[:160])
+        rec = telemetry.active_recorder()
+        if rec is not None:
+            rec.event("retry", site=site, attempt=n_retry,
+                      error=repr(exc)[:160])
+            rec.flush()    # forensic record: must survive a later kill
+        get_logger("ewt.supervisor").warning(
+            "transient dispatch error at %s (retry %d/%d): %r",
+            site, n_retry, self.retries, exc)
+
+    def _record_hang(self, exc):
+        from ..utils import telemetry
+        from ..utils.flightrec import flight_recorder
+
+        telemetry.registry().counter("dispatch_hang",
+                                     site=exc.site).inc()
+        flight_recorder().record("dispatch_hang", site=exc.site,
+                                 waited_s=exc.waited_s)
+
+    # ---------------------- circuit breaker ------------------------ #
+    def _demote(self, cause, site=None):
+        """Checkpoint, re-probe, record, raise — see module
+        docstring. Never returns."""
+        from ..utils import telemetry
+        from ..utils.flightrec import flight_recorder
+        from ..utils.logging import get_logger
+
+        site = site or self.site
+        log = get_logger("ewt.supervisor")
+        if self.on_checkpoint is not None:
+            try:
+                self.on_checkpoint()
+            except Exception as exc:   # noqa: BLE001 — still demote
+                log.warning("pre-demotion checkpoint flush failed: %r",
+                            exc)
+        from_level = current_level()
+        to_level = next_level(from_level)
+        # re-probe the tunnel in a throwaway subprocess (the only safe
+        # way to ask "is the device alive" once a call has hung) — on
+        # the cpu rung there is no tunnel left to probe
+        device_ok = None
+        if from_level != "cpu":
+            from ..utils.deviceprobe import probe_device
+
+            device_ok = bool(probe_device(
+                timeout=_env_float("EWT_DEMOTE_PROBE_S", 30.0),
+                refresh=True))
+        telemetry.registry().counter(
+            "demotion", **{"from": from_level,
+                           "to": to_level or "restart"}).inc()
+        rec = telemetry.active_recorder()
+        if rec is not None:
+            rec.event("demotion", site=site,
+                      **{"from": from_level,
+                         "to": to_level or "restart"},
+                      strikes=self.strikes,
+                      device_ok=device_ok,
+                      cause=(repr(cause)[:200] if cause is not None
+                             else None))
+            rec.flush()     # the demotion record must survive a crash
+        flight_recorder().anomaly(
+            "dispatch_demotion",
+            once_key=f"dispatch_demotion:{site}:{from_level}",
+            site=site, from_level=from_level,
+            to_level=to_level or "restart", strikes=self.strikes,
+            device_ok=device_ok,
+            cause=(repr(cause)[:300] if cause is not None else None))
+        log.error("circuit breaker tripped at %s (%s; device_ok=%s): "
+                  "demoting %s -> %s", site,
+                  cause if cause is not None else
+                  f"{self.strikes} strikes", device_ok, from_level,
+                  to_level or "restart")
+        raise PlatformDemotion(from_level, to_level, site,
+                               cause=cause, device_ok=device_ok)

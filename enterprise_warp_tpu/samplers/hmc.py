@@ -39,9 +39,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..io.writers import durable_replace
 from ..native import write_table
 from .transform import make_logp_z
 from ..parallel.distributed import is_primary as _is_primary
+from ..resilience import faults
+from ..resilience.supervisor import (BlockSupervisor, PlatformDemotion,
+                                     apply_demotion,
+                                     preemption_requested)
 from ..utils import profiling, telemetry
 from ..utils.flightrec import flight_recorder
 from ..utils.logging import EvalRateMeter, get_logger
@@ -149,6 +154,10 @@ class HMCSampler:
         self._from_unit_batch = telemetry.traced(
             lambda z: like.from_unit(jax.nn.sigmoid(z)),
             name="hmc_from_unit_batch")
+        # supervised execution (resilience/supervisor.py): watchdog +
+        # retry + circuit-breaker demotion on the block dispatch; a
+        # direct inline call when unarmed (the default)
+        self._supervisor = BlockSupervisor("hmc.dispatch")
         os.makedirs(outdir, exist_ok=True)
 
     # ---------------- init / checkpoint -------------------------------- #
@@ -205,7 +214,9 @@ class HMCSampler:
                  mass=st.mass, step=st.step, accepted=st.accepted,
                  divergences=st.divergences, mu=st.mu,
                  da_iter=st.da_iter, ngrad=st.ngrad)
-        os.replace(tmp, self._ckpt_path)
+        durable_replace(tmp, self._ckpt_path)
+        # kill-after-durable-checkpoint injection boundary (resilience)
+        faults.fire("hmc.ckpt", path=self._ckpt_path, step=int(st.step))
 
     def _load_state(self):
         z = np.load(self._ckpt_path)
@@ -414,6 +425,13 @@ class HMCSampler:
         blocks = {}
 
         while st.step < nsamp:
+            if preemption_requested():
+                # graceful preemption: the previous block's state was
+                # already saved; stop at this clean boundary and let
+                # run_scope emit run_end(reason="preempted")
+                _log.warning("preemption requested: stopping at step "
+                             "%d (checkpoint on disk)", st.step)
+                break
             todo = int(min(block_size, nsamp - st.step))
             ngrad_before = st.ngrad
             # never straddle the warmup or mass boundaries in one block
@@ -425,12 +443,20 @@ class HMCSampler:
             if bkey not in blocks:
                 blocks[bkey] = self._make_block(todo, adapt)
             with span("hmc.dispatch", steps=todo, adapt=adapt):
+                # supervised dispatch (see PTSampler._dispatch_block):
+                # injected/transient errors surface before the jit
+                # consumes its donated inputs, so retry re-invocation
+                # is safe; hangs and exhausted retries demote through
+                # the checkpoint/resume path
                 (z, key, log_eps, log_eps_bar, h_bar, acc, ndiv, zs,
-                 lnls, mean_acc, ngrad) = blocks[bkey](
-                    self._place(st.z), self._place(st.key), st.log_eps,
-                    st.log_eps_bar, st.h_bar, jnp.asarray(st.mass),
-                    self._place(st.accepted), st.divergences,
-                    st.da_iter, st.mu, st.ngrad, self._consts)
+                 lnls, mean_acc, ngrad) = self._supervisor.call(
+                    lambda: blocks[bkey](
+                        self._place(st.z), self._place(st.key),
+                        st.log_eps, st.log_eps_bar, st.h_bar,
+                        jnp.asarray(st.mass),
+                        self._place(st.accepted), st.divergences,
+                        st.da_iter, st.mu, st.ngrad, self._consts),
+                    step=int(st.step), block_steps=int(todo))
             # block-boundary bubble: previous results landed ->
             # this dispatch handed the device new work
             now = monotonic()
@@ -505,6 +531,13 @@ class HMCSampler:
             thetas = np.asarray(self._from_unit_batch(
                 jnp.asarray(zs_np.reshape(-1, self.ndim))))
             lnls_np = np.asarray(lnls).reshape(-1, 1)
+            if faults.fire("hmc.nonfinite", step=int(st.step)) \
+                    is not None:
+                # poison one committed eval: drives the counted
+                # escalation + anomaly dump below, as a genuinely bad
+                # chain state would
+                lnls_np = lnls_np.copy()
+                lnls_np[0, 0] = np.nan
             nbad = int(np.sum(~np.isfinite(lnls_np)))
             if nbad:
                 # a committed non-finite lnl is an anomaly (HMC only
@@ -624,6 +657,17 @@ def run_hmc(like, outdir, nsamp, params=None, resume=True, seed=0,
             and "warmup" in getattr(params, "sampler_kwargs", {}))
         if not explicit:
             opts["warmup"] = max(200, min(400, nsamp // 10))
-    sampler = HMCSampler(like, outdir, **opts)
-    sampler.sample(nsamp, resume=resume, verbose=verbose)
-    return sampler
+    # demotion re-entry loop (see run_ptmcmc): in-process for
+    # megakernel -> classic, propagated for forced-CPU re-entry
+    while True:
+        sampler = HMCSampler(like, outdir, **opts)
+        try:
+            sampler.sample(nsamp, resume=resume, verbose=verbose)
+        except PlatformDemotion as d:
+            if not apply_demotion(d):
+                raise
+            _log.warning("re-entering HMC run on the %s path (resume "
+                         "from checkpoint)", d.to_level)
+            resume = True
+            continue
+        return sampler

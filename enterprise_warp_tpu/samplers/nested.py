@@ -25,7 +25,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..io.writers import atomic_write_json
+from ..io.writers import atomic_write_json, durable_replace
+from ..resilience import faults
+from ..resilience.supervisor import (BlockSupervisor, PlatformDemotion,
+                                     apply_demotion,
+                                     preemption_requested)
 from ..utils import profiling, telemetry
 from ..utils.flightrec import flight_recorder
 from ..utils.logging import EvalRateMeter, get_logger
@@ -218,16 +222,7 @@ def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
                             donate_argnums=donate)
 
 
-# ewt: allow-host-sync — the NS outer loop harvests each iteration's
-# dead points at the iteration boundary: that per-iteration commit IS
-# the nested-sampling design (evidence accumulation is host-side)
-# ewt: allow-precision — live points / lnZ ledger stay f64: the
-# shrinkage arithmetic (ln X after ~n*H iterations) loses the
-# evidence tail in f32 (docs/kernels.md f64-island list)
-def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
-               kbatch=None, seed=0, max_iter=100000, verbose=True,
-               label="result", resume=True, checkpoint_every=50,
-               slide_moves=None):
+def run_nested(like, outdir=None, **kw):
     """Nested sampling over a compiled likelihood object.
 
     Returns a dict with ``log_evidence``, ``log_evidence_err``,
@@ -240,9 +235,36 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
     ``resume=True`` (default, matching the reference's Bilby behavior at
     ``/root/reference/examples/bilby_example.py:44``) an existing
     checkpoint is loaded and the run continues with an identical random
-    stream, so kill-and-resume reproduces the uninterrupted run. The
-    checkpoint is removed when the run converges.
+    stream, so kill-and-resume reproduces the uninterrupted run
+    bit-for-bit. The checkpoint is removed when the run converges.
+
+    Supervised execution (resilience/supervisor.py): each iteration
+    dispatch runs under the watchdog/retry wrapper; a circuit-breaker
+    :class:`PlatformDemotion` is re-entered here in-process for the
+    megakernel -> classic rung (resuming from the checkpoint) and
+    propagated for the forced-CPU rung.
     """
+    while True:
+        try:
+            return _run_nested_impl(like, outdir=outdir, **kw)
+        except PlatformDemotion as d:
+            if not apply_demotion(d):
+                raise
+            _log.warning("re-entering nested run on the %s path "
+                         "(resume from checkpoint)", d.to_level)
+            kw["resume"] = True
+
+
+# ewt: allow-host-sync — the NS outer loop harvests each iteration's
+# dead points at the iteration boundary: that per-iteration commit IS
+# the nested-sampling design (evidence accumulation is host-side)
+# ewt: allow-precision — live points / lnZ ledger stay f64: the
+# shrinkage arithmetic (ln X after ~n*H iterations) loses the
+# evidence tail in f32 (docs/kernels.md f64-island list)
+def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
+                     kbatch=None, seed=0, max_iter=100000, verbose=True,
+                     label="result", resume=True, checkpoint_every=50,
+                     slide_moves=None):
     nd = like.ndim
     kbatch = kbatch or max(1, nlive // 5)
 
@@ -348,7 +370,9 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
                        else np.zeros(0)),
             nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
             params_fp=_params_fingerprint(like))
-        os.replace(tmp, ckpt_path)
+        durable_replace(tmp, ckpt_path)
+        # kill-after-durable-checkpoint injection boundary (resilience)
+        faults.fire("nested.ckpt", path=ckpt_path, iteration=int(it))
 
     # commit the live-point state once: the first iteration call (fresh
     # uniform draws / checkpoint load, uncommitted) must hit the same
@@ -362,6 +386,11 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
     rng_key = jax.device_put(jnp.array(rng_key), _dev0)
 
     converged = False
+    # supervised iteration dispatch (resilience/supervisor.py): a
+    # breaker trip checkpoints first (on_checkpoint) so the demotion
+    # re-entry resumes from the exact iteration boundary
+    supervisor = BlockSupervisor("nested.iteration",
+                                 on_checkpoint=lambda: _write_ckpt())
     with telemetry.run_scope(outdir, sampler="nested", label=label,
                              nlive=int(nlive), kbatch=int(kbatch),
                              nsteps=int(nsteps), ndim=int(nd),
@@ -369,13 +398,29 @@ def run_nested(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
                              param_names=list(like.param_names)) as rec:
         meter = EvalRateMeter()
         while it < max_iter:
+            if preemption_requested():
+                # graceful preemption: checkpoint at this iteration
+                # boundary and stop; the not-converged epilogue below
+                # writes the resumable state
+                _log.warning("preemption requested: stopping at "
+                             "iteration %d", it)
+                break
             with span("ns.iteration", it=it):
                 u, lnl, rng_key, du, dl, acc, lnz_d, lnx_d, delta_d = \
-                    iteration(u, lnl, rng_key, jnp.float64(scale),
-                              jnp.float64(lnz), jnp.float64(ln_x),
-                              _consts)
+                    supervisor.call(
+                        lambda: iteration(u, lnl, rng_key,
+                                          jnp.float64(scale),
+                                          jnp.float64(lnz),
+                                          jnp.float64(ln_x), _consts),
+                        iteration_idx=int(it))
                 dead_u.append(np.asarray(du))
                 dead_lnl.append(np.asarray(dl))
+                if faults.fire("nested.nonfinite",
+                               iteration=int(it)) is not None:
+                    # poison one dead point: drives the counted
+                    # escalation + anomaly dump below
+                    dead_lnl[-1] = dead_lnl[-1].copy()
+                    dead_lnl[-1][0] = np.nan
             profiling.capture_tick()
             # the likelihood builders map NaN -> -inf (the oracle
             # corner contract), so the bad-dead-point test must be

@@ -38,9 +38,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..io.writers import atomic_write_json
+from ..io.writers import atomic_write_json, durable_replace
 from ..native import write_table
 from ..parallel.distributed import is_primary as _is_primary
+from ..resilience import faults
+from ..resilience.supervisor import (BlockSupervisor, PlatformDemotion,
+                                     apply_demotion,
+                                     preemption_requested)
 from ..utils import profiling, telemetry
 from ..utils.flightrec import flight_recorder
 from ..utils.logging import EvalRateMeter, get_logger
@@ -257,6 +261,13 @@ class PTSampler:
         self.use_maskstats = getattr(like, "param_blocks", None) \
             is not None
         self.mask_counts = np.zeros(3)
+        # supervised execution (resilience/supervisor.py): every device
+        # block and commit-side sync routes through this wrapper —
+        # watchdog, bounded retry, circuit-breaker demotion. With the
+        # watchdog off and no fault plan (the default) call() is a
+        # direct inline invocation: the block program and the host-sync
+        # pattern are byte-identical to the unsupervised path.
+        self._supervisor = BlockSupervisor("pt.dispatch")
         os.makedirs(outdir, exist_ok=True)
 
     # ---------------- initialization / resume -------------------------- #
@@ -335,7 +346,12 @@ class PTSampler:
             return
         tmp = self._ckpt_path + ".tmp.npz"
         np.savez(tmp, **payload)
-        os.replace(tmp, self._ckpt_path)
+        durable_replace(tmp, self._ckpt_path)
+        # injection site pt.ckpt fires AFTER the durable replace: a
+        # ``kill`` here is the clean checkpoint-boundary crash the
+        # resume-equivalence contract is tested against
+        faults.fire("pt.ckpt", path=self._ckpt_path,
+                    step=int(payload.get("step", -1)))
 
     # ewt: allow-host-sync — checkpoint resume: np.load hands back
     # host arrays; the pull happens once, before sampling restarts
@@ -904,15 +920,25 @@ class PTSampler:
          eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
          lam, cg_rows, kde_pts, kde_bw, temps_in) = placed
         with span("pt.dispatch", steps=todo):
-            out = self._block(
-                self._place(st.x, self._mat_shard),
-                self._place(st.lnl, self._vec_shard),
-                self._place(st.lnp, self._vec_shard),
-                self._place(st.key),
-                self._place(st.history), st.hist_len,
-                acc_in, sacc_in, sprop_in, fam_a_in, fam_p_in, mask_in,
-                eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
-                lam, cg_rows, kde_pts, kde_bw, temps_in, self._consts)
+            # supervised dispatch: retryable — an injected/transient
+            # error surfaces BEFORE the jit executes, so the thunk's
+            # inputs (including the donated leaves) are still live and
+            # re-invocation hits the same jit cache entry. A real
+            # failure that consumed donated buffers is non-retryable by
+            # construction: the retry errors non-transiently and the
+            # breaker demotes through the checkpoint/resume path.
+            out = self._supervisor.call(
+                lambda: self._block(
+                    self._place(st.x, self._mat_shard),
+                    self._place(st.lnl, self._vec_shard),
+                    self._place(st.lnp, self._vec_shard),
+                    self._place(st.key),
+                    self._place(st.history), st.hist_len,
+                    acc_in, sacc_in, sprop_in, fam_a_in, fam_p_in,
+                    mask_in, eigvecs, eigvals, chol, ind_mean, ind_L,
+                    ind_iL, lam, cg_rows, kde_pts, kde_bw, temps_in,
+                    self._consts),
+                step=int(st.step), block_steps=int(todo))
         # block-boundary bubble: host wall between the previous block's
         # results landing (device went idle) and this dispatch handing
         # the device new work
@@ -956,7 +982,24 @@ class PTSampler:
         if nf_steps is not None:
             leaves["nf_steps"] = nf_steps
         with span("pt.commit", steps=todo):
-            snap = host_snapshot(leaves)
+            # the commit sync is where a dead relay actually manifests
+            # (the dispatch above is async) — watchdog-supervised, but
+            # never retried: the donated inputs of a half-finished
+            # block cannot be reconstructed, so a failure here goes
+            # straight to the breaker and the checkpoint/resume path
+            snap = self._supervisor.call(
+                lambda: host_snapshot(leaves), retryable=False,
+                site="pt.commit", step=int(st.step))
+        spec = faults.fire("pt.nonfinite", step=int(st.step))
+        if spec is not None and spec.kind == "nonfinite":
+            # poison the committed snapshot: exercises the counted
+            # nonfinite_eval escalation + flight-recorder anomaly dump
+            # exactly as a genuinely bad evaluation would surface
+            snap["lnl"] = np.asarray(snap["lnl"]).copy()
+            snap["lnl"][0] = np.nan
+            if nf_steps is not None:
+                snap["nf_steps"] = np.asarray(snap["nf_steps"]).copy()
+                snap["nf_steps"][0] += 1
         self._t_ready = monotonic()
         self._last_sync_s = self._t_ready - t0
         self.host_sync_total_s += self._last_sync_s
@@ -1109,6 +1152,35 @@ class PTSampler:
         self._anneal_state = st
         return st
 
+    def _truncate_chain_to(self, step, thin, block_size):
+        """Resume repair: cut every chain file back to the rows the
+        checkpointed ``step`` accounts for (see the resume branch in
+        :meth:`_sample_impl`). Row accounting mirrors the emission
+        path: each committed block of ``b`` steps appended
+        ``ceil(b / thin) * nchains`` cold rows (hot-rung files emit the
+        same count per rung), and blocks are ``block_size`` long except
+        a final partial one."""
+        import glob as _glob
+
+        from .convergence import _robust_loadtxt
+        B = max(int(block_size), 1)
+        n_full, r = divmod(int(step), B)
+        want = self.nchains * (n_full * (-(-B // thin))
+                               + (-(-r // thin)))
+        for path in _glob.glob(os.path.join(self.outdir,
+                                            "chain_*.txt")):
+            raw, dropped = _robust_loadtxt(path)
+            nrows = raw.shape[0] if raw.size else 0
+            if not dropped and nrows <= want:
+                continue
+            _log.info("resume repair: truncating %s to %d rows "
+                      "(had %d%s)", os.path.basename(path), want,
+                      nrows, ", torn tail" if dropped else "")
+            if nrows == 0 or want == 0:
+                open(path, "w").close()
+            else:
+                write_table(path, raw[:want], append=False)
+
     # ---------------- telemetry ---------------------------------------- #
     def _block_diag(self, cs, diag_t):
         """Worst R-hat/ESS of one block's cold emission (throttled —
@@ -1167,6 +1239,15 @@ class PTSampler:
             st = self._load_state()
             if verbose:
                 _log.info("resuming from step %d", st.step)
+            # a kill between a block's chain append and its checkpoint
+            # (both deferred host work) leaves rows past the
+            # checkpointed step, which the resumed run regenerates —
+            # truncate to the checkpointed row count so kill-and-resume
+            # reproduces the uninterrupted chain bit-for-bit (mirrors
+            # the HMC resume repair). Torn partial lines are dropped by
+            # the robust loader either way.
+            if _is_primary():
+                self._truncate_chain_to(st.step, thin, block_size)
         else:
             st = self._fresh_state()
             # fresh run: truncate the cold chain and any stale hot-rung
@@ -1193,8 +1274,22 @@ class PTSampler:
         # execution and this loop reproduces the seed path exactly.
         from .devicestate import HostPipeline
         pipe = HostPipeline(enabled=self.device_state)
+        # circuit breaker: before demoting, the supervisor drains the
+        # pending deferred host work so the last committed block's
+        # checkpoint is durable on disk for the resume re-entry
+        self._supervisor.on_checkpoint = pipe.flush
         try:
             while st.step < nsamp:
+                if preemption_requested():
+                    # graceful preemption: the in-flight block was
+                    # finished and committed last iteration, its
+                    # checkpoint is in the deferred queue (flushed in
+                    # the finally) — stop cleanly; run_scope emits
+                    # run_end(reason="preempted")
+                    _log.warning("preemption requested: stopping at "
+                                 "step %d after a final checkpoint",
+                                 st.step)
+                    break
                 todo = int(min(block_size, nsamp - st.step))
                 sacc_before = np.asarray(st.swaps_accepted).copy()
                 sprop_before = np.asarray(st.swaps_proposed).copy()
@@ -1313,6 +1408,11 @@ class PTSampler:
             ], axis=1)
             if _is_primary():
                 write_table(chain_path, rows, append=True)
+                # injection site pt.chain fires AFTER the chain append
+                # and BEFORE the checkpoint: a ``kill`` here leaves
+                # rows ahead of the checkpoint — the artifact the
+                # resume-time truncation repair exists for
+                faults.fire("pt.chain", path=chain_path, step=step_now)
             if self.write_hot and _is_primary():
                 # reference PTMCMCSampler behavior (writeHotChains): one
                 # chain file per tempered rung. Row format matches the
@@ -1474,19 +1574,35 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
                            mc=8, seed=seed)
             opts["init_x"] = fit["samples"]
     opts.update(kw)
-    sampler = PTSampler(like, outdir, **opts)
-    if params is not None and getattr(
-            params, "anneal_init",
-            getattr(params, "sampler_kwargs", {}).get("anneal_init",
-                                                      False)):
-        # SMC-style tempered warm start (the pipeline-leg operating
-        # mode) from the paramfile: no-op on resume (checkpoint
-        # present), counters reset so the measurement starts clean
-        if verbose:
-            _log.info("anneal_init: tempered warm start")
-        sampler.anneal_init(verbose=verbose)
-    sampler.sample(nsamp, resume=resume, verbose=verbose, thin=thin)
-    return sampler
+    # demotion re-entry loop (resilience/supervisor.py): an in-process
+    # demotion (megakernel -> classic XLA) is applied by flipping the
+    # documented hatch and rebuilding the sampler, which resumes from
+    # its own checkpoint; anything deeper (forced-CPU) propagates to
+    # the CLI/driver for a process-level re-entry through the same
+    # resume path. Bounded by the ladder length — each pass moves down.
+    while True:
+        sampler = PTSampler(like, outdir, **opts)
+        if params is not None and getattr(
+                params, "anneal_init",
+                getattr(params, "sampler_kwargs", {}).get("anneal_init",
+                                                          False)):
+            # SMC-style tempered warm start (the pipeline-leg operating
+            # mode) from the paramfile: no-op on resume (checkpoint
+            # present), counters reset so the measurement starts clean
+            if verbose:
+                _log.info("anneal_init: tempered warm start")
+            sampler.anneal_init(verbose=verbose)
+        try:
+            sampler.sample(nsamp, resume=resume, verbose=verbose,
+                           thin=thin)
+        except PlatformDemotion as d:
+            if not apply_demotion(d):
+                raise
+            _log.warning("re-entering PT run on the %s path (resume "
+                         "from checkpoint)", d.to_level)
+            resume = True
+            continue
+        return sampler
 
 
 def _covm_from_csv(covm_df, param_names):

@@ -5,16 +5,27 @@ plugin over a relay; when the relay dies, device calls block forever on
 a futex inside the PJRT client — no error, no timeout.  Every consumer
 that might touch the device therefore probes it first **in a throwaway
 subprocess with a wall-clock timeout**, converting the hang into a clean
-False.  This module is the single Python implementation of that probe
+failure.  This module is the single Python implementation of that probe
 (``tools/device_measurements.sh`` keeps an equivalent shell one-liner);
-``bench.py`` and ``tools/north_star.py`` both use it so the recipe
-cannot drift between them.
+``bench.py``, ``tools/north_star.py`` and the resilience supervisor's
+circuit breaker all use it so the recipe cannot drift between them.
+
+A probe failure is never silent: the result carries a typed ``outcome``
+(``ok`` / ``timeout`` / ``exit`` / ``oserror``) and a human ``reason``
+including the subprocess's stderr tail, every probe increments
+``device_probe{outcome=}`` in the metrics registry, and failures are
+logged — so a campaign log explains *why* a leg ran on CPU fallback
+instead of just recording that it did.  Results are memoized per
+(env, require_accelerator) within the process: a dead tunnel costs one
+``timeout`` wait, not one per consumer (``refresh=True`` re-probes —
+the supervisor's post-hang re-probe must see the tunnel's CURRENT
+state, not the startup verdict).
 """
 
 import subprocess
 import sys
 
-__all__ = ["probe_device"]
+__all__ = ["probe_device", "ProbeResult"]
 
 _PROBE_CODE = (
     "import jax, jax.numpy as jnp;"
@@ -22,22 +33,86 @@ _PROBE_CODE = (
     "{check}print('ok')"
 )
 
+_STDERR_TAIL = 240
 
-def probe_device(timeout=60, env=None, require_accelerator=True):
-    """True iff a trivial jax computation completes within ``timeout``.
 
-    With ``require_accelerator`` (the default) the probe additionally
-    asserts the default backend is not CPU, so a session where the
-    plugin silently fell back to host does not count as "device up".
-    Pass ``env`` to probe the platform a specific subprocess would see
-    (e.g. a forced-CPU leg).
-    """
+class ProbeResult:
+    """Truthy iff the probe passed; carries the failure provenance."""
+
+    __slots__ = ("ok", "outcome", "reason")
+
+    def __init__(self, ok: bool, outcome: str, reason: str):
+        self.ok = bool(ok)
+        self.outcome = outcome      # ok | timeout | exit | oserror
+        self.reason = reason
+
+    def __bool__(self):
+        return self.ok
+
+    def __repr__(self):
+        return (f"ProbeResult(ok={self.ok}, outcome={self.outcome!r}, "
+                f"reason={self.reason!r})")
+
+
+_MEMO: dict = {}
+
+
+def _run_probe(timeout, env, require_accelerator) -> ProbeResult:
     check = ("assert jax.devices()[0].platform != 'cpu';"
              if require_accelerator else "")
     try:
         r = subprocess.run(
             [sys.executable, "-c", _PROBE_CODE.format(check=check)],
             env=env, timeout=timeout, capture_output=True)
-        return r.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+    except subprocess.TimeoutExpired:
+        return ProbeResult(
+            False, "timeout",
+            f"probe exceeded {timeout}s wall clock (device call hung "
+            f"— dead relay?)")
+    except OSError as exc:
+        return ProbeResult(False, "oserror",
+                           f"probe subprocess failed to start: {exc!r}")
+    if r.returncode == 0:
+        return ProbeResult(True, "ok", "probe passed")
+    tail = (r.stderr or b"").decode("utf-8", "replace").strip()
+    tail = tail[-_STDERR_TAIL:]
+    return ProbeResult(
+        False, "exit",
+        f"probe exited {r.returncode}"
+        + (f"; stderr tail: {tail}" if tail else ""))
+
+
+def probe_device(timeout=60, env=None, require_accelerator=True,
+                 refresh=False):
+    """Truthy :class:`ProbeResult` iff a trivial jax computation
+    completes within ``timeout`` seconds in a throwaway subprocess.
+
+    With ``require_accelerator`` (the default) the probe additionally
+    asserts the default backend is not CPU, so a session where the
+    plugin silently fell back to host does not count as "device up".
+    Pass ``env`` to probe the platform a specific subprocess would see
+    (e.g. a forced-CPU leg).  ``refresh`` bypasses the per-process
+    memo — use it when the device's *current* state matters (the
+    supervisor's post-hang re-probe).
+    """
+    key = (tuple(sorted(env.items())) if env is not None else None,
+           bool(require_accelerator))
+    if not refresh and key in _MEMO:
+        return _MEMO[key]
+    res = _run_probe(timeout, env, require_accelerator)
+    _MEMO[key] = res
+    # provenance is best-effort: tools/north_star.py loads this module
+    # standalone by file path (jax-import-free), where the package's
+    # telemetry/logging layers are unavailable
+    try:
+        from . import telemetry
+        from .logging import get_logger
+
+        telemetry.registry().counter("device_probe",
+                                     outcome=res.outcome).inc()
+        if not res.ok:
+            get_logger("ewt.deviceprobe").warning(
+                "device probe failed (%s): %s", res.outcome, res.reason)
+    except ImportError:
+        pass
+    return res

@@ -605,22 +605,48 @@ class RunRecorder:
         self._flush_every = flush_every
         self._flush_interval = flush_interval
         self._last_flush = time.time()
+        self._in_flush = False
+        self._ended = False
         if self.enabled:
             os.makedirs(run_dir, exist_ok=True)
             self._heal_torn_tail()
 
     def _heal_torn_tail(self):
-        """A process killed mid-write leaves the stream without a
-        trailing newline; a new session appending onto that torn tail
-        would weld its first event (the ``run_start``) onto the partial
-        line, losing both. Terminate the tail before appending."""
+        """A process killed mid-write leaves a partial final record
+        with no trailing newline; a new session appending onto that
+        torn tail would weld its first event (the ``run_start``) onto
+        the partial line, losing both. Truncate the torn record away —
+        it is unparseable garbage either way, and dropping it keeps
+        the resumed stream schema-clean (``tools/report.py --check``
+        exits 0 instead of flagging a malformed mid-stream line;
+        ``--repair`` is the offline equivalent for streams nothing
+        will resume)."""
         try:
             with open(self.path, "rb+") as fh:
                 fh.seek(0, os.SEEK_END)
-                if fh.tell() > 0:
-                    fh.seek(-1, os.SEEK_END)
-                    if fh.read(1) != b"\n":
-                        fh.write(b"\n")
+                size = fh.tell()
+                if size == 0:
+                    return
+                fh.seek(-1, os.SEEK_END)
+                if fh.read(1) == b"\n":
+                    return
+                # walk back in chunks to the last newline-terminated
+                # record (a torn record can exceed any single window —
+                # truncating to 0 on a miss would destroy every good
+                # record before it)
+                chunk = 1 << 16
+                end = size
+                keep = 0
+                while end > 0:
+                    start = max(end - chunk, 0)
+                    fh.seek(start)
+                    tail = fh.read(end - start)
+                    cut = tail.rfind(b"\n")
+                    if cut >= 0:
+                        keep = start + cut + 1
+                        break
+                    end = start
+                fh.truncate(keep)
         except FileNotFoundError:
             pass
         except OSError:
@@ -642,14 +668,31 @@ class RunRecorder:
             self.flush()
 
     def flush(self):
-        if not self._buf or not self.enabled:
+        if not self._buf or not self.enabled or self._in_flush:
             return
+        # fault-injection site ``events.flush`` (resilience harness):
+        # ``torn``/``kill`` specs truncate the payload mid-record — the
+        # documented kill-mid-append crash artifact. The re-entrancy
+        # guard keeps the injection's own ``fault`` event (appended via
+        # this recorder) from recursing back into flush.
+        self._in_flush = True
+        try:
+            from ..resilience import faults
+            spec = faults.fire("events.flush", write=True,
+                               path=self.path)
+        finally:
+            self._in_flush = False
         payload = "\n".join(self._buf) + "\n"
         self._buf = []
         self._last_flush = time.time()
+        if spec is not None and spec.kind in ("torn", "kill"):
+            payload = faults.torn_bytes(spec, payload)
         try:
             with open(self.path, "a") as fh:
                 fh.write(payload)
+                if spec is not None and spec.kind == "kill":
+                    fh.flush()
+                    faults.kill_now(spec)
         except OSError as exc:
             # telemetry must never kill a run: a full disk / dead mount
             # under the run dir degrades the recorder to a no-op (events
@@ -690,9 +733,13 @@ class RunRecorder:
         self.event("checkpoint", **fields)
 
     def run_end(self, **fields):
-        """``run_end``: status + final metrics-registry snapshot."""
-        if not self.enabled:
+        """``run_end``: status + final metrics-registry snapshot.
+        Idempotent — the preemption path emits it early (the clean
+        ``reason="preempted"`` record must precede the flight-recorder
+        dump) and the scope teardown must not emit a second one."""
+        if not self.enabled or self._ended:
             return
+        self._ended = True
         fields.setdefault("metrics", _REGISTRY.snapshot())
         self.event("run_end", **fields)
         self.flush()
@@ -734,6 +781,17 @@ def _is_primary() -> bool:
         return is_primary()
     except Exception:   # noqa: BLE001 — never let telemetry kill a run
         return True
+
+
+def _preempted() -> bool:
+    """Whether a graceful preemption (SIGTERM) was requested this
+    process — lazily imported so telemetry stays standalone."""
+    try:
+        from ..resilience.supervisor import preemption_requested
+
+        return preemption_requested()
+    except Exception:   # noqa: BLE001 — never let telemetry kill a run
+        return False
 
 
 @contextlib.contextmanager
@@ -787,6 +845,21 @@ def run_scope(run_dir: str | None, **start_fields):
                 flight_recorder().anomaly(
                     "run_scope_error", run_dir=run_dir,
                     once_key=f"run_scope_error:{run_dir}")
+            except Exception:   # noqa: BLE001
+                pass
+        elif _preempted():
+            # graceful preemption (SIGTERM, resilience.supervisor): the
+            # samplers finished their in-flight block and checkpointed;
+            # the contract is a CLEAN run_end(reason="preempted")
+            # FIRST, then the flight-recorder ring dump — both while
+            # this recorder is still active so each lands in the stream
+            rec.run_end(status=status, reason="preempted")
+            try:
+                from .flightrec import flight_recorder
+
+                flight_recorder().anomaly(
+                    "preempted", run_dir=run_dir,
+                    once_key=f"preempted:{run_dir}")
             except Exception:   # noqa: BLE001
                 pass
         _ACTIVE.remove(rec)

@@ -1,0 +1,604 @@
+"""Resilience layer: fault injection, supervised dispatch, demotion,
+kill-and-resume equivalence, and graceful preemption.
+
+The expensive contracts run as REAL subprocesses — a SIGKILL at a
+fault-plan-chosen site, then restart-and-resume — because that is the
+production recovery path: torn writes, stale checkpoints, and the
+events-stream heal all only exist across a process boundary.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.resilience import faults, supervisor
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends with no fault plan and no pending
+    preemption — process-global state must never leak across tests."""
+    faults.install_plan(None)
+    supervisor._PREEMPT.clear()
+    yield
+    faults.install_plan(None)
+    supervisor._PREEMPT.clear()
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"ewt_tool_{name}", str(REPO_ROOT / "tools" / f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def tiny_like():
+    from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                            build_pulsar_likelihood)
+    from enterprise_warp_tpu.sim import inject_white, make_fake_pulsar
+    psr = make_fake_pulsar(ntoa=60, backends=("RX",), toaerr_us=1.0,
+                           seed=1)
+    inject_white(psr, efac={"RX": 1.2}, rng=np.random.default_rng(1))
+    m = StandardModels(psr=psr)
+    return build_pulsar_likelihood(
+        psr, TermList(psr, [m.efac("by_backend")]))
+
+
+@pytest.fixture(scope="module")
+def like():
+    return tiny_like()
+
+
+# ------------------------------------------------------------------ #
+#  fault plan                                                         #
+# ------------------------------------------------------------------ #
+
+class TestFaultPlan:
+    def test_inert_without_plan(self):
+        assert faults.plan() is None
+        assert faults.fire("pt.dispatch") is None
+
+    def test_env_parsing_and_occurrence_matching(self, monkeypatch):
+        monkeypatch.setenv("EWT_FAULT_PLAN", json.dumps(
+            {"faults": [{"site": "a", "kind": "error", "at": 2,
+                         "count": 2}]}))
+        monkeypatch.setattr(faults, "_PLAN", False)   # re-read env
+        assert faults.fire("a") is None               # occurrence 1
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("a")                          # occurrence 2
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("a")                          # occurrence 3
+        assert faults.fire("a") is None               # past the window
+        assert faults.plan().occurrences("a") == 4
+
+    def test_where_filter_and_counter(self):
+        faults.install_plan({"faults": [
+            {"site": "w", "kind": "torn", "where": "mask_stats"}]})
+        assert faults.fire("w", path="/x/chain_1.txt") is None
+        spec = faults.fire("w", path="/x/mask_stats.json")
+        assert spec is not None and spec.kind == "torn"
+        from enterprise_warp_tpu.utils import telemetry
+        snap = telemetry.registry().snapshot()["counters"]
+        assert snap.get("fault_injected{site=w}", 0) >= 1
+
+    def test_schema_rejects_unknowns(self):
+        with pytest.raises(ValueError):
+            faults.FaultPlan.from_json(
+                {"faults": [{"site": "x", "kind": "melt"}]})
+        with pytest.raises(ValueError):
+            faults.FaultPlan.from_json(
+                {"faults": [{"site": "x", "kind": "error",
+                             "banana": 1}]})
+
+    def test_torn_bytes_truncates(self):
+        spec = faults.FaultSpec(site="s", kind="torn", frac=0.5)
+        assert faults.torn_bytes(spec, b"0123456789") == b"01234"
+        assert faults.torn_bytes(spec, "ab") == "a"
+        assert faults.torn_bytes(spec, "") == ""
+
+
+# ------------------------------------------------------------------ #
+#  supervisor                                                         #
+# ------------------------------------------------------------------ #
+
+class TestSupervisor:
+    def test_inline_fast_path_when_unarmed(self):
+        sup = supervisor.BlockSupervisor("s", watchdog_s=0)
+        assert not sup.supervised()
+        assert sup.call(lambda: 41 + 1) == 42
+        assert sup.calls == 0        # not even counted: pure inline
+
+    def test_retry_then_success_counts_a_strike(self):
+        faults.install_plan({"faults": [
+            {"site": "s", "kind": "error", "at": 1, "count": 2}]})
+        sup = supervisor.BlockSupervisor("s", retries=3,
+                                         backoff_s=0.001)
+        assert sup.call(lambda: "ok") == "ok"
+        assert sup.strikes == 1
+        from enterprise_warp_tpu.utils import telemetry
+        snap = telemetry.registry().snapshot()["counters"]
+        assert snap.get("dispatch_retry{site=s}", 0) >= 2
+
+    def test_retry_exhaustion_demotes_with_checkpoint(self):
+        faults.install_plan({"faults": [
+            {"site": "s", "kind": "error"}]})      # every occurrence
+        flushed = []
+        sup = supervisor.BlockSupervisor(
+            "s", retries=1, backoff_s=0.001,
+            on_checkpoint=lambda: flushed.append(1))
+        with pytest.raises(supervisor.PlatformDemotion) as ei:
+            sup.call(lambda: "never")
+        assert flushed == [1]
+        assert ei.value.from_level == "cpu"       # CPU suite = bottom
+        assert ei.value.to_level is None
+        assert isinstance(ei.value.cause, faults.InjectedFault)
+
+    def test_watchdog_converts_hang_into_demotion(self):
+        faults.install_plan({"faults": [
+            {"site": "h", "kind": "hang", "at": 1, "hang_s": 30}]})
+        sup = supervisor.BlockSupervisor("h", watchdog_s=0.2,
+                                         retries=0)
+        t0 = time.monotonic()
+        with pytest.raises(supervisor.PlatformDemotion) as ei:
+            sup.call(lambda: 1)
+        assert time.monotonic() - t0 < 10         # not the 30 s sleep
+        assert isinstance(ei.value.cause, supervisor.DispatchHang)
+        from enterprise_warp_tpu.utils import telemetry
+        snap = telemetry.registry().snapshot()["counters"]
+        assert snap.get("dispatch_hang{site=h}", 0) >= 1
+        assert any(k.startswith("demotion{") for k in snap)
+
+    def test_non_transient_errors_propagate_unwrapped(self):
+        faults.install_plan({"faults": []})   # armed -> supervised path
+        sup = supervisor.BlockSupervisor("s", retries=3,
+                                         backoff_s=0.001)
+
+        def boom():
+            raise ValueError("shape mismatch")
+        with pytest.raises(ValueError):
+            sup.call(boom)
+
+    def test_non_transient_error_on_retry_demotes(self):
+        """A retry re-invocation that fails non-transiently (e.g. a
+        donating dispatch whose buffers the first attempt consumed)
+        must exit through the breaker's checkpoint/resume path, not
+        crash raw with no checkpoint."""
+        faults.install_plan({"faults": [
+            {"site": "s", "kind": "error", "at": 1}]})
+        flushed = []
+        calls = []
+        sup = supervisor.BlockSupervisor(
+            "s", retries=3, backoff_s=0.001,
+            on_checkpoint=lambda: flushed.append(1))
+
+        def thunk():
+            calls.append(1)
+            raise RuntimeError("donated buffer was deleted")
+        with pytest.raises(supervisor.PlatformDemotion) as ei:
+            sup.call(thunk)
+        assert calls == [1]            # the one retry re-invocation
+        assert flushed == [1]          # checkpoint flushed pre-demotion
+        assert isinstance(ei.value.cause, RuntimeError)
+
+    def test_backoff_jitter_is_process_stable(self):
+        import zlib
+        expect = (zlib.crc32(b"s:1:1") % 1000) / 1000.0
+        assert 0.0 <= expect < 1.0     # pins the crc recipe, not hash()
+
+    def test_ladder_and_apply_demotion(self, monkeypatch):
+        assert supervisor.current_level() == "cpu"    # CPU-only suite
+        assert supervisor.next_level("mega") == "classic"
+        assert supervisor.next_level("classic") == "cpu"
+        assert supervisor.next_level("cpu") is None
+        monkeypatch.delenv("EWT_PALLAS", raising=False)
+        d = supervisor.PlatformDemotion("mega", "classic", "s")
+        assert supervisor.apply_demotion(d)
+        assert os.environ["EWT_PALLAS"] == "0"
+        monkeypatch.delenv("EWT_PALLAS", raising=False)
+        assert not supervisor.apply_demotion(
+            supervisor.PlatformDemotion("classic", "cpu", "s"))
+
+
+# ------------------------------------------------------------------ #
+#  deviceprobe provenance                                             #
+# ------------------------------------------------------------------ #
+
+class TestDeviceProbe:
+    def test_reason_memo_and_counter(self, monkeypatch):
+        from enterprise_warp_tpu.utils import deviceprobe, telemetry
+        monkeypatch.setattr(deviceprobe, "_MEMO", {})
+        calls = []
+
+        def fake_run(*a, **k):
+            calls.append(1)
+
+            class R:
+                returncode = 1
+                stderr = b"AssertionError: no accelerator\n"
+            return R()
+        monkeypatch.setattr(deviceprobe.subprocess, "run", fake_run)
+        res = deviceprobe.probe_device(timeout=5)
+        assert not res
+        assert res.outcome == "exit"
+        assert "AssertionError" in res.reason
+        # memoized: a second consumer pays nothing
+        assert not deviceprobe.probe_device(timeout=5)
+        assert len(calls) == 1
+        # refresh re-probes (the supervisor's post-hang contract)
+        deviceprobe.probe_device(timeout=5, refresh=True)
+        assert len(calls) == 2
+        snap = telemetry.registry().snapshot()["counters"]
+        assert snap.get("device_probe{outcome=exit}", 0) >= 2
+
+    def test_timeout_outcome(self, monkeypatch):
+        from enterprise_warp_tpu.utils import deviceprobe
+        monkeypatch.setattr(deviceprobe, "_MEMO", {})
+
+        def fake_run(*a, **k):
+            raise subprocess.TimeoutExpired(cmd="x", timeout=5)
+        monkeypatch.setattr(deviceprobe.subprocess, "run", fake_run)
+        res = deviceprobe.probe_device(timeout=5)
+        assert res.outcome == "timeout"
+        assert "hung" in res.reason
+
+
+# ------------------------------------------------------------------ #
+#  stream heal / repair                                               #
+# ------------------------------------------------------------------ #
+
+class TestStreamRepair:
+    def test_recorder_heal_truncates_torn_tail(self, tmp_path):
+        from enterprise_warp_tpu.utils.telemetry import RunRecorder
+        p = tmp_path / "events.jsonl"
+        good = json.dumps({"t": 1.0, "type": "heartbeat"})
+        p.write_text(good + "\n" + '{"t": 2.0, "ty')   # torn tail
+        RunRecorder(str(tmp_path))
+        assert p.read_text() == good + "\n"
+
+    def test_report_repair_then_check_clean(self, tmp_path, capsys):
+        report = _load_tool("report")
+        p = tmp_path / "events.jsonl"
+        rows = [json.dumps({"t": float(i), "type": "heartbeat"})
+                for i in range(3)]
+        p.write_text("\n".join(rows) + "\n" + '{"t": 9.9, "type": "he')
+        assert report.main([str(p), "--check"]) == 1   # torn = dirty
+        assert report.main([str(p), "--repair", "--check"]) == 0
+        assert p.read_text() == "\n".join(rows) + "\n"
+        # idempotent on a clean stream
+        assert report.main([str(p), "--repair", "--check"]) == 0
+
+    def test_recorder_heal_survives_oversized_torn_tail(self,
+                                                        tmp_path):
+        """A torn final record larger than the heal's 64 KiB scan
+        window must not take the good records before it down with it."""
+        from enterprise_warp_tpu.utils.telemetry import RunRecorder
+        p = tmp_path / "events.jsonl"
+        good = json.dumps({"t": 1.0, "type": "heartbeat"})
+        torn = '{"t": 2.0, "type": "anomaly", "pad": "' + "x" * (1 << 17)
+        p.write_text(good + "\n" + torn)
+        RunRecorder(str(tmp_path))
+        assert p.read_text() == good + "\n"
+
+    def test_repair_terminates_newline_less_valid_record(self,
+                                                         tmp_path):
+        """A kill can land exactly between a record's last byte and
+        its newline: --repair must append the terminator so the
+        resume-time heal does not drop the valid record."""
+        report = _load_tool("report")
+        p = tmp_path / "events.jsonl"
+        good = json.dumps({"t": 1.0, "type": "heartbeat"})
+        last = json.dumps({"t": 2.0, "type": "checkpoint"})
+        p.write_bytes((good + "\n" + last).encode())
+        report.repair_stream(str(p), out=open(os.devnull, "w"))
+        assert p.read_bytes() == (good + "\n" + last + "\n").encode()
+        from enterprise_warp_tpu.utils.telemetry import RunRecorder
+        RunRecorder(str(tmp_path))     # heal now keeps both records
+        assert p.read_bytes() == (good + "\n" + last + "\n").encode()
+
+    def test_events_flush_torn_injection(self, tmp_path):
+        from enterprise_warp_tpu.utils.telemetry import RunRecorder
+        rec = RunRecorder(str(tmp_path))
+        for i in range(5):
+            rec.event("heartbeat", step=i)
+        faults.install_plan({"faults": [
+            {"site": "events.flush", "kind": "torn", "at": 1,
+             "frac": 0.5}]})
+        rec.flush()
+        faults.install_plan(None)
+        report = _load_tool("report")
+        path = str(tmp_path / "events.jsonl")
+        assert report.check_stream(path, out=open(os.devnull, "w")) > 0
+        report.repair_stream(path, out=open(os.devnull, "w"))
+        assert report.check_stream(path, out=open(os.devnull, "w")) \
+            == 0
+
+
+# ------------------------------------------------------------------ #
+#  probe-ladder injection                                             #
+# ------------------------------------------------------------------ #
+
+def test_cholfuse_probe_transient_injection(monkeypatch):
+    from enterprise_warp_tpu.ops import cholfuse
+    monkeypatch.setattr(cholfuse, "_PROBE_RESULT", None)
+    monkeypatch.setattr(cholfuse, "_PROBE_REASON", "not probed")
+    monkeypatch.setattr(cholfuse, "_PROBE_TRANSIENTS", 0)
+    faults.install_plan({"faults": [
+        {"site": "cholfuse.probe", "kind": "error", "at": 1}]})
+    assert cholfuse.pallas_chol_available() is False
+    st = cholfuse.probe_status()
+    assert "transient" in (st.get("reason") or "")
+    # transient does NOT pin the verdict: the next call re-probes
+    assert cholfuse._PROBE_RESULT is None
+
+
+# ------------------------------------------------------------------ #
+#  in-process sampler integration                                     #
+# ------------------------------------------------------------------ #
+
+class TestSamplerIntegration:
+    def _run_pt(self, like, outdir, **kw):
+        from enterprise_warp_tpu.samplers import PTSampler
+        s = PTSampler(like, str(outdir), ntemps=2, nchains=4, seed=0,
+                      cov_update=30, **kw)
+        s.sample(90, resume=False, verbose=False)
+        return (outdir / "chain_1.txt").read_text()
+
+    def test_injected_dispatch_error_is_retried_bit_equal(
+            self, like, tmp_path):
+        ref = self._run_pt(like, tmp_path / "ref")
+        faults.install_plan({"faults": [
+            {"site": "pt.dispatch", "kind": "error", "at": 2}]})
+        got = self._run_pt(like, tmp_path / "flaky")
+        assert got == ref
+
+    def test_threaded_watchdog_is_transparent(self, like, tmp_path,
+                                              monkeypatch):
+        ref = self._run_pt(like, tmp_path / "ref")
+        # a generous watchdog arms the threaded path on every block;
+        # the produced chain must be bit-identical to the inline one
+        monkeypatch.setenv("EWT_WATCHDOG_S", "120")
+        got = self._run_pt(like, tmp_path / "watched")
+        assert got == ref
+
+    def test_nonfinite_injection_dumps_anomaly(self, like, tmp_path,
+                                               monkeypatch):
+        from enterprise_warp_tpu.utils import flightrec, telemetry
+        monkeypatch.setenv("EWT_FLIGHTREC", "1")
+        monkeypatch.setattr(flightrec, "_RECORDER", None)
+        faults.install_plan({"faults": [
+            {"site": "pt.nonfinite", "kind": "nonfinite", "at": 2}]})
+        self._run_pt(like, tmp_path / "nf")
+        telemetry.set_flight_hook(None)
+        dump = tmp_path / "nf" / "anomaly" / "anomaly.json"
+        assert dump.exists()
+        doc = json.loads(dump.read_text())
+        assert doc["reason"] == "nonfinite_eval"
+        snap = telemetry.registry().snapshot()["counters"]
+        assert snap.get("nonfinite_eval{where=block}", 0) >= 1
+
+    def test_preemption_stops_at_block_boundary(self, like, tmp_path):
+        from enterprise_warp_tpu.samplers import PTSampler
+        s = PTSampler(like, str(tmp_path), ntemps=2, nchains=4,
+                      seed=0, cov_update=30)
+        supervisor.request_preemption()
+        st = s.sample(90, resume=False, verbose=False)
+        assert st.step == 0            # stopped before the first block
+        supervisor._PREEMPT.clear()
+        events = [json.loads(ln) for ln in
+                  (tmp_path / "events.jsonl").read_text().splitlines()]
+        end = [e for e in events if e["type"] == "run_end"]
+        assert len(end) == 1 and end[0].get("reason") == "preempted"
+
+
+# ------------------------------------------------------------------ #
+#  kill-and-resume equivalence (real subprocesses)                    #
+# ------------------------------------------------------------------ #
+
+CHILD_PRELUDE = """\
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                        build_pulsar_likelihood)
+from enterprise_warp_tpu.sim import inject_white, make_fake_pulsar
+
+psr = make_fake_pulsar(ntoa=60, backends=("RX",), toaerr_us=1.0,
+                       seed=1)
+inject_white(psr, efac={{"RX": 1.2}}, rng=np.random.default_rng(1))
+m = StandardModels(psr=psr)
+like = build_pulsar_likelihood(psr,
+                               TermList(psr, [m.efac("by_backend")]))
+outdir = sys.argv[1]
+"""
+
+PT_BODY = """\
+from enterprise_warp_tpu.samplers import PTSampler
+s = PTSampler(like, outdir, ntemps=2, nchains=4, seed=0,
+              cov_update=30)
+s.sample(90, resume=True, verbose=False)
+"""
+
+HMC_BODY = """\
+from enterprise_warp_tpu.samplers.hmc import HMCSampler
+s = HMCSampler(like, outdir, nchains=8, seed=0, warmup=20,
+               n_leapfrog=4)
+s.sample(80, resume=True, verbose=False, block_size=20)
+"""
+
+NESTED_BODY = """\
+from enterprise_warp_tpu.samplers.nested import run_nested
+run_nested(like, outdir=outdir, nlive=40, kbatch=8, nsteps=5,
+           dlogz=0.5, seed=0, checkpoint_every=5, label="r",
+           verbose=False)
+"""
+
+
+def _child_env(plan=None):
+    env = dict(os.environ)
+    env.pop("EWT_FAULT_PLAN", None)
+    if plan is not None:
+        env["EWT_FAULT_PLAN"] = json.dumps(plan)
+    return env
+
+
+def _drive_to_completion(script, outdir, plan, max_attempts=5):
+    """First attempt runs under ``plan`` (and is expected to die);
+    later attempts resume clean until exit 0. Returns attempts used."""
+    for attempt in range(1, max_attempts + 1):
+        r = subprocess.run(
+            [sys.executable, str(script), str(outdir)],
+            env=_child_env(plan if attempt == 1 else None),
+            timeout=300, capture_output=True)
+        if r.returncode == 0:
+            return attempt
+        assert r.returncode < 0, (
+            f"child died with exit {r.returncode}, not a signal:\n"
+            + r.stderr.decode("utf-8", "replace")[-2000:])
+    raise AssertionError("campaign never completed")
+
+
+@pytest.mark.parametrize("body,plan,artifact", [
+    (PT_BODY,
+     {"faults": [{"site": "pt.ckpt", "kind": "kill", "at": 1}]},
+     "chain_1.txt"),
+    (PT_BODY,
+     {"faults": [{"site": "pt.chain", "kind": "kill", "at": 2}]},
+     "chain_1.txt"),
+    (HMC_BODY,
+     {"faults": [{"site": "hmc.ckpt", "kind": "kill", "at": 2}]},
+     "chain_1.txt"),
+    (NESTED_BODY,
+     {"faults": [{"site": "nested.ckpt", "kind": "kill", "at": 1}]},
+     "r_result.json"),
+], ids=["pt-ckpt-kill", "pt-chain-kill", "hmc-ckpt-kill",
+        "nested-ckpt-kill"])
+def test_kill_and_resume_reproduces_uninterrupted(tmp_path, body, plan,
+                                                  artifact):
+    script = tmp_path / "child.py"
+    script.write_text(CHILD_PRELUDE.format(repo=str(REPO_ROOT)) + body)
+
+    ref_dir = tmp_path / "ref"
+    r = subprocess.run([sys.executable, str(script), str(ref_dir)],
+                       env=_child_env(), timeout=300,
+                       capture_output=True)
+    assert r.returncode == 0, r.stderr.decode("utf-8", "replace")[-2000:]
+
+    chaos_dir = tmp_path / "chaos"
+    attempts = _drive_to_completion(script, chaos_dir, plan)
+    assert attempts >= 2        # the kill actually happened
+
+    ref = (ref_dir / artifact).read_bytes()
+    got = (chaos_dir / artifact).read_bytes()
+    assert got == ref
+
+    # the resumed stream healed its torn tail: schema-check clean
+    report = _load_tool("report")
+    ev = chaos_dir / "events.jsonl"
+    if ev.exists():
+        assert report.check_stream(str(ev),
+                                   out=open(os.devnull, "w")) == 0
+
+
+def test_cli_sigterm_preempts_cleanly(tmp_path):
+    """Kill-and-inspect: SIGTERM a live CLI run; it must finish the
+    in-flight block, checkpoint, and emit run_end(reason="preempted")
+    before the flight-recorder dump — then resume on rerun."""
+    chaos = _load_tool("chaos")
+    chaos.make_dataset(str(tmp_path), seed=0)
+    pr = chaos.write_prfile(str(tmp_path), "run.dat", "out", 20000, 50)
+    env = _child_env()
+    env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep \
+        + env.get("PYTHONPATH", "")
+    env["EWT_FLIGHTREC"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "enterprise_warp_tpu.cli",
+         "--prfile", pr, "--num", "0"],
+        cwd=str(tmp_path), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE)
+    # wait for sampling to actually start (first chain rows on disk)
+    deadline = time.monotonic() + 240
+    chain = None
+    import glob as _glob
+    while time.monotonic() < deadline:
+        hits = _glob.glob(str(tmp_path / "out" / "**" / "chain_1.txt"),
+                          recursive=True)
+        if hits and os.path.getsize(hits[0]) > 0:
+            chain = hits[0]
+            break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.5)
+    assert chain is not None, (
+        "sampling never started: "
+        + proc.stderr.peek().decode("utf-8", "replace")[-2000:]
+        if proc.poll() is not None else "no chain rows before deadline")
+    proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=120)
+    assert proc.returncode == 0, err.decode("utf-8", "replace")[-2000:]
+    outdir = os.path.dirname(chain)
+    assert os.path.exists(os.path.join(outdir, "state.npz"))
+    events = [json.loads(ln) for ln in
+              open(os.path.join(outdir, "events.jsonl"))]
+    ends = [e for e in events if e["type"] == "run_end"]
+    assert len(ends) == 1
+    assert ends[0].get("reason") == "preempted"
+    # the preemption ring dump landed AFTER the clean run_end
+    anomalies = [i for i, e in enumerate(events)
+                 if e["type"] == "anomaly"
+                 and e.get("reason") == "preempted"]
+    assert anomalies and anomalies[0] > events.index(ends[0])
+
+
+def test_atomic_write_kill_preserves_previous_content(tmp_path):
+    """A SIGKILL mid atomic_write_json (after the partial tmp write,
+    before the rename) must leave the previous artifact intact — the
+    crash window the fsync+rename contract exists for."""
+    target = tmp_path / "artifact.json"
+    target.write_text('{"generation": 1}')
+    script = tmp_path / "child.py"
+    script.write_text(
+        "import os, sys\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        f"sys.path.insert(0, {str(REPO_ROOT)!r})\n"
+        "from enterprise_warp_tpu.io.writers import atomic_write_json\n"
+        f"atomic_write_json({str(target)!r}, "
+        "{'generation': 2, 'pad': list(range(200))})\n")
+    env = _child_env({"faults": [
+        {"site": "io.atomic_json", "kind": "kill", "at": 1,
+         "frac": 0.5}]})
+    r = subprocess.run([sys.executable, str(script)], env=env,
+                       timeout=120, capture_output=True)
+    assert r.returncode == -signal.SIGKILL
+    assert json.loads(target.read_text()) == {"generation": 1}
+
+
+@pytest.mark.slow
+def test_chaos_soak_smoke(tmp_path):
+    """The seeded chaos storm end-to-end (small campaign): >=3 kills,
+    >=2 dispatch faults, 1 hang; bit-equal recovery; clean stream."""
+    chaos = _load_tool("chaos")
+    out = tmp_path / "CHAOS.json"
+    rc = chaos.main(["--seed", "0", "--nsamp", "300", "--blocks", "3",
+                     "--workdir", str(tmp_path / "wd"),
+                     "--output", str(out)])
+    rec = json.loads(out.read_text())
+    assert rc == 0, rec
+    assert rec["pass"] and rec["bit_equal"]
+    assert rec["counts"]["kills"] >= 3
+    assert rec["counts"]["dispatch_faults"] >= 2
+    assert rec["counts"]["hangs"] >= 1

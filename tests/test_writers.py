@@ -113,3 +113,43 @@ def test_atomic_write_json(tmp_path):
                               TypeError("nope")))
     assert json.load(open(path)) == {"b": 2}
     assert not os.path.exists(path + ".tmp")
+
+
+def test_atomic_write_json_torn_injection(tmp_path):
+    """Torn-write regression via the resilience fault harness: a
+    ``torn`` spec produces a truncated artifact (the short-write
+    fixture consumers must survive), while the fsync+rename path keeps
+    a non-faulted rewrite fully atomic afterwards."""
+    from enterprise_warp_tpu.io.writers import atomic_write_json
+    from enterprise_warp_tpu.resilience import faults
+
+    path = str(tmp_path / "artifact.json")
+    atomic_write_json(path, {"gen": 1})
+    faults.install_plan({"faults": [
+        {"site": "io.atomic_json", "kind": "torn", "at": 1,
+         "frac": 0.5}]})
+    try:
+        atomic_write_json(path, {"gen": 2, "pad": list(range(50))})
+    finally:
+        faults.install_plan(None)
+    raw = open(path).read()
+    with pytest.raises(ValueError):
+        json.loads(raw)           # genuinely torn on disk
+    # un-faulted write repairs the artifact in place, atomically
+    atomic_write_json(path, {"gen": 3})
+    assert json.load(open(path)) == {"gen": 3}
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_durable_replace_and_dir_fsync(tmp_path):
+    """durable_replace fsyncs the source and the directory and leaves
+    exactly the renamed entry (platform-tolerant: a refused directory
+    fsync must not raise)."""
+    from enterprise_warp_tpu.io.writers import durable_replace
+
+    tmp = tmp_path / "x.tmp"
+    dst = tmp_path / "x.json"
+    tmp.write_text("{}")
+    durable_replace(str(tmp), str(dst))
+    assert dst.read_text() == "{}"
+    assert not tmp.exists()

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Chaos soak: an end-to-end campaign under a seeded fault storm.
+
+Runs a small self-contained PTMCMC campaign twice — once uninterrupted
+(the reference), once under a randomized-but-seeded storm of injected
+process kills, transient dispatch errors, a dispatch hang, and a torn
+event-stream write (the resilience harness, ``EWT_FAULT_PLAN``) — and
+asserts the recovered campaign is **bit-equal** to the uninterrupted
+one, with every fault visible in telemetry and zero torn artifacts
+(``tools/report.py --check`` exits 0). The verdict is written to
+``CHAOS.json``, the robustness counterpart of the BENCH artifacts.
+
+Usage::
+
+    python tools/chaos.py --seed 0                 # full soak
+    python tools/chaos.py --seed 0 --nsamp 300 --blocks 3   # smoke
+    python tools/chaos.py --seed 0 --workdir /tmp/chaos --keep
+
+Each campaign leg is a real ``enterprise_warp_tpu.cli`` subprocess, so
+kills are real SIGKILLs (torn writes and stale checkpoints included)
+and the recovery path is the production one: restart + resume from the
+checkpoint, with the supervisor's watchdog converting the injected
+hang into a circuit-breaker demotion (exit 75 -> restart).
+"""
+
+import argparse
+import filecmp
+import glob
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import ensure_repo_path                  # noqa: E402
+
+REPO = ensure_repo_path()
+
+MAX_ATTEMPTS = 12
+
+
+def make_dataset(workdir, seed):
+    """A tiny deterministic single-pulsar dataset + noise model +
+    paramfiles (the verify-skill self-contained recipe)."""
+    import numpy as np
+
+    from enterprise_warp_tpu.io.writers import save_pulsar_pair
+    from enterprise_warp_tpu.sim import inject_white, make_fake_pulsar
+
+    psr = make_fake_pulsar(ntoa=80, backends=("RX",), toaerr_us=1.0,
+                           seed=seed + 100)
+    inject_white(psr, efac={"RX": 1.3},
+                 rng=np.random.default_rng(seed + 101))
+    save_pulsar_pair(psr, os.path.join(workdir, "data"))
+    with open(os.path.join(workdir, "nm.json"), "w") as fh:
+        json.dump({"universal": {"efac": "by_backend"}}, fh)
+
+
+def write_prfile(workdir, name, out, nsamp, cov_update):
+    path = os.path.join(workdir, name)
+    with open(path, "w") as fh:
+        fh.write(
+            "paramfile_label: chaos\n"
+            "datadir: data/\n"
+            f"out: {out}/\n"
+            "array_analysis: False\n"
+            "sampler: ptmcmcsampler\n"
+            "SCAMweight: 30\nAMweight: 15\nDEweight: 50\n"
+            f"nsamp: {nsamp}\n"
+            f"covUpdate: {cov_update}\n"
+            "{0}\n"
+            "noise_model_file: nm.json\n")
+    return path
+
+
+def run_leg(workdir, prfile, plan=None, watchdog_s=0.0, timeout=600):
+    """One CLI subprocess; returns its returncode (negative = killed
+    by that signal)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["EWT_FLIGHTREC"] = "1"
+    env["EWT_DEMOTION_EXEC"] = "0"   # the driver owns the restarts
+    env.pop("EWT_FAULT_PLAN", None)
+    if plan is not None:
+        env["EWT_FAULT_PLAN"] = json.dumps(plan)
+    env["EWT_WATCHDOG_S"] = str(watchdog_s)
+    r = subprocess.run(
+        [sys.executable, "-m", "enterprise_warp_tpu.cli",
+         "--prfile", prfile, "--num", "0"],
+        cwd=workdir, env=env, timeout=timeout, capture_output=True)
+    return r.returncode, r.stderr.decode("utf-8", "replace")[-2000:]
+
+
+def build_storm(rng, blocks):
+    """The seeded storm schedule: one plan per attempt. Guarantees (by
+    construction, not by luck) >= 1 hang, >= 2 transient dispatch
+    errors, and >= 3 kills across the campaign for any ``blocks >= 3``
+    — the hang first (it consumes no sampling progress), then
+    block-boundary kills whose occurrence indices are drawn only from
+    the range earlier legs can be proven to leave behind (a kill
+    scheduled past the campaign's remaining blocks would silently
+    never fire and the storm would complete under-strength), and the
+    torn event-stream kill last (the run-start flush is occurrence 1,
+    so occurrence 2 always lands while the resumed run is live)."""
+    # leg 2 commits at most blocks-2 blocks before dying, leaving >= 2
+    at_ckpt = rng.randint(1, max(blocks - 2, 1))
+    # leg 3 dies between a chain append and its checkpoint; at most
+    # blocks - at_ckpt chain appends remain, so cap the draw one short
+    # of that to leave the final leg real sampling work too
+    at_chain = rng.randint(1, max(min(2, blocks - at_ckpt - 1), 1))
+    return [
+        # 1: dispatch hang -> watchdog -> circuit breaker -> exit 75
+        {"watchdog_s": 3.0, "faults": [
+            {"site": "pt.dispatch", "kind": "hang", "at": 1,
+             "hang_s": 60}]},
+        # 2: transient dispatch error (retried) + kill at a durable
+        #    checkpoint boundary
+        {"watchdog_s": 0.0, "faults": [
+            {"site": "pt.dispatch", "kind": "error", "at": 1},
+            {"site": "pt.ckpt", "kind": "kill", "at": at_ckpt}]},
+        # 3: second transient error + kill between the chain append
+        #    and its checkpoint (the resume-truncation artifact)
+        {"watchdog_s": 0.0, "faults": [
+            {"site": "pt.dispatch", "kind": "error", "at": 1},
+            {"site": "pt.chain", "kind": "kill", "at": at_chain}]},
+        # 4: kill mid event-stream flush — the torn trailing record
+        {"watchdog_s": 0.0, "faults": [
+            {"site": "events.flush", "kind": "kill", "at": 2,
+             "frac": round(rng.uniform(0.2, 0.8), 3)}]},
+    ]
+
+
+def find_one(pattern):
+    hits = sorted(glob.glob(pattern, recursive=True))
+    return hits[0] if hits else None
+
+
+def stream_events(path):
+    out = []
+    if path and os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(ev, dict):
+                    out.append(ev)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--nsamp", type=int, default=600)
+    ap.add_argument("--blocks", type=int, default=6,
+                    help="checkpoint blocks (covUpdate = nsamp/blocks)")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the workdir for inspection")
+    ap.add_argument("--output", default=os.path.join(REPO,
+                                                     "CHAOS.json"))
+    opts = ap.parse_args(argv)
+
+    workdir = opts.workdir or tempfile.mkdtemp(prefix="ewt_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    cov_update = max(opts.nsamp // opts.blocks, 1)
+    make_dataset(workdir, opts.seed)
+    ref_pr = write_prfile(workdir, "ref.dat", "out_ref", opts.nsamp,
+                          cov_update)
+    chaos_pr = write_prfile(workdir, "chaos.dat", "out_chaos",
+                            opts.nsamp, cov_update)
+
+    print(f"[chaos] workdir={workdir} seed={opts.seed} "
+          f"nsamp={opts.nsamp} blocks={opts.blocks}", flush=True)
+    rc, err = run_leg(workdir, ref_pr)
+    if rc != 0:
+        print(f"[chaos] reference leg failed (exit {rc}):\n{err}",
+              file=sys.stderr)
+        return 2
+    print("[chaos] reference leg complete", flush=True)
+
+    rng = random.Random(opts.seed)
+    storm = build_storm(rng, opts.blocks)
+    attempts = []
+    kills = hangs = 0
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        plan = storm[attempt - 1] if attempt <= len(storm) else None
+        watchdog = plan.pop("watchdog_s") if plan else 0.0
+        rc, err = run_leg(workdir, chaos_pr, plan=plan,
+                          watchdog_s=watchdog)
+        attempts.append({"attempt": attempt, "plan": plan,
+                         "watchdog_s": watchdog, "exit": rc})
+        tag = ("complete" if rc == 0 else
+               f"killed (signal {-rc})" if rc < 0 else
+               "demoted/restart" if rc == 75 else f"exit {rc}")
+        print(f"[chaos] attempt {attempt}: {tag}", flush=True)
+        if rc < 0 and -rc == signal.SIGKILL:
+            kills += 1
+        if rc == 75:
+            hangs += 1
+        if rc == 0:
+            break
+        # between attempts, exercise the offline stream repair (the
+        # resume path heals the torn tail itself; --repair is the
+        # equivalent for streams nothing will resume)
+        ev_path = find_one(os.path.join(workdir, "out_chaos", "**",
+                                        "events.jsonl"))
+        if ev_path:
+            subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools",
+                                              "report.py"),
+                 ev_path, "--repair"], capture_output=True)
+    else:
+        print("[chaos] storm never completed within "
+              f"{MAX_ATTEMPTS} attempts", file=sys.stderr)
+
+    completed = attempts and attempts[-1]["exit"] == 0
+
+    # ---- verification ------------------------------------------------ #
+    ref_chain = find_one(os.path.join(workdir, "out_ref", "**",
+                                      "chain_1.txt"))
+    chaos_chain = find_one(os.path.join(workdir, "out_chaos", "**",
+                                        "chain_1.txt"))
+    bit_equal = bool(ref_chain and chaos_chain
+                     and filecmp.cmp(ref_chain, chaos_chain,
+                                     shallow=False))
+
+    ev_path = find_one(os.path.join(workdir, "out_chaos", "**",
+                                    "events.jsonl"))
+    check_rc = 1
+    if ev_path:
+        check_rc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "report.py"),
+             ev_path, "--check"], capture_output=True).returncode
+
+    events = stream_events(ev_path)
+    n_retry = sum(1 for ev in events if ev.get("type") == "retry")
+    n_fault_ev = sum(1 for ev in events if ev.get("type") == "fault")
+    n_demotion = sum(1 for ev in events
+                     if ev.get("type") == "demotion")
+    dispatch_faults = sum(
+        1 for ev in events
+        if ev.get("type") == "fault" and ev.get("kind") == "error"
+        and str(ev.get("site", "")).endswith(".dispatch"))
+
+    ok = (completed and bit_equal and check_rc == 0
+          and kills >= 3 and dispatch_faults >= 2 and hangs >= 1)
+    record = {
+        "seed": opts.seed,
+        "nsamp": opts.nsamp,
+        "blocks": opts.blocks,
+        "attempts": attempts,
+        "counts": {"kills": kills, "hangs": hangs,
+                   "dispatch_faults": dispatch_faults,
+                   "demotion_events": n_demotion,
+                   "retry_events": n_retry,
+                   "fault_events": n_fault_ev},
+        "bit_equal": bit_equal,
+        "stream_check_exit": check_rc,
+        "completed": completed,
+        "pass": ok,
+    }
+    from enterprise_warp_tpu.io.writers import atomic_write_json
+    atomic_write_json(opts.output, record, indent=1)
+    print(f"[chaos] kills={kills} dispatch_faults={dispatch_faults} "
+          f"hangs={hangs} demotions={n_demotion} retries={n_retry} "
+          f"bit_equal={bit_equal} check={'clean' if check_rc == 0 else 'DIRTY'}",
+          flush=True)
+    print(f"[chaos] {'PASS' if ok else 'FAIL'} -> {opts.output}",
+          flush=True)
+    if not opts.keep and opts.workdir is None:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

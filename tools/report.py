@@ -42,11 +42,12 @@ import json
 import os
 import sys
 
-#: the typed-event vocabulary (docs/observability.md). ``--check``
-#: flags anything else as unknown.
+#: the typed-event vocabulary (docs/observability.md;
+#: ``fault``/``retry``/``demotion`` from the resilience layer,
+#: docs/resilience.md). ``--check`` flags anything else as unknown.
 KNOWN_EVENT_TYPES = frozenset({
     "run_start", "run_end", "compile", "heartbeat", "checkpoint",
-    "span", "cost_analysis", "anomaly",
+    "span", "cost_analysis", "anomaly", "fault", "retry", "demotion",
 })
 
 
@@ -363,6 +364,57 @@ def _human_summary(report, out=sys.stdout):
                 p(f"  pallas routes at crash: {routes}")
 
 
+def repair_stream(path, out=sys.stdout):
+    """``--repair``: truncate torn trailing record(s) from an
+    events.jsonl — the documented kill-mid-append crash artifact — so
+    a resumed run (or ``--check``) sees a valid stream again. Walks
+    back from the tail dropping lines that fail to parse as JSON
+    objects, stopping at the first valid record; mid-stream damage is
+    left alone (that is data loss to report, not a tail to heal).
+    Returns the number of bytes removed."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    keep = len(data)
+    tail = data
+    removed_lines = 0
+    while True:
+        # position of the last line start within data[:keep]
+        body = tail.rstrip(b"\n")
+        if not body:
+            break
+        cut = body.rfind(b"\n")
+        line = body[cut + 1:]
+        try:
+            ev = json.loads(line)
+            ok = isinstance(ev, dict) and "type" in ev
+        except ValueError:
+            ok = False
+        if ok:
+            break
+        removed_lines += 1
+        keep = cut + 1 if cut >= 0 else 0
+        tail = data[:keep]
+    removed = len(data) - keep
+    if removed:
+        with open(path, "rb+") as fh:
+            fh.truncate(keep)
+        print(f"REPAIR: dropped {removed_lines} torn trailing "
+              f"record(s) ({removed} bytes) from {path}", file=out)
+    elif data and not data.endswith(b"\n"):
+        # the final line IS a complete record, only its terminating
+        # newline was lost: append it — the resume-time heal
+        # (RunRecorder._heal_torn_tail) classifies any unterminated
+        # tail as torn and would otherwise drop the valid record
+        with open(path, "ab") as fh:
+            fh.write(b"\n")
+        print(f"REPAIR: terminated a complete but newline-less final "
+              f"record in {path}", file=out)
+    else:
+        print(f"REPAIR: {path} tail is clean, nothing to do",
+              file=out)
+    return removed
+
+
 def check_stream(path, out=sys.stdout):
     """``--check``: schema-validate an events.jsonl — unknown event
     types, torn/malformed records, and span open/close imbalance.
@@ -434,6 +486,11 @@ def main(argv=None):
                     help="schema-validate the stream (unknown event "
                          "types, torn records, span imbalance) and "
                          "exit non-zero on problems; writes no report")
+    ap.add_argument("--repair", action="store_true",
+                    help="truncate torn trailing record(s) — the "
+                         "kill-mid-append crash artifact — so a "
+                         "resumed run can append to a valid stream; "
+                         "combine with --check to validate the result")
     opts = ap.parse_args(argv)
 
     path = opts.path
@@ -442,6 +499,10 @@ def main(argv=None):
     if not os.path.exists(path):
         print(f"no event stream at {path}", file=sys.stderr)
         return 1
+    if opts.repair:
+        repair_stream(path)
+        if not opts.check:
+            return 0
     if opts.check:
         return 1 if check_stream(path) else 0
     events, dropped = load_events(path)
