@@ -23,6 +23,42 @@ from .samplers import (HyperModelLikelihood, run_hmc, run_nested,
                        run_ptmcmc)
 
 
+def _demotion_reexec(argv_full):
+    """Environment + argv for the forced-CPU demotion re-exec: pin the
+    CPU backend, thread the run lineage across the process boundary
+    (``EWT_PARENT_RUN_ID``/``EWT_LINEAGE_REASON=demotion`` plus the
+    campaign id, so the child's ``run_lineage`` event links back to
+    the demoted run even before it reads its own stream), and strip
+    ``-w/--wipe_old_output`` — replaying it would rmtree the output
+    dir and destroy the very checkpoint the re-entry resumes from.
+    Pure function of (argv, current env, last lineage) so the re-exec
+    contract is unit-testable without an execve."""
+    from .utils import telemetry
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    lin = telemetry.last_lineage()
+    if lin is not None:
+        env["EWT_PARENT_RUN_ID"] = lin["run_id"]
+        env["EWT_LINEAGE_REASON"] = "demotion"
+        if lin.get("campaign"):
+            env.setdefault("EWT_CAMPAIGN_ID", lin["campaign"])
+    clean = []
+    skip = False
+    for a in argv_full:
+        if skip:
+            skip = False
+            continue
+        if a in ("-w", "--wipe_old_output"):
+            skip = True
+            continue
+        if a.startswith("--wipe_old_output=") or (
+                a.startswith("-w") and a[2:].lstrip("=").isdigit()):
+            continue
+        clean.append(a)
+    return env, [sys.executable, "-m", "enterprise_warp_tpu.cli"] + clean
+
+
 def import_custom_models(py_path: str, class_name: str):
     """Dynamic import of a user model file (results-CLI contract,
     ``/root/reference/enterprise_warp/results.py:1048-1054``)."""
@@ -111,29 +147,10 @@ def main(argv=None):
         print(f"platform demotion: {d}", file=sys.stderr)
         if d.to_level == "cpu" and \
                 os.environ.get("EWT_DEMOTION_EXEC", "1") != "0":
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"
             argv_full = list(sys.argv[1:]) if argv is None \
                 else list(argv)
-            # strip -w/--wipe_old_output: replaying it would rmtree
-            # the output dir and destroy the very checkpoint the
-            # re-entry resumes from
-            clean = []
-            skip = False
-            for a in argv_full:
-                if skip:
-                    skip = False
-                    continue
-                if a in ("-w", "--wipe_old_output"):
-                    skip = True
-                    continue
-                if a.startswith("--wipe_old_output=") or (
-                        a.startswith("-w") and a[2:].lstrip("=").isdigit()):
-                    continue
-                clean.append(a)
-            os.execve(sys.executable,
-                      [sys.executable, "-m", "enterprise_warp_tpu.cli"]
-                      + clean, env)
+            env, cmd = _demotion_reexec(argv_full)
+            os.execve(sys.executable, cmd, env)
         return EXIT_DEMOTED
     return 0
 

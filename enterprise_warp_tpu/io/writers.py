@@ -120,6 +120,29 @@ def atomic_write_json(path: str, obj, indent: int = 1, sort_keys=False,
     return path
 
 
+def atomic_write_text(path: str, text: str):
+    """Atomic (tmp + same-directory rename) text write WITHOUT the
+    durability fsyncs of :func:`atomic_write_json`. For artifacts that
+    are continuously rewritten and merely scraped — the OpenMetrics
+    textfile (``utils/metricsexport.py``) — where a reader must never
+    see a torn exposition but losing the last refresh to a power cut
+    costs nothing; paying two fsyncs per heartbeat for it would put
+    durability IO on the telemetry cadence."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        raise
+    return path
+
+
 def _rad_to_hms(rad: float) -> str:
     hours = (rad % (2.0 * math.pi)) * 12.0 / math.pi
     h = int(hours)

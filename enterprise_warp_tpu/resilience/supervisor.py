@@ -398,11 +398,15 @@ class BlockSupervisor:
                            "to": to_level or "restart"}).inc()
         rec = telemetry.active_recorder()
         if rec is not None:
+            # run_id: the campaign stitcher can attribute the demotion
+            # to its exact session even when the stream later gains
+            # re-entry sessions (docs/observability.md, run lineage)
             rec.event("demotion", site=site,
                       **{"from": from_level,
                          "to": to_level or "restart"},
                       strikes=self.strikes,
                       device_ok=device_ok,
+                      run_id=rec.run_id,
                       cause=(repr(cause)[:200] if cause is not None
                              else None))
             rec.flush()     # the demotion record must survive a crash

@@ -384,7 +384,6 @@ class HMCSampler:
     # block), mirroring the PTMCMC devicestate pipeline
     def _sample_impl(self, nsamp, resume, verbose, block_size, collect,
                      rec):
-        meter = EvalRateMeter()
         diag_t = [0.0]
         chain_path0 = os.path.join(self.outdir, "chain_1.txt")
         if resume and os.path.exists(self._ckpt_path):
@@ -409,6 +408,11 @@ class HMCSampler:
             if _is_primary():
                 open(os.path.join(self.outdir, "chain_1.txt"),
                      "w").close()
+
+        # seed evals_total from the checkpointed gradient count so the
+        # heartbeat series stays cumulative across resume sessions;
+        # rates measure only this session (no post-resume spike)
+        meter = EvalRateMeter(initial_total=self.W * int(st.ngrad))
 
         chain_path = os.path.join(self.outdir, "chain_1.txt")
         if _is_primary():
@@ -590,6 +594,9 @@ class HMCSampler:
                 mem = profiling.memory_watermark()
                 if mem is not None:
                     hb.update(mem)
+                rss = profiling.host_rss_bytes()
+                if rss is not None:
+                    hb["rss_bytes"] = rss
                 worst = self._block_diag(
                     thetas.reshape(todo, self.W, self.ndim), diag_t)
                 if worst is not None:

@@ -396,7 +396,10 @@ def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
                              nsteps=int(nsteps), ndim=int(nd),
                              dlogz=float(dlogz),
                              param_names=list(like.param_names)) as rec:
-        meter = EvalRateMeter()
+        # evals_total seeded from the checkpointed iteration count so
+        # the series stays cumulative across resumes; rates measure
+        # only this session (no post-resume spike)
+        meter = EvalRateMeter(initial_total=it * kbatch * nsteps)
         while it < max_iter:
             if preemption_requested():
                 # graceful preemption: checkpoint at this iteration
@@ -475,6 +478,9 @@ def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
                 mem = profiling.memory_watermark()
                 if mem is not None:
                     hb.update(mem)
+                rss = profiling.host_rss_bytes()
+                if rss is not None:
+                    hb["rss_bytes"] = rss
                 rec.heartbeat(**hb)
                 if verbose:
                     _log.info("NS it=%d lnZ=%.3f dlogz=%.4f acc=%.2f "

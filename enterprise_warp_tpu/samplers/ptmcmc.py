@@ -1233,7 +1233,6 @@ class PTSampler:
     # snapshot at block boundaries (the one sync per block)
     def _sample_impl(self, nsamp, resume, verbose, thin, block_size,
                      collect, rec):
-        meter = EvalRateMeter()
         diag_t = [0.0]
         if resume and os.path.exists(self._ckpt_path):
             st = self._load_state()
@@ -1260,6 +1259,12 @@ class PTSampler:
                                                  "chain_*.txt")):
                     if os.path.basename(p) != "chain_1.txt":
                         os.remove(p)
+
+        # seed evals_total from the checkpointed step so the heartbeat
+        # series stays cumulative across kill/resume sessions; rates
+        # still measure only this session's work (EvalRateMeter
+        # contract — no bogus first-heartbeat evals/s spike)
+        meter = EvalRateMeter(initial_total=self.W * int(st.step))
 
         chain_path = os.path.join(self.outdir, "chain_1.txt")
         if _is_primary():
@@ -1486,6 +1491,10 @@ class PTSampler:
                 mem = profiling.memory_watermark()
                 if mem is not None:
                     hb.update(mem)
+                # host-side resident set (Linux procfs; None elsewhere)
+                rss = profiling.host_rss_bytes()
+                if rss is not None:
+                    hb["rss_bytes"] = rss
                 # which Pallas route the likelihood's traces actually
                 # took (pallas / xla-fallback / probe-failed) — a
                 # mid-run transient probe failure shows up here, not

@@ -107,11 +107,20 @@ class EvalRateMeter:
     ``add(n)`` after each batched likelihood call; ``rate()`` is the
     cumulative throughput, ``window_rate()`` the rate since the last call
     to ``window_rate``.
+
+    ``initial_total`` seeds the counter from a resumed run's
+    checkpoint, so ``total`` (the heartbeat ``evals_total`` field)
+    stays cumulative across process sessions and a campaign stitcher
+    sees one monotone series. The seed counts toward ``total`` ONLY:
+    both ``rate()`` and ``window_rate()`` measure work done since THIS
+    meter started — folding checkpointed evals into this session's
+    elapsed seconds would report a bogus post-resume throughput spike.
     """
 
-    def __init__(self):
+    def __init__(self, initial_total: int = 0):
         self.t0 = monotonic()
-        self.total = 0
+        self.total = int(initial_total)
+        self._base = int(initial_total)
         self._win_t = self.t0
         self._win_n = 0
 
@@ -121,7 +130,7 @@ class EvalRateMeter:
 
     def rate(self) -> float:
         dt = monotonic() - self.t0
-        return self.total / dt if dt > 0 else 0.0
+        return (self.total - self._base) / dt if dt > 0 else 0.0
 
     def window_rate(self) -> float:
         now = monotonic()

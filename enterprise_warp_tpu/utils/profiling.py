@@ -58,7 +58,7 @@ from . import telemetry
 
 __all__ = ["spans_enabled", "span", "span_records", "reset_spans",
            "flush_trace", "export_chrome_trace", "monotonic",
-           "walltime", "timeit", "memory_watermark",
+           "walltime", "timeit", "memory_watermark", "host_rss_bytes",
            "live_buffer_report", "capture_dir", "capture_arm",
            "capture_tick", "capture_stop"]
 
@@ -350,6 +350,30 @@ def memory_watermark(device=None):
     reg.gauge("hbm_in_use_bytes").set(out["hbm_in_use_bytes"])
     reg.gauge("hbm_peak_bytes").set(out["hbm_peak_bytes"])
     return out
+
+
+try:
+    _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE")
+except (AttributeError, ValueError, OSError):
+    _PAGE_SIZE = 4096
+
+
+def host_rss_bytes():
+    """Resident-set size of THIS process in bytes, read from
+    ``/proc/self/statm`` (field 2, pages) — the host-side companion to
+    :func:`memory_watermark`: a device-resident run whose HOST heap
+    creeps (chain buffers, deferred host-work queues, event buffers)
+    shows up here, not in HBM. Stdlib-only; a graceful ``None`` off
+    Linux (no procfs) — callers simply omit the heartbeat field. Sets
+    the ``rss_bytes`` gauge when telemetry is enabled."""
+    try:
+        with open("/proc/self/statm") as fh:
+            rss = int(fh.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        return None
+    if telemetry.enabled():
+        telemetry.registry().gauge("rss_bytes").set(rss)
+    return rss
 
 
 def live_buffer_report(top: int = 20):

@@ -50,11 +50,13 @@ import json
 import os
 import threading
 import time
+import uuid
 
 __all__ = ["enabled", "registry", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "traced", "RunRecorder", "run_scope",
            "active_recorder", "dispatch_stats", "pallas_path_summary",
-           "cost_analysis_enabled", "set_flight_hook"]
+           "cost_analysis_enabled", "set_flight_hook", "last_lineage",
+           "LINEAGE_REASONS"]
 
 
 def enabled() -> bool:
@@ -582,6 +584,106 @@ def set_flight_hook(hook):
     _FLIGHT_HOOK = hook
 
 
+# ------------------------------------------------------------------ #
+#  run lineage                                                        #
+# ------------------------------------------------------------------ #
+
+#: the typed vocabulary of the ``run_lineage`` event's ``reason``
+#: field: how THIS process session relates to the previous one in the
+#: same stream. ``fresh`` = no predecessor; ``resume`` = ordinary
+#: restart/resume (kill, rerun into the same outdir); ``demotion`` =
+#: re-entry after a circuit-breaker platform demotion (the PR 7
+#: mega->classic in-process re-entry, the forced-CPU re-exec, and the
+#: exit-75 external restart all classify here); ``preempt-restart`` =
+#: the predecessor ended with a clean ``run_end(reason="preempted")``.
+LINEAGE_REASONS = ("fresh", "resume", "demotion", "preempt-restart")
+
+#: how far back the lineage scan reads an existing stream: the
+#: previous session's run_start / run_lineage / run_end / demotion
+#: records all live within the stream tail for any sane heartbeat
+#: cadence, and a campaign stitcher never needs more than the LAST
+#: session to link the new one.
+_LINEAGE_SCAN_BYTES = 1 << 19
+
+# the most recent recorder's identity in this process — the CLI's
+# demotion re-exec reads it AFTER the run scope has already closed
+# (the PlatformDemotion propagated out of it), so the recorder itself
+# is gone from _ACTIVE by then.
+_LAST_LINEAGE: dict | None = None
+
+
+def last_lineage() -> dict | None:
+    """Identity of the most recent (possibly closed) run recorder in
+    this process: ``{"run_id", "campaign", "parent", "reason",
+    "run_dir"}`` — or None if no recorder ever started. Survives the
+    run scope so process-boundary code (the CLI's demotion re-exec)
+    can propagate ``EWT_PARENT_RUN_ID``/``EWT_CAMPAIGN_ID`` into the
+    child environment."""
+    return _LAST_LINEAGE
+
+
+def _scan_prev_session(path: str) -> dict:
+    """Read the tail of an existing events.jsonl and summarize its
+    LAST session: the run/campaign ids to link the new session to and
+    the evidence needed to classify how it ended. Returns
+    ``{"run_id", "campaign", "end_status", "end_reason", "demoted"}``
+    (all-None when the stream is absent/empty/id-less). Never raises —
+    lineage is telemetry, not control flow."""
+    out = {"run_id": None, "campaign": None, "end_status": None,
+           "end_reason": None, "demoted": False}
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(size - _LINEAGE_SCAN_BYTES, 0))
+            tail = fh.read()
+    except OSError:
+        return out
+    if max(size, 0) > _LINEAGE_SCAN_BYTES:
+        # drop the (possibly mid-record) first line of a partial read
+        tail = tail.split(b"\n", 1)[-1]
+    for raw in tail.splitlines():
+        try:
+            ev = json.loads(raw)
+        except ValueError:
+            continue
+        if not isinstance(ev, dict):
+            continue
+        t = ev.get("type")
+        if t == "run_start":
+            # a new session: everything after it overwrites the summary
+            out = {"run_id": ev.get("run_id"),
+                   "campaign": ev.get("campaign"),
+                   "end_status": None, "end_reason": None,
+                   "demoted": False}
+        elif t == "run_lineage":
+            out["run_id"] = ev.get("run_id") or out["run_id"]
+            out["campaign"] = ev.get("campaign") or out["campaign"]
+        elif t == "run_end":
+            out["end_status"] = ev.get("status")
+            out["end_reason"] = ev.get("reason")
+        elif t == "demotion":
+            out["demoted"] = True
+    return out
+
+
+def _classify_reason(prev: dict) -> str:
+    """Lineage reason from the previous session's tail summary (used
+    only when ``EWT_LINEAGE_REASON`` did not pin it): a predecessor
+    that ended with a clean preemption is a ``preempt-restart``; one
+    whose session recorded a platform demotion and did not finish
+    ``ok`` is a ``demotion`` re-entry (covers the exit-75 external
+    restart, where no env can cross the boundary); anything else with
+    a predecessor is a plain ``resume``."""
+    if prev.get("run_id") is None:
+        return "fresh"
+    if prev.get("end_reason") == "preempted":
+        return "preempt-restart"
+    if prev.get("demoted") and prev.get("end_status") != "ok":
+        return "demotion"
+    return "resume"
+
+
 class RunRecorder:
     """Structured JSONL event stream for one run directory.
 
@@ -594,6 +696,21 @@ class RunRecorder:
 
     Every event is one JSON object per line with at least ``t`` (unix
     epoch seconds) and ``type``.
+
+    **Run lineage**: every recorder mints a ``run_id`` and works out
+    which run it descends from, so the many processes of one campaign
+    — per-pulsar runs, kill/resume re-entries, the PR 7 demotion
+    re-exec, chaos restarts — stitch into one logical timeline.
+    Sources, in priority order: ``EWT_PARENT_RUN_ID`` /
+    ``EWT_LINEAGE_REASON`` (consumed once — the demotion re-exec sets
+    them for exactly one child), then the tail of the existing stream
+    (a restart by an EXTERNAL supervisor crosses no env, but it
+    appends to the same events.jsonl). The campaign/trace id comes
+    from ``EWT_CAMPAIGN_ID`` (a campaign driver sets it once for the
+    whole fleet), else from the previous session, else it is minted
+    fresh. ``run_start`` carries ``run_id``/``campaign`` and is
+    followed by a typed ``run_lineage`` event (``parent``,
+    ``reason`` — see :data:`LINEAGE_REASONS`).
     """
 
     def __init__(self, run_dir: str, flush_every: int = 20,
@@ -607,9 +724,40 @@ class RunRecorder:
         self._last_flush = time.time()
         self._in_flush = False
         self._ended = False
+        self.run_id = uuid.uuid4().hex[:12]
+        self.campaign = None
+        self.parent_run_id = None
+        self.lineage_reason = "fresh"
         if self.enabled:
             os.makedirs(run_dir, exist_ok=True)
             self._heal_torn_tail()
+            self._resolve_lineage()
+
+    def _resolve_lineage(self):
+        """Fill ``campaign``/``parent_run_id``/``lineage_reason`` (see
+        class docstring). Runs after the tail heal so the scan only
+        sees complete records."""
+        prev = _scan_prev_session(self.path)
+        # env pins are one-shot: the demotion re-exec names ITS child;
+        # a grandchild must rediscover its parent from the stream
+        env_parent = os.environ.pop("EWT_PARENT_RUN_ID", None)
+        env_reason = os.environ.pop("EWT_LINEAGE_REASON", None)
+        if env_reason not in LINEAGE_REASONS:
+            env_reason = None
+        self.parent_run_id = env_parent or prev.get("run_id")
+        if self.parent_run_id is None:
+            self.lineage_reason = "fresh"
+        elif env_reason is not None:
+            self.lineage_reason = env_reason
+        elif prev.get("run_id") is not None:
+            self.lineage_reason = _classify_reason(prev)
+        else:
+            # env named a parent but the stream holds no prior session
+            # (a re-entry into a cleaned directory): a plain resume
+            self.lineage_reason = "resume"
+        self.campaign = (os.environ.get("EWT_CAMPAIGN_ID")
+                         or prev.get("campaign")
+                         or uuid.uuid4().hex[:12])
 
     def _heal_torn_tail(self):
         """A process killed mid-write leaves a partial final record
@@ -709,10 +857,15 @@ class RunRecorder:
 
     # -------------------------- typed events ---------------------- #
     def run_start(self, **fields):
-        """``run_start``: environment fingerprint + caller fields."""
+        """``run_start``: environment fingerprint + caller fields,
+        followed by the session's ``run_lineage`` event (see class
+        docstring)."""
         if not self.enabled:
             return
+        global _LAST_LINEAGE
         info = dict(fields)
+        info.setdefault("run_id", self.run_id)
+        info.setdefault("campaign", self.campaign)
         try:
             import jax
 
@@ -724,10 +877,27 @@ class RunRecorder:
         except Exception:   # noqa: BLE001 — fingerprint is best-effort
             pass
         self.event("run_start", **info)
+        self.event("run_lineage", run_id=self.run_id,
+                   campaign=self.campaign, parent=self.parent_run_id,
+                   reason=self.lineage_reason, pid=os.getpid())
+        _LAST_LINEAGE = {"run_id": self.run_id,
+                         "campaign": self.campaign,
+                         "parent": self.parent_run_id,
+                         "reason": self.lineage_reason,
+                         "run_dir": self.run_dir}
         self.flush()        # the header must survive an early crash
 
     def heartbeat(self, **fields):
         self.event("heartbeat", **fields)
+        # OpenMetrics textfile export on heartbeat cadence
+        # (utils/metricsexport.py) — a no-op unless
+        # EWT_METRICS_TEXTFILE is set; never kills a run
+        try:
+            from .metricsexport import maybe_export
+
+            maybe_export()
+        except Exception:   # noqa: BLE001
+            pass
 
     def checkpoint(self, **fields):
         self.event("checkpoint", **fields)
@@ -743,6 +913,14 @@ class RunRecorder:
         fields.setdefault("metrics", _REGISTRY.snapshot())
         self.event("run_end", **fields)
         self.flush()
+        # final textfile export so the scrape target holds the
+        # end-of-run registry, not the last heartbeat's
+        try:
+            from .metricsexport import maybe_export
+
+            maybe_export(force=True)
+        except Exception:   # noqa: BLE001
+            pass
 
 
 class _NoopRecorder:
@@ -752,6 +930,10 @@ class _NoopRecorder:
     enabled = False
     run_dir = None
     path = None
+    run_id = None
+    campaign = None
+    parent_run_id = None
+    lineage_reason = None
 
     def event(self, *args, **fields):
         pass
@@ -827,6 +1009,15 @@ def run_scope(run_dir: str | None, **start_fields):
 
         flight_recorder().bind(run_dir)
     except Exception:   # noqa: BLE001 — profiling never kills a run
+        pass
+    # metrics exporters (utils/metricsexport.py): start the /metrics
+    # endpoint (EWT_METRICS_PORT) and announce any armed exporter as a
+    # metrics_export event — both inert without their knobs
+    try:
+        from .metricsexport import autostart
+
+        autostart(rec)
+    except Exception:   # noqa: BLE001 — telemetry never kills a run
         pass
     status = "ok"
     try:

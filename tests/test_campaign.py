@@ -1,0 +1,778 @@
+"""Campaign-observability layer: run lineage, OpenMetrics export,
+fleet console, and the perf-regression sentinel (ISSUE 8).
+
+Fast coverage is in-process (lineage classification, exporter
+serialization, campaign/sentinel folds on crafted streams); the
+chaos-style acceptance campaign — two pulsars, a kill/resume and a
+forced demotion restart stitched into one connected lineage graph —
+runs real CLI subprocesses and is slow-marked.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.utils import metricsexport, telemetry
+from enterprise_warp_tpu.utils.logging import EvalRateMeter
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on(monkeypatch):
+    """Telemetry ON, a clean registry, and none of the campaign env
+    knobs leaking between tests."""
+    monkeypatch.setenv("EWT_TELEMETRY", "1")
+    for var in ("EWT_CAMPAIGN_ID", "EWT_PARENT_RUN_ID",
+                "EWT_LINEAGE_REASON", "EWT_METRICS_TEXTFILE",
+                "EWT_METRICS_PORT"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.registry().reset()
+    yield
+    metricsexport.stop_http_server()
+    telemetry.registry().reset()
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"ewt_tool_{name}", str(REPO_ROOT / "tools" / f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _events(path):
+    return [json.loads(ln) for ln in
+            pathlib.Path(path).read_text().splitlines()]
+
+
+def _write_stream(dirpath, events):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, "events.jsonl"), "w") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+
+
+# ------------------------------------------------------------------ #
+#  run lineage                                                        #
+# ------------------------------------------------------------------ #
+
+class TestLineage:
+    def test_fresh_then_resume_chain(self, tmp_path):
+        with telemetry.run_scope(str(tmp_path), sampler="t") as rec:
+            first_id = rec.run_id
+            assert rec.lineage_reason == "fresh"
+            assert rec.parent_run_id is None
+        with telemetry.run_scope(str(tmp_path), sampler="t") as rec2:
+            assert rec2.parent_run_id == first_id
+            assert rec2.lineage_reason == "resume"
+            # the campaign id survives the process-session boundary
+            # through the stream, not the environment
+            assert "EWT_CAMPAIGN_ID" not in os.environ
+        evs = _events(tmp_path / "events.jsonl")
+        lineage = [e for e in evs if e["type"] == "run_lineage"]
+        assert [e["reason"] for e in lineage] == ["fresh", "resume"]
+        assert lineage[1]["parent"] == lineage[0]["run_id"]
+        assert lineage[0]["campaign"] == lineage[1]["campaign"]
+        starts = [e for e in evs if e["type"] == "run_start"]
+        assert starts[0]["run_id"] == lineage[0]["run_id"]
+
+    def test_env_override_is_consumed_once(self, tmp_path,
+                                           monkeypatch):
+        monkeypatch.setenv("EWT_PARENT_RUN_ID", "cafe00000001")
+        monkeypatch.setenv("EWT_LINEAGE_REASON", "demotion")
+        rec = telemetry.RunRecorder(str(tmp_path))
+        assert rec.parent_run_id == "cafe00000001"
+        assert rec.lineage_reason == "demotion"
+        # one-shot: the re-exec names ITS child only
+        assert "EWT_PARENT_RUN_ID" not in os.environ
+        assert "EWT_LINEAGE_REASON" not in os.environ
+        rec2 = telemetry.RunRecorder(str(tmp_path / "other"))
+        assert rec2.lineage_reason == "fresh"
+
+    def test_campaign_env_pins_campaign(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("EWT_CAMPAIGN_ID", "fleet42")
+        rec = telemetry.RunRecorder(str(tmp_path))
+        assert rec.campaign == "fleet42"
+
+    def test_preempt_restart_classification(self, tmp_path):
+        _write_stream(tmp_path, [
+            {"t": 1.0, "type": "run_start", "run_id": "aaa",
+             "campaign": "c1"},
+            {"t": 1.0, "type": "run_lineage", "run_id": "aaa",
+             "campaign": "c1", "parent": None, "reason": "fresh"},
+            {"t": 2.0, "type": "run_end", "status": "ok",
+             "reason": "preempted"},
+        ])
+        rec = telemetry.RunRecorder(str(tmp_path))
+        assert rec.parent_run_id == "aaa"
+        assert rec.lineage_reason == "preempt-restart"
+        assert rec.campaign == "c1"
+
+    def test_demotion_restart_classification(self, tmp_path):
+        """The exit-75 external restart crosses no env — the stream's
+        demotion event plus the error-status run_end classify it."""
+        _write_stream(tmp_path, [
+            {"t": 1.0, "type": "run_start", "run_id": "bbb",
+             "campaign": "c1"},
+            {"t": 1.0, "type": "run_lineage", "run_id": "bbb",
+             "campaign": "c1", "parent": None, "reason": "fresh"},
+            {"t": 2.0, "type": "demotion", "site": "pt.dispatch",
+             "from": "cpu", "to": "restart"},
+            {"t": 2.1, "type": "run_end", "status": "error"},
+        ])
+        rec = telemetry.RunRecorder(str(tmp_path))
+        assert rec.lineage_reason == "demotion"
+        assert rec.parent_run_id == "bbb"
+
+    def test_recovered_demotion_counts_as_resume(self, tmp_path):
+        """A session that demoted in-process but finished ok is an
+        ordinary predecessor — the next session is a resume."""
+        _write_stream(tmp_path, [
+            {"t": 1.0, "type": "run_start", "run_id": "ccc"},
+            {"t": 1.0, "type": "run_lineage", "run_id": "ccc",
+             "parent": None, "reason": "fresh"},
+            {"t": 2.0, "type": "demotion", "from": "mega",
+             "to": "classic"},
+            {"t": 3.0, "type": "run_end", "status": "ok"},
+        ])
+        rec = telemetry.RunRecorder(str(tmp_path))
+        assert rec.lineage_reason == "resume"
+
+    def test_cli_reexec_env_propagates_lineage(self, tmp_path,
+                                               monkeypatch):
+        from enterprise_warp_tpu import cli
+        with telemetry.run_scope(str(tmp_path), sampler="t") as rec:
+            rid, camp = rec.run_id, rec.campaign
+        env, cmd = cli._demotion_reexec(
+            ["--prfile", "run.dat", "-w", "1", "--num", "0"])
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert env["EWT_PARENT_RUN_ID"] == rid
+        assert env["EWT_LINEAGE_REASON"] == "demotion"
+        assert env["EWT_CAMPAIGN_ID"] == camp
+        assert "-w" not in cmd and "1" not in cmd[3:]
+        assert "--prfile" in cmd and "--num" in cmd
+
+
+# ------------------------------------------------------------------ #
+#  OpenMetrics export                                                 #
+# ------------------------------------------------------------------ #
+
+class TestOpenMetrics:
+    def test_serialization_families_quantiles_escaping(self):
+        reg = telemetry.registry()
+        reg.counter("retraces", fn="stage2").inc(3)
+        reg.counter("retraces", fn="block").inc(1)
+        reg.gauge("rss_bytes").set(4096)
+        reg.gauge("empty_gauge")            # value None: skipped
+        h = reg.histogram("span_ms", span='we"ird\\name')
+        for v in range(100):
+            h.observe(float(v))
+        text = metricsexport.openmetrics()
+        assert text.endswith("# EOF\n")
+        assert text.count("# TYPE ewt_retraces counter") == 1
+        assert 'ewt_retraces_total{fn="stage2"} 3' in text
+        assert 'ewt_retraces_total{fn="block"} 1' in text
+        assert "ewt_rss_bytes 4096" in text
+        assert "ewt_empty_gauge" not in text
+        assert "# TYPE ewt_span_ms summary" in text
+        assert 'quantile="0.5"' in text
+        assert 'we\\"ird\\\\name' in text
+        assert "ewt_span_ms_count" in text
+
+    def test_textfile_written_on_heartbeat_and_run_end(
+            self, tmp_path, monkeypatch):
+        target = tmp_path / "metrics.prom"
+        monkeypatch.setenv("EWT_METRICS_TEXTFILE", str(target))
+        monkeypatch.setattr(metricsexport, "_last_write",
+                            [float("-inf")])
+        telemetry.registry().counter("beats").inc()
+        with telemetry.run_scope(str(tmp_path / "run"),
+                                 sampler="t") as rec:
+            rec.heartbeat(step=1)
+            assert target.exists()
+            text = target.read_text()
+            assert text.endswith("# EOF\n")
+            assert "ewt_beats_total 1" in text
+            # heartbeat cadence is throttled: an immediate second
+            # heartbeat must not rewrite
+            before = target.stat().st_mtime_ns
+            telemetry.registry().counter("beats").inc()
+            rec.heartbeat(step=2)
+            assert target.stat().st_mtime_ns == before
+        # run_end forces the final snapshot past the throttle
+        assert "ewt_beats_total 2" in target.read_text()
+        evs = _events(tmp_path / "run" / "events.jsonl")
+        exports = [e for e in evs if e["type"] == "metrics_export"]
+        assert any(e["mode"] == "textfile" for e in exports)
+
+    def test_master_gate_disables_export(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("EWT_METRICS_TEXTFILE",
+                           str(tmp_path / "m.prom"))
+        monkeypatch.setenv("EWT_METRICS_PORT", "0")
+        monkeypatch.setenv("EWT_TELEMETRY", "0")
+        assert metricsexport.textfile_path() is None
+        assert metricsexport.http_port() is None
+        assert metricsexport.maybe_export(force=True) is None
+        assert metricsexport.start_http_server() is None
+        assert not (tmp_path / "m.prom").exists()
+
+    def test_http_endpoint_serves_openmetrics(self, tmp_path,
+                                              monkeypatch):
+        monkeypatch.setenv("EWT_METRICS_PORT", "0")   # ephemeral
+        telemetry.registry().counter("scrapes").inc(7)
+        with telemetry.run_scope(str(tmp_path), sampler="t"):
+            pass
+        evs = _events(tmp_path / "events.jsonl")
+        exports = [e for e in evs if e["type"] == "metrics_export"
+                   and e["mode"] == "http"]
+        assert exports, "autostart did not announce the endpoint"
+        port = exports[0]["port"]
+        url = f"http://127.0.0.1:{port}/metrics"
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert body.endswith("# EOF\n")
+        assert "ewt_scrapes_total 7" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+
+
+# ------------------------------------------------------------------ #
+#  EvalRateMeter seeding (resume satellite)                           #
+# ------------------------------------------------------------------ #
+
+class TestEvalRateMeter:
+    def test_seed_feeds_total_not_rates(self):
+        meter = EvalRateMeter(initial_total=10_000)
+        time.sleep(0.05)
+        meter.add(100)
+        assert meter.total == 10_100
+        # rate() measures THIS session's work only: 100 evals over
+        # >=0.05 s is < 2000/s, while a seed-contaminated rate would
+        # be >= 10100 / (test wall <= 5 s) >= 2020/s
+        assert 0.0 < meter.rate() < 2000.0
+        assert meter.window_rate() < 2000.0
+
+    def test_pt_resume_heartbeats_stay_cumulative(self, tmp_path):
+        from enterprise_warp_tpu.samplers import PTSampler
+        like = _gauss_like()
+        outdir = str(tmp_path)
+        s1 = PTSampler(like, outdir, ntemps=2, nchains=4, seed=0,
+                       cov_update=30)
+        s1.sample(60, resume=False, verbose=False)
+        s2 = PTSampler(like, outdir, ntemps=2, nchains=4, seed=0,
+                       cov_update=30)
+        s2.sample(90, resume=True, verbose=False)
+        evs = _events(tmp_path / "events.jsonl")
+        # split heartbeats by session
+        sessions, cur = [], None
+        for ev in evs:
+            if ev["type"] == "run_start":
+                cur = []
+                sessions.append(cur)
+            elif ev["type"] == "heartbeat" and cur is not None:
+                cur.append(ev)
+        assert len(sessions) == 2
+        W = 2 * 4
+        assert sessions[0][-1]["evals_total"] == W * 60
+        # resumed session's first heartbeat CONTINUES the series (the
+        # checkpointed 60 steps are seeded in) and its evals/s is a
+        # finite per-session figure, not a seed-contaminated spike
+        first = sessions[1][0]
+        assert first["evals_total"] == W * 90
+        assert first["evals_per_s"] is not None
+        totals = [hb["evals_total"] for sess in sessions
+                  for hb in sess]
+        assert totals == sorted(totals)
+        # lineage rode along: session 2 is a resume of session 1
+        lineage = [e for e in evs if e["type"] == "run_lineage"]
+        assert [e["reason"] for e in lineage] == ["fresh", "resume"]
+
+
+def _gauss_like():
+    import jax
+    import jax.numpy as jnp
+
+    from enterprise_warp_tpu.models.priors import Parameter, Uniform
+
+    class GaussLike:
+        def __init__(self):
+            self.mu = jnp.asarray([0.0, 1.0], dtype=jnp.float64)
+            self.sigma = jnp.asarray([0.5, 0.3], dtype=jnp.float64)
+            self.ndim = 2
+            self.params = [Parameter(f"p{i}", Uniform(-10.0, 10.0))
+                           for i in range(2)]
+            self.param_names = [p.name for p in self.params]
+
+            def ll(theta):
+                z = (theta - self.mu) / self.sigma
+                return -0.5 * jnp.sum(z * z)
+
+            self.loglike = jax.jit(ll)
+            self.loglike_batch = jax.jit(jax.vmap(ll))
+
+        def log_prior(self, theta):
+            import jax.numpy as jnp
+            theta = jnp.atleast_1d(theta)
+            out = 0.0
+            for i, p in enumerate(self.params):
+                out = out + p.prior.logpdf(theta[..., i])
+            return out
+
+        def from_unit(self, u):
+            import jax.numpy as jnp
+            return jnp.stack([p.prior.from_unit(u[..., i])
+                              for i, p in enumerate(self.params)],
+                             axis=-1)
+
+        def sample_prior(self, rng, n=1):
+            return rng.uniform(-10.0, 10.0, size=(n, self.ndim))
+
+    return GaussLike()
+
+
+# ------------------------------------------------------------------ #
+#  report.py: new vocabulary + multi-stream stitching                 #
+# ------------------------------------------------------------------ #
+
+class TestReportStitch:
+    def test_check_accepts_new_event_types(self, tmp_path):
+        report = _load_tool("report")
+        _write_stream(tmp_path, [
+            {"t": 1.0, "type": "run_start", "run_id": "a"},
+            {"t": 1.0, "type": "run_lineage", "run_id": "a",
+             "parent": None, "reason": "fresh"},
+            {"t": 1.1, "type": "metrics_export", "mode": "http",
+             "port": 9100},
+            {"t": 2.0, "type": "run_end", "status": "ok"},
+        ])
+        path = str(tmp_path / "events.jsonl")
+        assert report.check_stream(path,
+                                   out=open(os.devnull, "w")) == 0
+
+    def test_single_stream_report_carries_lineage(self, tmp_path):
+        report = _load_tool("report")
+        _write_stream(tmp_path, [
+            {"t": 1.0, "type": "run_start", "run_id": "a",
+             "sampler": "ptmcmc"},
+            {"t": 1.0, "type": "run_lineage", "run_id": "a",
+             "parent": None, "reason": "fresh"},
+            {"t": 2.0, "type": "run_end", "status": "error"},
+            {"t": 3.0, "type": "run_start", "run_id": "b",
+             "sampler": "ptmcmc"},
+            {"t": 3.0, "type": "run_lineage", "run_id": "b",
+             "parent": "a", "reason": "resume"},
+            {"t": 4.0, "type": "run_end", "status": "ok"},
+        ])
+        events, dropped = report.load_events(
+            str(tmp_path / "events.jsonl"))
+        rpt = report.build_report(events, dropped)
+        lin = rpt["lineage"]
+        assert [s["run_id"] for s in lin["sessions"]] == ["a", "b"]
+        assert lin["graph"]["connected"]
+        assert lin["graph"]["edges"] == [["a", "b"]]
+
+    def test_multi_stream_stitch_links_across_dirs(self, tmp_path,
+                                                   capsys):
+        report = _load_tool("report")
+        _write_stream(tmp_path / "d1", [
+            {"t": 1.0, "type": "run_start", "run_id": "a"},
+            {"t": 1.0, "type": "run_lineage", "run_id": "a",
+             "parent": None, "reason": "fresh"},
+            {"t": 2.0, "type": "run_end", "status": "error"},
+        ])
+        _write_stream(tmp_path / "d2", [
+            {"t": 3.0, "type": "run_start", "run_id": "b"},
+            {"t": 3.0, "type": "run_lineage", "run_id": "b",
+             "parent": "a", "reason": "demotion"},
+            {"t": 4.0, "type": "run_end", "status": "ok"},
+        ])
+        out = tmp_path / "stitched.json"
+        assert report.main([str(tmp_path / "d1"),
+                            str(tmp_path / "d2"),
+                            "-o", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        assert doc["lineage"]["graph"]["connected"]
+        assert doc["lineage"]["graph"]["edges"] == [["a", "b"]]
+        assert len(doc["streams"]) == 2
+        # drop the parent stream: the child is now an orphan
+        assert report.main([str(tmp_path / "d2"),
+                            str(tmp_path / "d2" / "events.jsonl"),
+                            "-o", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        assert not doc["lineage"]["graph"]["connected"]
+
+
+# ------------------------------------------------------------------ #
+#  fleet console                                                      #
+# ------------------------------------------------------------------ #
+
+def _campaign_fixture(root):
+    t = time.time()
+    _write_stream(root / "0_J0001", [
+        {"t": t - 100, "type": "run_start", "run_id": "aaa",
+         "campaign": "c1", "sampler": "ptmcmc"},
+        {"t": t - 100, "type": "run_lineage", "run_id": "aaa",
+         "campaign": "c1", "parent": None, "reason": "fresh"},
+        {"t": t - 95, "type": "heartbeat", "step": 30, "nsamp": 90,
+         "evals_per_s": 100.0, "evals_total": 3000},
+        {"t": t - 90, "type": "fault", "site": "pt.ckpt",
+         "kind": "kill"},
+        {"t": t - 80, "type": "run_start", "run_id": "bbb",
+         "campaign": "c1", "sampler": "ptmcmc"},
+        {"t": t - 80, "type": "run_lineage", "run_id": "bbb",
+         "campaign": "c1", "parent": "aaa", "reason": "resume"},
+        {"t": t - 70, "type": "heartbeat", "step": 90, "nsamp": 90,
+         "evals_per_s": 120.0, "evals_total": 9000, "rhat": 1.01},
+        {"t": t - 69, "type": "run_end", "status": "ok"},
+    ])
+    _write_stream(root / "1_J0002", [
+        {"t": t - 60, "type": "run_start", "run_id": "ccc",
+         "campaign": "c1", "sampler": "ptmcmc"},
+        {"t": t - 60, "type": "run_lineage", "run_id": "ccc",
+         "campaign": "c1", "parent": None, "reason": "fresh"},
+        {"t": t - 55, "type": "retry", "site": "pt.dispatch",
+         "attempt": 1},
+        {"t": t - 54, "type": "demotion", "site": "pt.dispatch",
+         "from": "cpu", "to": "restart"},
+        {"t": t - 53, "type": "run_end", "status": "error"},
+        {"t": t - 50, "type": "run_start", "run_id": "ddd",
+         "campaign": "c1", "sampler": "ptmcmc"},
+        {"t": t - 50, "type": "run_lineage", "run_id": "ddd",
+         "campaign": "c1", "parent": "ccc", "reason": "demotion"},
+        {"t": t - 5, "type": "heartbeat", "step": 45, "nsamp": 90,
+         "evals_per_s": 80.0},
+    ])
+
+
+class TestCampaignConsole:
+    def test_fold_statuses_lineage_and_totals(self, tmp_path, capsys):
+        campaign = _load_tool("campaign")
+        _campaign_fixture(tmp_path)
+        assert campaign.main([str(tmp_path), "--check"]) == 0
+        rep = json.loads(
+            (tmp_path / "campaign_report.json").read_text())
+        assert rep["lineage"]["connected"]
+        by_dir = {r["run_dir"]: r for r in rep["runs"]}
+        assert by_dir["0_J0001"]["status"] == "done"
+        assert by_dir["0_J0001"]["sessions"] == 2
+        assert by_dir["0_J0001"]["reasons"] == ["fresh", "resume"]
+        assert by_dir["1_J0002"]["status"] == "running"
+        assert by_dir["1_J0002"]["demoted"]
+        t = rep["totals"]
+        assert t["resumes"] == 1 and t["demotion_reentries"] == 1
+        assert t["faults"] == 1 and t["retries"] == 1
+        assert t["aggregate_running_evals_per_s"] == 80.0
+        assert rep["campaigns"] == ["c1"]
+        out = capsys.readouterr().out
+        assert "connected" in out and "0_J0001" in out
+
+    def test_orphan_breaks_the_graph(self, tmp_path):
+        campaign = _load_tool("campaign")
+        _campaign_fixture(tmp_path)
+        # lose pulsar B's first session: its demotion child orphans
+        _write_stream(tmp_path / "1_J0002", [
+            {"t": time.time() - 50, "type": "run_start",
+             "run_id": "ddd", "campaign": "c1"},
+            {"t": time.time() - 50, "type": "run_lineage",
+             "run_id": "ddd", "campaign": "c1", "parent": "ccc",
+             "reason": "demotion"},
+        ])
+        assert campaign.main([str(tmp_path), "--check", "-q"]) == 1
+        rep = json.loads(
+            (tmp_path / "campaign_report.json").read_text())
+        assert not rep["lineage"]["connected"]
+        assert rep["lineage"]["orphans"][0]["run_id"] == "ddd"
+
+    def test_nested_iteration_heartbeats_track_progress(self,
+                                                        tmp_path):
+        """Nested heartbeats carry 'iteration', never 'step' — the
+        fold must follow the LATEST one, not freeze on the first."""
+        report = _load_tool("report")
+        _write_stream(tmp_path, [
+            {"t": 1.0, "type": "run_start", "run_id": "n",
+             "sampler": "nested"},
+            {"t": 2.0, "type": "heartbeat", "iteration": 20,
+             "evals_per_s": 10.0},
+            {"t": 3.0, "type": "heartbeat", "iteration": 60,
+             "evals_per_s": 11.0},
+        ])
+        events, _ = report.load_events(str(tmp_path / "events.jsonl"))
+        seg = report.fold_segments(events)[-1]
+        assert seg["step"] == 60
+
+    def test_dead_vs_running_staleness(self, tmp_path):
+        campaign = _load_tool("campaign")
+        t = time.time()
+        _write_stream(tmp_path / "x", [
+            {"t": t - 10_000, "type": "run_start", "run_id": "e"},
+            {"t": t - 10_000, "type": "run_lineage", "run_id": "e",
+             "parent": None, "reason": "fresh"},
+            {"t": t - 9_999, "type": "heartbeat", "step": 1,
+             "nsamp": 100},
+        ])
+        rep = campaign.fold_campaign(str(tmp_path), stale_s=300.0)
+        assert rep["runs"][0]["status"] == "dead"
+        rep = campaign.fold_campaign(str(tmp_path), stale_s=1e6)
+        assert rep["runs"][0]["status"] == "running"
+
+
+# ------------------------------------------------------------------ #
+#  regression sentinel                                                #
+# ------------------------------------------------------------------ #
+
+def _bench_fixture(d, latest_value=560.0):
+    os.makedirs(d, exist_ok=True)
+    mk = lambda v: {"parsed": {   # noqa: E731
+        "metric": "loglike_evals_per_sec", "value": v,
+        "unit": "evals/s (jax-CPU fallback)",
+        "device_unavailable": True,
+        "last_device": {"value": 33503.6,
+                        "measured_at": "2026-07-31T09:05:00"}}}
+    json.dump(mk(544.6), open(os.path.join(d, "BENCH_r04.json"), "w"))
+    json.dump(mk(571.3), open(os.path.join(d, "BENCH_r05.json"), "w"))
+    json.dump(mk(latest_value),
+              open(os.path.join(d, "BENCH_r06.json"), "w"))
+    json.dump({"bubble_reduction": 6.55,
+               "host_boundary_fraction": 0.0358},
+              open(os.path.join(d, "BENCH_PIPELINE.json"), "w"))
+    json.dump({"dispatch": {"full_kernel": {
+        "dispatch_reduction": 6.78, "mega": {"dispatch_ops": 9}}}},
+        open(os.path.join(d, "ROOFLINE.json"), "w"))
+
+
+class TestSentinel:
+    def test_real_repo_history_passes(self, tmp_path):
+        sentinel = _load_tool("sentinel")
+        out = tmp_path / "TRENDS.json"
+        assert sentinel.main(["--bench-dir", str(REPO_ROOT),
+                              "--out", str(out), "-q"]) == 0
+        doc = json.loads(out.read_text())
+        assert doc["pass"]
+        assert any(g["name"] == "evals_per_s"
+                   and g["status"] == "pass" for g in doc["gates"])
+
+    def test_synthetic_regression_fails(self, tmp_path):
+        sentinel = _load_tool("sentinel")
+        d = str(tmp_path / "hist")
+        _bench_fixture(d, latest_value=100.0)     # ~82% drop
+        out = tmp_path / "TRENDS.json"
+        assert sentinel.main(["--bench-dir", d, "--out",
+                              str(out), "-q"]) == 1
+        doc = json.loads(out.read_text())
+        assert not doc["pass"]
+        gate = {g["name"]: g for g in doc["gates"]}["evals_per_s"]
+        assert gate["status"] == "fail"
+        assert gate["best_previous"] == 571.3
+
+    def test_healthy_synthetic_history_passes(self, tmp_path):
+        sentinel = _load_tool("sentinel")
+        d = str(tmp_path / "hist")
+        _bench_fixture(d, latest_value=560.0)     # within tolerance
+        assert sentinel.main(["--bench-dir", d, "--out",
+                              str(tmp_path / "T.json"), "-q"]) == 0
+
+    def test_dispatch_and_bubble_gates(self, tmp_path):
+        sentinel = _load_tool("sentinel")
+        d = str(tmp_path / "hist")
+        _bench_fixture(d)
+        json.dump({"dispatch": {"full_kernel": {
+            "dispatch_reduction": 1.2, "mega": {"dispatch_ops": 48}}}},
+            open(os.path.join(d, "ROOFLINE.json"), "w"))
+        assert sentinel.main(["--bench-dir", d, "--out",
+                              str(tmp_path / "T.json"), "-q"]) == 1
+
+    def test_stale_device_leg_warns_and_strict_fails(self, tmp_path):
+        sentinel = _load_tool("sentinel")
+        d = str(tmp_path / "hist")
+        _bench_fixture(d)
+        for name in ("BENCH_r04.json", "BENCH_r05.json",
+                     "BENCH_r06.json"):
+            path = os.path.join(d, name)
+            doc = json.load(open(path))
+            doc["parsed"]["last_device"]["measured_at"] = \
+                "2026-01-01T00:00:00"
+            json.dump(doc, open(path, "w"))
+        out = tmp_path / "T.json"
+        assert sentinel.main(["--bench-dir", d, "--out", str(out),
+                              "-q"]) == 0        # warning only
+        doc = json.loads(out.read_text())
+        gate = {g["name"]: g for g in doc["gates"]}["device_leg_fresh"]
+        assert gate["status"] == "warn" and "STALE" in gate["detail"]
+        assert sentinel.main(["--bench-dir", d, "--out", str(out),
+                              "--strict", "-q"]) == 1
+
+    def test_failed_latest_round_warns_never_sails(self, tmp_path):
+        """A newest bench round that produced NO headline value must
+        not silently pass by racing an older record."""
+        sentinel = _load_tool("sentinel")
+        d = str(tmp_path / "hist")
+        _bench_fixture(d)
+        json.dump({"n": 7, "rc": 1, "parsed": None},
+                  open(os.path.join(d, "BENCH_r07.json"), "w"))
+        out = tmp_path / "T.json"
+        assert sentinel.main(["--bench-dir", d, "--out", str(out),
+                              "-q"]) == 0       # warn by default
+        doc = json.loads(out.read_text())
+        gate = {g["name"]: g for g in doc["gates"]}["evals_per_s"]
+        assert gate["status"] == "warn"
+        assert "BENCH_r07" in gate["detail"]
+        assert sentinel.main(["--bench-dir", d, "--out", str(out),
+                              "--strict", "-q"]) == 1
+
+    def test_fresh_run_retrace_gate(self, tmp_path):
+        sentinel = _load_tool("sentinel")
+        d = str(tmp_path / "hist")
+        _bench_fixture(d)
+        run = tmp_path / "run"
+        _write_stream(run, [
+            {"t": 1.0, "type": "run_start", "run_id": "a",
+             "sampler": "ptmcmc"},
+            {"t": 1.0, "type": "run_lineage", "run_id": "a",
+             "parent": None, "reason": "fresh"},
+            {"t": 2.0, "type": "heartbeat", "step": 10, "nsamp": 10,
+             "evals_per_s": 50.0},
+            {"t": 3.0, "type": "run_end", "status": "ok",
+             "metrics": {"counters": {"retraces{fn=ptmcmc_block}": 2},
+                         "gauges": {}, "histograms": {}}},
+        ])
+        assert sentinel.main(["--bench-dir", d, "--run", str(run),
+                              "--out", str(tmp_path / "T.json"),
+                              "-q"]) == 0
+        # a retrace storm trips the gate
+        _write_stream(run, [
+            {"t": 1.0, "type": "run_start", "run_id": "a",
+             "sampler": "ptmcmc"},
+            {"t": 3.0, "type": "run_end", "status": "ok",
+             "metrics": {"counters":
+                         {"retraces{fn=ptmcmc_block}": 40},
+                         "gauges": {}, "histograms": {}}},
+        ])
+        assert sentinel.main(["--bench-dir", d, "--run", str(run),
+                              "--out", str(tmp_path / "T.json"),
+                              "-q"]) == 1
+
+
+# ------------------------------------------------------------------ #
+#  host-side memory satellite                                         #
+# ------------------------------------------------------------------ #
+
+def test_host_rss_gauge_and_report_fold(tmp_path):
+    from enterprise_warp_tpu.utils import profiling
+    rss = profiling.host_rss_bytes()
+    if rss is None:
+        pytest.skip("no /proc/self/statm on this platform")
+    assert rss > 1 << 20            # a python process holds > 1 MiB
+    snap = telemetry.registry().snapshot()["gauges"]
+    assert snap.get("rss_bytes") == float(rss)
+    report = _load_tool("report")
+    _write_stream(tmp_path, [
+        {"t": 1.0, "type": "run_start", "run_id": "a"},
+        {"t": 2.0, "type": "heartbeat", "step": 1, "rss_bytes": 1000,
+         "hbm_peak_bytes": 2048},
+        {"t": 3.0, "type": "heartbeat", "step": 2, "rss_bytes": 3000},
+    ])
+    events, _ = report.load_events(str(tmp_path / "events.jsonl"))
+    rpt = report.build_report(events)
+    assert rpt["memory"]["rss_peak_bytes"] == 3000
+    assert rpt["memory"]["rss_last_bytes"] == 3000
+    assert rpt["memory"]["hbm_peak_bytes"] == 2048
+
+
+# ------------------------------------------------------------------ #
+#  acceptance: chaos-style campaign, stitched end-to-end              #
+# ------------------------------------------------------------------ #
+
+@pytest.mark.slow
+def test_chaos_campaign_lineage_e2e(tmp_path, monkeypatch):
+    """The ISSUE-8 acceptance campaign: two pulsars under one
+    campaign id; pulsar A suffers a SIGKILL at a checkpoint boundary
+    (kill -> resume), pulsar B a dispatch hang that trips the
+    watchdog circuit breaker into a demotion restart (exit 75 ->
+    restart). The stitched campaign report must show one CONNECTED
+    lineage graph, both runs done, and every stream schema-clean."""
+    chaos = _load_tool("chaos")
+    campaign = _load_tool("campaign")
+    report = _load_tool("report")
+
+    workdir = str(tmp_path)
+    monkeypatch.setenv("EWT_CAMPAIGN_ID", "accept8")
+
+    from enterprise_warp_tpu.io.writers import save_pulsar_pair
+    from enterprise_warp_tpu.sim import inject_white, make_fake_pulsar
+    for i, name in enumerate(("data_a", "data_b")):
+        psr = make_fake_pulsar(ntoa=80, backends=("RX",),
+                               toaerr_us=1.0, seed=200 + i)
+        inject_white(psr, efac={"RX": 1.3},
+                     rng=np.random.default_rng(300 + i))
+        save_pulsar_pair(psr, os.path.join(workdir, name))
+    with open(os.path.join(workdir, "nm.json"), "w") as fh:
+        json.dump({"universal": {"efac": "by_backend"}}, fh)
+
+    def prfile(name, datadir, out):
+        path = os.path.join(workdir, name)
+        with open(path, "w") as fh:
+            fh.write("paramfile_label: accept\n"
+                     f"datadir: {datadir}/\n"
+                     f"out: {out}/\n"
+                     "array_analysis: False\n"
+                     "sampler: ptmcmcsampler\n"
+                     "SCAMweight: 30\nAMweight: 15\nDEweight: 50\n"
+                     "nsamp: 300\ncovUpdate: 100\n"
+                     "{0}\nnoise_model_file: nm.json\n")
+        return path
+
+    pr_a = prfile("a.dat", "data_a", "out/psrA")
+    pr_b = prfile("b.dat", "data_b", "out/psrB")
+
+    # pulsar A: SIGKILL at the first durable checkpoint, then resume
+    rc, err = chaos.run_leg(
+        workdir, pr_a,
+        plan={"faults": [{"site": "pt.ckpt", "kind": "kill",
+                          "at": 1}]})
+    assert rc == -signal.SIGKILL, err
+    rc, err = chaos.run_leg(workdir, pr_a)
+    assert rc == 0, err
+
+    # pulsar B: dispatch hang -> watchdog -> breaker -> exit 75 ->
+    # external restart (the demotion re-entry lineage)
+    rc, err = chaos.run_leg(
+        workdir, pr_b,
+        plan={"faults": [{"site": "pt.dispatch", "kind": "hang",
+                          "at": 1, "hang_s": 60}]},
+        watchdog_s=3.0)
+    assert rc == chaos.__dict__.get("EXIT_DEMOTED", 75), err
+    rc, err = chaos.run_leg(workdir, pr_b)
+    assert rc == 0, err
+
+    root = os.path.join(workdir, "out")
+    assert campaign.main([root, "--check", "-q"]) == 0
+    rep = json.loads(
+        open(os.path.join(root, "campaign_report.json")).read())
+    assert rep["lineage"]["connected"], rep["lineage"]
+    assert rep["totals"]["run_dirs"] == 2
+    statuses = sorted(r["status"] for r in rep["runs"])
+    assert statuses == ["done", "done"], rep["runs"]
+    reasons = [s for r in rep["runs"] for s in r["reasons"]]
+    assert "resume" in reasons and "demotion" in reasons
+    assert "accept8" in rep["campaigns"]
+    # the hang emits a flushed fault event; the SIGKILL intentionally
+    # does NOT (the crash is the artifact) — its trace is the resume
+    # session counted above
+    assert rep["totals"]["faults"] >= 1
+    assert rep["totals"]["demotions"] >= 1
+
+    # every stream in the campaign is schema-clean
+    for path in campaign.discover_streams(root):
+        assert report.check_stream(path,
+                                   out=open(os.devnull, "w")) == 0, \
+            path

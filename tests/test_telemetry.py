@@ -126,14 +126,19 @@ def test_event_schema_roundtrip(tmp_path):
     lines = (tmp_path / "events.jsonl").read_text().splitlines()
     events = [json.loads(ln) for ln in lines]
     types = [e["type"] for e in events]
-    assert types == ["run_start", "heartbeat", "checkpoint", "run_end"]
+    assert types == ["run_start", "run_lineage", "heartbeat",
+                     "checkpoint", "run_end"]
+    lin = events[1]
+    assert lin["run_id"] == events[0]["run_id"]
+    assert lin["reason"] == "fresh" and lin["parent"] is None
     for e in events:
         assert isinstance(e["t"], float)
     start = events[0]
     assert start["config_hash"] == "abc123"
+    assert start["campaign"]
     assert start["jax_version"] == jax.__version__
     assert start["backend"] == "cpu"
-    hb = events[1]
+    hb = events[2]
     # numpy scalars/arrays degrade to plain JSON numbers/lists
     assert hb["ess"] == 250.0 and hb["ladder"] == [1.0, 1.7]
     assert hb["evals_per_s"] == 123.4 and hb["cache_hit_rate"] == 0.5
@@ -150,7 +155,7 @@ def test_run_scope_nesting_single_start_end(tmp_path):
     events = [json.loads(ln) for ln in
               (tmp_path / "events.jsonl").read_text().splitlines()]
     assert [e["type"] for e in events] == \
-        ["run_start", "heartbeat", "run_end"]
+        ["run_start", "run_lineage", "heartbeat", "run_end"]
     assert events[0]["sampler"] == "outer"
     assert not (tmp_path / "inner").exists()
 

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+# ewt: allow-no-print module — the fleet console IS this tool's
+# product: it renders the campaign table to stdout (report.py
+# contract); diagnostics go to stderr
+"""Fleet console: fold a whole campaign's event streams into one view.
+
+A PTA campaign is many processes — per-pulsar runs, kill/resume
+re-entries, demotion re-execs, chaos restarts — each appending to its
+run_dir's ``events.jsonl``. This tool scans a campaign output root,
+stitches the per-session ``run_lineage`` pointers into one graph, and
+folds per-pulsar status, throughput, convergence, and fault/retry
+counts into ``<root>/campaign_report.json`` plus a console table.
+
+Usage::
+
+    python tools/campaign.py out/                      # one-shot report
+    python tools/campaign.py out/ --watch              # live console
+    python tools/campaign.py out/ --watch --interval 5
+    python tools/campaign.py out/ -o /tmp/report.json -q
+
+Status vocabulary (terminal session of each run_dir):
+
+- ``running``   — no ``run_end`` yet and the stream is fresh
+  (last event younger than ``--stale-s``);
+- ``done``      — ``run_end(status=ok)`` with no preemption;
+- ``preempted`` — clean SIGTERM stop, checkpoint on disk;
+- ``error``     — ``run_end(status=error)`` (includes sessions that
+  exited through a platform demotion: flagged ``demoted``);
+- ``dead``      — no ``run_end`` and no recent events: killed or
+  crashed, awaiting a resume.
+
+The lineage graph is the campaign's integrity check: ``connected`` is
+true iff every non-``fresh`` session's parent run is present among the
+discovered streams — an orphan means a run_dir's history is
+unreachable (lost stream, foreign run_dir mixed into the root).
+
+``--check`` exits non-zero when the graph is not connected (CI gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+# report.py owns the event-stream parsing, the lineage fold, and the
+# package-free atomic JSON writer; this tool adds the fleet-level
+# aggregation on top (single source of truth for the segment schema)
+from report import (_atomic_write_json, fold_segments,  # noqa: E402
+                    lineage_graph, load_events)
+
+
+def discover_streams(root):
+    """Every ``events.jsonl`` under ``root`` (sorted, stable)."""
+    hits = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if not d.startswith(".")]
+        if "events.jsonl" in filenames:
+            hits.append(os.path.join(dirpath, "events.jsonl"))
+    return sorted(hits)
+
+
+def _run_status(seg, now, stale_s):
+    if seg["status"] == "ok":
+        return "preempted" if seg["end_reason"] == "preempted" \
+            else "done"
+    if seg["status"] == "error":
+        return "error"
+    if seg["t_last"] is not None and now - seg["t_last"] <= stale_s:
+        return "running"
+    return "dead"
+
+
+def fold_campaign(root, now=None, stale_s=300.0):
+    """Scan ``root`` and fold every stream into the campaign report
+    structure (see module docstring)."""
+    # ewt: allow-no-raw-timing — staleness is judged against the
+    # streams' unix-epoch 't' fields; this standalone console never
+    # loads the (jax-importing) profiling clocks
+    now = time.time() if now is None else now
+    streams = discover_streams(root)
+    all_segs = []
+    runs = []
+    for path in streams:
+        events, dropped = load_events(path)
+        rel = os.path.relpath(os.path.dirname(path), root)
+        segs = fold_segments(events, stream=rel)
+        all_segs.extend(segs)
+        if not segs:
+            runs.append({"run_dir": rel, "status": "empty",
+                         "sessions": 0, "dropped_lines": dropped})
+            continue
+        term = segs[-1]
+        counts = {k: sum(s["counts"][k] for s in segs)
+                  for k in segs[0]["counts"]}
+        status = _run_status(term, now, stale_s)
+        step = term["step"]
+        nsamp = term["nsamp"]
+        runs.append({
+            "run_dir": rel,
+            "pulsar": os.path.basename(rel.rstrip("/")) or rel,
+            "campaign": term["campaign"],
+            "sampler": term["sampler"],
+            "status": status,
+            "demoted": counts["demotion"] > 0,
+            "anomaly": counts["anomaly"] > 0,
+            "sessions": len(segs),
+            "chain": [s["run_id"] for s in segs],
+            "reasons": [s["reason"] or "fresh" for s in segs],
+            "step": step,
+            "nsamp": nsamp,
+            "progress": (round(step / nsamp, 4)
+                         if step is not None and nsamp else None),
+            "evals_per_s": term["evals_per_s"],
+            "evals_total": term["evals_total"],
+            "rhat": term["rhat"],
+            "ess": term["ess"],
+            "faults": counts["fault"],
+            "retries": counts["retry"],
+            "demotions": counts["demotion"],
+            "anomalies": counts["anomaly"],
+            "checkpoints": counts["checkpoint"],
+            "heartbeats": counts["heartbeat"],
+            "dropped_lines": dropped,
+            "last_event_age_s": (round(now - term["t_last"], 1)
+                                 if term["t_last"] is not None
+                                 else None),
+        })
+
+    graph = lineage_graph(all_segs)
+    by_status: dict = {}
+    for r in runs:
+        by_status[r["status"]] = by_status.get(r["status"], 0) + 1
+    campaigns = sorted({r.get("campaign") for r in runs
+                        if r.get("campaign")})
+    live_rate = sum(r["evals_per_s"] or 0.0 for r in runs
+                    if r["status"] == "running")
+    return {
+        "root": os.path.abspath(root),
+        "generated_unix": round(now, 3),
+        "stale_s": stale_s,
+        "campaigns": campaigns,
+        "runs": runs,
+        "totals": {
+            "run_dirs": len(runs),
+            "sessions": len(all_segs),
+            "by_status": by_status,
+            "resumes": sum(1 for s in all_segs
+                           if s["reason"] == "resume"),
+            "demotion_reentries": sum(1 for s in all_segs
+                                      if s["reason"] == "demotion"),
+            "preempt_restarts": sum(1 for s in all_segs
+                                    if s["reason"] == "preempt-restart"),
+            "faults": sum(r.get("faults", 0) for r in runs),
+            "retries": sum(r.get("retries", 0) for r in runs),
+            "demotions": sum(r.get("demotions", 0) for r in runs),
+            "anomalies": sum(r.get("anomalies", 0) for r in runs),
+            "aggregate_running_evals_per_s": round(live_rate, 1),
+        },
+        "lineage": graph,
+    }
+
+
+# ------------------------------------------------------------------ #
+#  console rendering                                                  #
+# ------------------------------------------------------------------ #
+
+_STATUS_ORDER = {"error": 0, "dead": 1, "running": 2, "preempted": 3,
+                 "demoted": 4, "done": 5, "empty": 6}
+
+
+def render(report, out=sys.stdout):
+    """The fleet table: one row per run_dir, worst news first."""
+    def p(msg=""):
+        print(msg, file=out)
+
+    t = report["totals"]
+    g = report["lineage"]
+    p(f"campaign root: {report['root']}")
+    p(f"runs: {t['run_dirs']} dirs / {t['sessions']} sessions  "
+      + "  ".join(f"{k}={v}"
+                  for k, v in sorted(t["by_status"].items())))
+    p(f"lineage: {g['nodes']} runs, {len(g['edges'])} links, "
+      + ("connected" if g["connected"]
+         else f"{len(g['orphans'])} ORPHAN(S)")
+      + f"; resumes={t['resumes']} demotions={t['demotion_reentries']}"
+        f" preempt-restarts={t['preempt_restarts']}")
+    p(f"faults={t['faults']} retries={t['retries']} "
+      f"anomalies={t['anomalies']} | running throughput "
+      f"{t['aggregate_running_evals_per_s']} evals/s")
+    p()
+    hdr = (f"{'run_dir':32s} {'status':10s} {'prog':>6s} "
+           f"{'evals/s':>9s} {'rhat':>7s} {'sess':>4s} "
+           f"{'flt':>3s} {'rty':>3s} {'dmt':>3s} lineage")
+    p(hdr)
+    p("-" * len(hdr))
+    rows = sorted(report["runs"],
+                  key=lambda r: (_STATUS_ORDER.get(r["status"], 9),
+                                 r["run_dir"]))
+    for r in rows:
+        if r["status"] == "empty":
+            p(f"{r['run_dir'][:32]:32s} {'empty':10s}")
+            continue
+        prog = (f"{100.0 * r['progress']:.0f}%"
+                if r.get("progress") is not None else "-")
+        rate = (f"{r['evals_per_s']:.0f}"
+                if r.get("evals_per_s") is not None else "-")
+        rhat = (f"{r['rhat']:.3f}" if r.get("rhat") is not None
+                else "-")
+        flags = ("!" if r.get("anomaly") else "") \
+            + ("v" if r.get("demoted") else "")
+        reasons = ">".join({"fresh": "F", "resume": "R",
+                            "demotion": "D",
+                            "preempt-restart": "P"}.get(x, "?")
+                           for x in r["reasons"])
+        p(f"{r['run_dir'][:32]:32s} {(r['status'] + flags):10s} "
+          f"{prog:>6s} {rate:>9s} {rhat:>7s} {r['sessions']:>4d} "
+          f"{r['faults']:>3d} {r['retries']:>3d} "
+          f"{r['demotions']:>3d} {reasons}")
+    if g["orphans"]:
+        p()
+        for o in g["orphans"]:
+            p(f"ORPHAN: {o['stream']} run={o['run_id']} "
+              f"reason={o['reason']} parent={o['parent']} (not found)")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fold a campaign root's event streams into "
+                    "campaign_report.json + a fleet console")
+    ap.add_argument("root", help="campaign output root to scan")
+    ap.add_argument("-o", "--output", default=None,
+                    help="report path (default "
+                         "<root>/campaign_report.json)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="write the JSON report only, no console")
+    ap.add_argument("--watch", action="store_true",
+                    help="live mode: re-scan and re-render until "
+                         "interrupted")
+    ap.add_argument("--interval", type=float, default=10.0,
+                    help="watch refresh seconds (default 10)")
+    ap.add_argument("--stale-s", type=float, default=300.0,
+                    help="seconds without events before a run with no "
+                         "run_end counts as dead (default 300)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero unless the lineage graph is "
+                         "fully connected (no orphan run_dirs)")
+    opts = ap.parse_args(argv)
+
+    if not os.path.isdir(opts.root):
+        print(f"no campaign root at {opts.root}", file=sys.stderr)
+        return 2
+    out_path = opts.output or os.path.join(opts.root,
+                                           "campaign_report.json")
+    while True:
+        report = fold_campaign(opts.root, stale_s=opts.stale_s)
+        _atomic_write_json(out_path, report)
+        if not opts.quiet:
+            if opts.watch:
+                # cursor home, overdraw in place, then erase whatever
+                # of the previous (taller) frame remains below — no
+                # full-screen clear, so the frame never flickers blank
+                sys.stdout.write("\x1b[H")
+            render(report)
+            print(f"report: {out_path}"
+                  + (f"  (refresh {opts.interval}s, ctrl-c to stop)"
+                     if opts.watch else ""))
+            if opts.watch:
+                sys.stdout.write("\x1b[0J")
+                sys.stdout.flush()
+        if not opts.watch:
+            break
+        try:
+            time.sleep(max(opts.interval, 0.2))
+        except KeyboardInterrupt:
+            break
+    if opts.check and not report["lineage"]["connected"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,7 @@ Usage::
 
     python tools/report.py <run_dir | events.jsonl> [-o run_report.json]
     python tools/report.py out/examp_1_t1/0_J1832-0836/
+    python tools/report.py out/psrA out/psrB     # lineage-aware stitch
 
 Reads ``events.jsonl`` (written by ``utils/telemetry.py`` — see
 ``docs/observability.md`` for the event schema), folds it into
@@ -25,6 +26,14 @@ heartbeat ``hbm_*`` watermarks fold into a ``memory`` section; and an
 renders as a postmortem section in both the JSON report and the human
 summary.
 
+Campaign-layer folds (PR 8): every report carries a ``lineage``
+section — per-session ``run_id``/``parent``/``reason`` from the
+``run_lineage`` events plus the connectivity verdict — and passing
+SEVERAL paths stitches their streams into one campaign-level lineage
+graph (``tools/campaign.py`` builds the full fleet view on top).
+Heartbeat ``rss_bytes`` folds into the memory section alongside the
+HBM watermarks.
+
 ``--check`` mode: schema-validate the stream instead of folding it —
 unknown event types, torn/malformed records, and span open/close
 imbalance are reported and exit non-zero, so CI can gate on stream
@@ -44,10 +53,13 @@ import sys
 
 #: the typed-event vocabulary (docs/observability.md;
 #: ``fault``/``retry``/``demotion`` from the resilience layer,
-#: docs/resilience.md). ``--check`` flags anything else as unknown.
+#: docs/resilience.md; ``run_lineage``/``metrics_export`` from the
+#: campaign-observability layer). ``--check`` flags anything else as
+#: unknown.
 KNOWN_EVENT_TYPES = frozenset({
     "run_start", "run_end", "compile", "heartbeat", "checkpoint",
     "span", "cost_analysis", "anomaly", "fault", "retry", "demotion",
+    "run_lineage", "metrics_export",
 })
 
 
@@ -88,6 +100,86 @@ def load_events(path):
     return events, dropped
 
 
+def fold_segments(events, stream=None):
+    """Split one stream's events into process-session segments (each
+    ``run_start``.. up to the next ``run_start``), carrying the run
+    lineage identity the campaign layer stitches on. Events before the
+    first ``run_start`` (a stream whose head was lost) fold into a
+    synthetic id-less segment."""
+    segments = []
+    cur = None
+
+    def fresh():
+        return {"stream": stream, "run_id": None, "campaign": None,
+                "parent": None, "reason": None, "sampler": None,
+                "t0": None, "t_last": None, "status": None,
+                "end_reason": None, "events": 0,
+                "counts": {"fault": 0, "retry": 0, "demotion": 0,
+                           "anomaly": 0, "checkpoint": 0,
+                           "heartbeat": 0},
+                "step": None, "nsamp": None, "evals_per_s": None,
+                "evals_total": None, "rhat": None, "ess": None}
+
+    for ev in events:
+        t = ev.get("type")
+        if t == "run_start" or cur is None:
+            cur = fresh()
+            segments.append(cur)
+        cur["events"] += 1
+        cur["t0"] = cur["t0"] if cur["t0"] is not None else ev.get("t")
+        cur["t_last"] = ev.get("t", cur["t_last"])
+        if t == "run_start":
+            cur["run_id"] = ev.get("run_id")
+            cur["campaign"] = ev.get("campaign")
+            cur["sampler"] = ev.get("sampler")
+        elif t == "run_lineage":
+            cur["run_id"] = ev.get("run_id") or cur["run_id"]
+            cur["campaign"] = ev.get("campaign") or cur["campaign"]
+            cur["parent"] = ev.get("parent")
+            cur["reason"] = ev.get("reason")
+        elif t == "run_end":
+            cur["status"] = ev.get("status")
+            cur["end_reason"] = ev.get("reason")
+        elif t == "heartbeat":
+            c = cur["counts"]
+            c["heartbeat"] += 1
+            for k in ("step", "nsamp", "evals_per_s", "evals_total",
+                      "rhat", "ess"):
+                if ev.get(k) is not None:
+                    cur[k] = ev[k]
+            # nested heartbeats carry 'iteration', never 'step' — the
+            # fallback must track EVERY heartbeat, not just the first
+            if ev.get("step") is None \
+                    and ev.get("iteration") is not None:
+                cur["step"] = ev["iteration"]
+        elif t in ("fault", "retry", "demotion", "anomaly",
+                   "checkpoint"):
+            cur["counts"][t] += 1
+    return segments
+
+
+def lineage_graph(segments):
+    """Stitch session segments (possibly from many streams) into the
+    campaign lineage graph: parent->child edges via the ``run_lineage``
+    pointers. A segment claiming a predecessor (any non-``fresh``
+    reason) whose parent id is not among the known runs is an ORPHAN —
+    its history is unreachable, which is exactly the broken-campaign
+    condition ``connected`` reports."""
+    ids = {s["run_id"] for s in segments if s.get("run_id")}
+    edges = []
+    orphans = []
+    for s in segments:
+        if s.get("parent") and s["parent"] in ids:
+            edges.append([s["parent"], s["run_id"]])
+        elif s.get("reason") not in (None, "fresh"):
+            orphans.append({"run_id": s.get("run_id"),
+                            "stream": s.get("stream"),
+                            "parent": s.get("parent"),
+                            "reason": s.get("reason")})
+    return {"nodes": len(ids), "edges": edges, "orphans": orphans,
+            "connected": not orphans}
+
+
 def build_report(events, dropped=0):
     """Fold a list of event dicts into the run-report structure.
 
@@ -100,6 +192,16 @@ def build_report(events, dropped=0):
     between sessions.
     """
     sessions = sum(1 for ev in events if ev["type"] == "run_start")
+    # lineage over the WHOLE stream (every session), before the fold
+    # below narrows to the latest segment
+    segs = fold_segments(events)
+    lineage = {
+        "sessions": [{k: s[k] for k in ("run_id", "campaign", "parent",
+                                        "reason", "sampler", "status",
+                                        "end_reason")}
+                     for s in segs],
+        "graph": lineage_graph(segs),
+    } if segs else None
     for i in range(len(events) - 1, -1, -1):
         if events[i]["type"] == "run_start":
             events = events[i:]
@@ -191,23 +293,28 @@ def build_report(events, dropped=0):
         d["device_ms"] = round(d["device_ms"]
                                + float(ev.get("device_ms") or 0.0), 3)
 
-    # ---- device-memory watermarks (profiling layer) ----------------- #
+    # ---- memory watermarks (device HBM + host RSS) ------------------ #
     hbm_peaks = [hb["hbm_peak_bytes"] for hb in heartbeats
                  if hb.get("hbm_peak_bytes") is not None]
     hbm_last = [hb["hbm_in_use_bytes"] for hb in heartbeats
                 if hb.get("hbm_in_use_bytes") is not None]
+    rss = [hb["rss_bytes"] for hb in heartbeats
+           if hb.get("rss_bytes") is not None]
     memory = None
-    if hbm_peaks or hbm_last:
+    if hbm_peaks or hbm_last or rss:
         memory = {
             "hbm_peak_bytes": max(hbm_peaks) if hbm_peaks else None,
             "hbm_last_in_use_bytes": (hbm_last[-1] if hbm_last
                                       else None),
+            "rss_peak_bytes": max(rss) if rss else None,
+            "rss_last_bytes": rss[-1] if rss else None,
         }
 
     report = {
         "run": dict(starts[0], t=None) if starts else {},
         "status": (ends[-1].get("status") if ends else "in_flight"),
         "sessions_in_stream": max(sessions, 1),
+        "lineage": lineage,
         "events": {k: len(v) for k, v in sorted(by_type.items())},
         "dropped_lines": dropped,
         "wall_clock": {
@@ -286,6 +393,15 @@ def _human_summary(report, out=sys.stdout):
       f"jax={run.get('jax_version', '?')} "
       f"config={run.get('config_hash', '-')} "
       f"status={report['status']}")
+    lin = report.get("lineage")
+    if lin and lin.get("sessions"):
+        chain = " -> ".join(
+            f"{s.get('run_id') or '?'}({s.get('reason') or 'fresh'})"
+            for s in lin["sessions"])
+        g = lin.get("graph") or {}
+        p(f"lineage: {chain}"
+          + ("" if g.get("connected", True)
+             else f"  [BROKEN: {len(g.get('orphans', []))} orphan(s)]"))
     if w["total_s"] is not None:
         p(f"wall-clock: total {w['total_s']}s = compile "
           f"{w['compile_s']}s + sample {w['sample_s']}s")
@@ -334,6 +450,11 @@ def _human_summary(report, out=sys.stdout):
           + (f", last in-use "
              f"{mem['hbm_last_in_use_bytes'] / 2**20:.1f} MiB"
              if mem.get("hbm_last_in_use_bytes") is not None else ""))
+    if mem and mem.get("rss_peak_bytes") is not None:
+        p(f"host memory: peak {mem['rss_peak_bytes'] / 2**20:.1f} "
+          f"MiB RSS"
+          + (f", last {mem['rss_last_bytes'] / 2**20:.1f} MiB"
+             if mem.get("rss_last_bytes") is not None else ""))
     p(f"checkpoints: {report['checkpoints']}, heartbeats: "
       f"{report['events'].get('heartbeat', 0)}")
     pm = report.get("postmortem")
@@ -473,17 +594,48 @@ def check_stream(path, out=sys.stdout):
     return problems
 
 
+def build_stitched_report(streams):
+    """Lineage-aware multi-stream stitch: ``streams`` is
+    ``[(path, events, dropped), ...]`` — one run_dir each (a demotion
+    re-exec chain split across output dirs, two pulsars of one
+    campaign, ...). Each stream gets its own fold; the campaign-level
+    lineage graph is stitched across ALL of them, so a child whose
+    parent session lives in a different stream still links up."""
+    all_segs = []
+    per_stream = {}
+    for path, events, dropped in streams:
+        all_segs.extend(fold_segments(events, stream=path))
+        sub = build_report(events, dropped)
+        # same forensics contract as the single-path report: a
+        # stream's anomaly/ dump must not vanish just because it was
+        # inspected as part of its campaign
+        sub["postmortem"] = load_postmortem(os.path.dirname(path))
+        per_stream[path] = sub
+    return {
+        "streams": per_stream,
+        "lineage": {
+            "sessions": [{k: s[k] for k in
+                          ("stream", "run_id", "campaign", "parent",
+                           "reason", "sampler", "status",
+                           "end_reason")} for s in all_segs],
+            "graph": lineage_graph(all_segs),
+        },
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="fold a telemetry events.jsonl into run_report.json")
-    ap.add_argument("path", help="run directory or events.jsonl file")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="run directory or events.jsonl file; several "
+                         "paths stitch into one lineage-aware report")
     ap.add_argument("-o", "--output", default=None,
                     help="report path (default <run_dir>/"
                          "run_report.json)")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="write the JSON report only, no summary")
     ap.add_argument("--check", action="store_true",
-                    help="schema-validate the stream (unknown event "
+                    help="schema-validate the stream(s) (unknown event "
                          "types, torn records, span imbalance) and "
                          "exit non-zero on problems; writes no report")
     ap.add_argument("--repair", action="store_true",
@@ -493,30 +645,55 @@ def main(argv=None):
                          "combine with --check to validate the result")
     opts = ap.parse_args(argv)
 
-    path = opts.path
-    if os.path.isdir(path):
-        path = os.path.join(path, "events.jsonl")
-    if not os.path.exists(path):
-        print(f"no event stream at {path}", file=sys.stderr)
-        return 1
+    paths = []
+    for path in opts.paths:
+        if os.path.isdir(path):
+            path = os.path.join(path, "events.jsonl")
+        if not os.path.exists(path):
+            print(f"no event stream at {path}", file=sys.stderr)
+            return 1
+        paths.append(path)
     if opts.repair:
-        repair_stream(path)
+        for path in paths:
+            repair_stream(path)
         if not opts.check:
             return 0
     if opts.check:
-        return 1 if check_stream(path) else 0
-    events, dropped = load_events(path)
-    if not events:
-        print(f"{path}: no parseable events", file=sys.stderr)
-        return 1
-    report = build_report(events, dropped)
-    report["postmortem"] = load_postmortem(os.path.dirname(path))
+        problems = sum(check_stream(path) for path in paths)
+        return 1 if problems else 0
 
-    out_path = opts.output or os.path.join(os.path.dirname(path),
-                                           "run_report.json")
+    streams = []
+    for path in paths:
+        events, dropped = load_events(path)
+        if not events:
+            print(f"{path}: no parseable events", file=sys.stderr)
+            return 1
+        streams.append((path, events, dropped))
+
+    if len(streams) == 1:
+        path, events, dropped = streams[0]
+        report = build_report(events, dropped)
+        report["postmortem"] = load_postmortem(os.path.dirname(path))
+        out_path = opts.output or os.path.join(os.path.dirname(path),
+                                               "run_report.json")
+        _atomic_write_json(out_path, report)
+        if not opts.quiet:
+            _human_summary(report)
+            print(f"report: {out_path}")
+        return 0
+
+    report = build_stitched_report(streams)
+    out_path = opts.output or "run_report_stitched.json"
     _atomic_write_json(out_path, report)
     if not opts.quiet:
-        _human_summary(report)
+        for path, sub in report["streams"].items():
+            print(f"== {path}")
+            _human_summary(sub)
+        g = report["lineage"]["graph"]
+        print(f"campaign lineage: {g['nodes']} runs, "
+              f"{len(g['edges'])} links, "
+              + ("connected" if g["connected"]
+                 else f"{len(g['orphans'])} ORPHAN(S)"))
         print(f"report: {out_path}")
     return 0
 

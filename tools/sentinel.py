@@ -1,0 +1,405 @@
+#!/usr/bin/env python
+# ewt: allow-no-print module — gate verdicts and the TRENDS summary
+# are this CI tool's product (stdout); failures also exit non-zero
+"""Perf-regression sentinel: gate the committed benchmark trajectory.
+
+The BENCH_*.json artifacts record the repo's performance story, but
+nothing machine-checks that the story keeps moving forward — ROADMAP
+standing maintenance notes the device legs went stale *unnoticed*.
+This tool folds the benchmark history (plus, optionally, a fresh run's
+telemetry stream) into ``TRENDS.json`` and applies threshold gates:
+
+- ``evals_per_s``       — the newest headline BENCH_r record must not
+  drop more than ``--tol`` below the best previous record of the SAME
+  leg (device numbers race device numbers, CPU-fallback races
+  CPU-fallback; comparing across legs would hide a 50x cliff);
+- ``dispatch_ops``      — ROOFLINE.json's fused-kernel dispatch
+  reduction must hold the committed floor (``--min-dispatch-red``);
+- ``bubble_fraction``   — BENCH_PIPELINE.json's block-boundary
+  pipeline must keep its bubble reduction and host-boundary share;
+- ``retraces`` / ``nonfinite`` / ``bubble`` (with ``--run <run_dir>``)
+  — a fresh run's events.jsonl must show a bounded retrace count per
+  traced fn, zero non-finite evals, and a sane bubble fraction;
+- ``device_leg_fresh``  — the newest headline must have been measured
+  on a real device within ``--stale-days``; a CPU-fallback headline or
+  an aged device figure is a WARNING (``--strict`` promotes warnings
+  to failures) — the "went stale unnoticed" alarm.
+
+Exit status: 0 = all gates pass (warnings allowed unless --strict),
+1 = at least one gate failed, 2 = no benchmark history found.
+
+Usage::
+
+    python tools/sentinel.py                      # gate the repo root
+    python tools/sentinel.py --run out/0_J1832/   # + fresh-run gates
+    python tools/sentinel.py --bench-dir /tmp/hist --out /tmp/T.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from datetime import datetime, timedelta
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+from report import (_atomic_write_json, build_report,  # noqa: E402
+                    load_events)
+
+
+def _load_json(path):
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _leg(parsed):
+    """Which hardware leg a headline BENCH_r record raced on: explicit
+    ``device_unavailable`` wins, else the unit string's own words."""
+    if parsed.get("device_unavailable"):
+        return "cpu-fallback"
+    unit = str(parsed.get("unit", ""))
+    return "cpu-fallback" if "cpu" in unit.lower() else "device"
+
+
+def bench_history(bench_dir):
+    """The headline series: ``BENCH_r<N>.json`` records (driver
+    wrappers hold the payload under ``parsed``), ordered by round.
+    Unparseable/failed rounds are kept as gaps (visible in TRENDS,
+    never silently dropped)."""
+    series = []
+    for path in sorted(glob.glob(os.path.join(bench_dir,
+                                              "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m is None:
+            continue
+        doc = _load_json(path)
+        parsed = (doc or {}).get("parsed", doc)
+        entry = {"round": int(m.group(1)),
+                 "source": os.path.basename(path)}
+        if not isinstance(parsed, dict) or parsed.get("value") is None:
+            entry.update(value=None, leg=None,
+                         note="no parsed headline (failed round)")
+        else:
+            entry.update(value=float(parsed["value"]),
+                         leg=_leg(parsed),
+                         metric=parsed.get("metric"),
+                         unit=parsed.get("unit"))
+            ld = parsed.get("last_device")
+            if isinstance(ld, dict):
+                entry["last_device"] = {
+                    "value": ld.get("value"),
+                    "measured_at": ld.get("measured_at")}
+        series.append(entry)
+    # by ROUND, not filename: lexicographic sort puts r100 before r99
+    # once rounds outgrow the zero-padding, and the gates race
+    # whatever sits last in the series
+    series.sort(key=lambda e: e["round"])
+    return series
+
+
+# ------------------------------------------------------------------ #
+#  gates                                                              #
+# ------------------------------------------------------------------ #
+
+def _gate(name, status, detail, **extra):
+    g = {"name": name, "status": status, "detail": detail}
+    g.update(extra)
+    return g
+
+
+def gate_evals(series, tol):
+    """Newest headline vs best previous record of the same leg."""
+    if series and series[-1].get("value") is None:
+        # the newest round produced NO number at all — the most
+        # extreme "regression went unnoticed" shape; never let it
+        # sail past by silently racing an older record
+        return _gate("evals_per_s", "warn",
+                     f"latest bench round ({series[-1]['source']}) "
+                     "produced no headline value (failed round?) — "
+                     "nothing to gate",
+                     source=series[-1]["source"])
+    valued = [e for e in series if e.get("value") is not None]
+    if not valued:
+        return _gate("evals_per_s", "warn",
+                     "no headline BENCH_r records with a value")
+    latest = valued[-1]
+    prev = [e["value"] for e in valued[:-1]
+            if e.get("leg") == latest["leg"]]
+    if not prev:
+        return _gate("evals_per_s", "pass",
+                     f"first record of the {latest['leg']} leg "
+                     f"({latest['value']} evals/s) — nothing to race",
+                     value=latest["value"], leg=latest["leg"])
+    best = max(prev)
+    floor = (1.0 - tol) * best
+    if latest["value"] < floor:
+        return _gate(
+            "evals_per_s", "fail",
+            f"{latest['source']}: {latest['value']} evals/s is below "
+            f"{floor:.1f} (best previous {latest['leg']} record "
+            f"{best} - {100 * tol:.0f}% tolerance)",
+            value=latest["value"], best_previous=best,
+            floor=round(floor, 1), leg=latest["leg"])
+    return _gate("evals_per_s", "pass",
+                 f"{latest['value']} evals/s vs best previous "
+                 f"{latest['leg']} {best} (floor {floor:.1f})",
+                 value=latest["value"], best_previous=best,
+                 floor=round(floor, 1), leg=latest["leg"])
+
+
+def gate_dispatch(bench_dir, min_reduction):
+    roof = _load_json(os.path.join(bench_dir, "ROOFLINE.json"))
+    disp = ((roof or {}).get("dispatch") or {}).get("full_kernel")
+    if not disp:
+        return _gate("dispatch_ops", "warn",
+                     "no ROOFLINE.json dispatch record")
+    red = disp.get("dispatch_reduction")
+    mega = (disp.get("mega") or {}).get("dispatch_ops")
+    if red is None:
+        return _gate("dispatch_ops", "warn",
+                     "dispatch record lacks dispatch_reduction")
+    if red < min_reduction:
+        return _gate("dispatch_ops", "fail",
+                     f"fused-kernel dispatch reduction {red}x fell "
+                     f"below the committed {min_reduction}x floor "
+                     f"(mega dispatch_ops={mega})",
+                     reduction=red, floor=min_reduction,
+                     mega_dispatch_ops=mega)
+    return _gate("dispatch_ops", "pass",
+                 f"dispatch reduction {red}x (floor {min_reduction}x; "
+                 f"mega dispatch_ops={mega})",
+                 reduction=red, floor=min_reduction,
+                 mega_dispatch_ops=mega)
+
+
+def gate_bubble(bench_dir, min_reduction, max_host_fraction):
+    pipe = _load_json(os.path.join(bench_dir, "BENCH_PIPELINE.json"))
+    if not pipe:
+        return _gate("bubble_fraction", "warn",
+                     "no BENCH_PIPELINE.json record")
+    red = pipe.get("bubble_reduction")
+    host = pipe.get("host_boundary_fraction")
+    if red is None and host is None:
+        # a record that lost both fields is a disabled gate, not a
+        # pass (mirror gate_dispatch's missing-field contract)
+        return _gate("bubble_fraction", "warn",
+                     "BENCH_PIPELINE.json lacks bubble_reduction and "
+                     "host_boundary_fraction")
+    problems = []
+    if red is not None and red < min_reduction:
+        problems.append(f"bubble_reduction {red}x < floor "
+                        f"{min_reduction}x")
+    if host is not None and host > max_host_fraction:
+        problems.append(f"host_boundary_fraction {host} > cap "
+                        f"{max_host_fraction}")
+    if problems:
+        return _gate("bubble_fraction", "fail", "; ".join(problems),
+                     bubble_reduction=red, host_boundary_fraction=host)
+    return _gate("bubble_fraction", "pass",
+                 f"bubble_reduction {red}x, host_boundary_fraction "
+                 f"{host}", bubble_reduction=red,
+                 host_boundary_fraction=host)
+
+
+def gate_staleness(series, stale_days, now=None):
+    """The "device leg went stale unnoticed" alarm: the newest
+    headline must be a device measurement young enough to trust."""
+    valued = [e for e in series if e.get("value") is not None]
+    if not valued:
+        return _gate("device_leg_fresh", "warn", "no headline records")
+    latest = valued[-1]
+    if latest.get("leg") == "device":
+        return _gate("device_leg_fresh", "pass",
+                     f"latest headline ({latest['source']}) is a "
+                     "device measurement")
+    # CPU-fallback headline: how old is the newest device figure?
+    stamps = []
+    for e in valued:
+        ld = e.get("last_device") or {}
+        if ld.get("measured_at"):
+            stamps.append(str(ld["measured_at"]))
+    if not stamps:
+        return _gate("device_leg_fresh", "warn",
+                     f"latest headline ({latest['source']}) ran on "
+                     "CPU fallback and no device measurement is "
+                     "dated anywhere in the history")
+    newest = max(stamps)
+    try:
+        stamp = datetime.fromisoformat(newest)
+        # a tz-aware stamp minus naive now() is a TypeError, not a
+        # ValueError — normalize instead of crashing the gate
+        stamp = stamp.replace(tzinfo=None)
+        age = (datetime.now() if now is None else now) - stamp
+    except (ValueError, TypeError):
+        return _gate("device_leg_fresh", "warn",
+                     f"undatable device timestamp {newest!r}")
+    if age > timedelta(days=stale_days):
+        return _gate("device_leg_fresh", "warn",
+                     f"device leg is STALE: last true device figure "
+                     f"dated {newest}, {age.days} day(s) old "
+                     f"(cap {stale_days}); headline is CPU fallback",
+                     last_device_at=newest, age_days=age.days)
+    return _gate("device_leg_fresh", "pass",
+                 f"headline is CPU fallback but the device figure "
+                 f"({newest}) is {age.days} day(s) old "
+                 f"(cap {stale_days})",
+                 last_device_at=newest, age_days=age.days)
+
+
+def gate_run(run_dir, max_retraces, max_bubble):
+    """Fresh-run gates from a run_dir's events.jsonl fold."""
+    path = run_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    if not os.path.exists(path):
+        return [_gate("run_telemetry", "fail",
+                      f"no events.jsonl under {run_dir}")]
+    events, _dropped = load_events(path)
+    if not events:
+        return [_gate("run_telemetry", "fail",
+                      f"{path}: no parseable events")]
+    rep = build_report(events)
+    gates = []
+    # retraces per traced fn from the final registry snapshot (fall
+    # back to compile events for an in-flight stream)
+    counters = ((rep.get("metrics") or {}).get("counters") or {})
+    retr = {k: v for k, v in counters.items()
+            if k.startswith("retraces{")}
+    if not retr:
+        retr = {f"compile:{fn}": d["count"]
+                for fn, d in rep["compiles"]["per_fn"].items()}
+    worst = max(retr.values(), default=0)
+    if worst > max_retraces:
+        bad = sorted((k for k, v in retr.items()
+                      if v > max_retraces))
+        gates.append(_gate("retraces", "fail",
+                           f"retrace storm: {', '.join(bad)} exceed "
+                           f"the {max_retraces}-retrace cap",
+                           worst=worst, cap=max_retraces))
+    else:
+        gates.append(_gate("retraces", "pass",
+                           f"worst traced fn retraced {worst}x "
+                           f"(cap {max_retraces})",
+                           worst=worst, cap=max_retraces))
+    nonf = sum(v for k, v in counters.items()
+               if k.startswith("nonfinite_eval"))
+    gates.append(_gate("nonfinite", "pass" if nonf == 0 else "fail",
+                       f"{nonf} non-finite evaluation(s) recorded",
+                       count=nonf))
+    bf = (rep.get("wall_clock") or {}).get("bubble_fraction")
+    if bf is None:
+        gates.append(_gate("bubble", "warn",
+                           "run carries no bubble telemetry"))
+    elif bf > max_bubble:
+        gates.append(_gate("bubble", "fail",
+                           f"bubble_fraction {bf} > cap {max_bubble} "
+                           "(device idles at block boundaries)",
+                           bubble_fraction=bf, cap=max_bubble))
+    else:
+        gates.append(_gate("bubble", "pass",
+                           f"bubble_fraction {bf} (cap {max_bubble})",
+                           bubble_fraction=bf, cap=max_bubble))
+    return gates
+
+
+# ------------------------------------------------------------------ #
+#  driver                                                             #
+# ------------------------------------------------------------------ #
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fold BENCH history (+ a fresh run) into "
+                    "TRENDS.json and gate the perf trajectory")
+    ap.add_argument("--bench-dir", default=os.path.dirname(_HERE),
+                    help="directory holding the BENCH_*.json history "
+                         "(default: repo root)")
+    ap.add_argument("--run", default=None,
+                    help="run_dir (or events.jsonl) of a fresh run to "
+                         "gate alongside the history")
+    ap.add_argument("--out", default=None,
+                    help="TRENDS.json path (default "
+                         "<bench-dir>/TRENDS.json)")
+    ap.add_argument("--tol", type=float, default=0.15,
+                    help="allowed fractional drop of the headline "
+                         "evals/s vs the best same-leg record "
+                         "(default 0.15)")
+    ap.add_argument("--min-dispatch-red", type=float, default=5.0,
+                    help="fused-kernel dispatch-reduction floor "
+                         "(default 5.0, the committed contract)")
+    ap.add_argument("--min-bubble-red", type=float, default=2.0,
+                    help="pipeline bubble-reduction floor (default 2)")
+    ap.add_argument("--max-host-fraction", type=float, default=0.5,
+                    help="host_boundary_fraction cap (default 0.5)")
+    ap.add_argument("--max-retraces", type=int, default=8,
+                    help="per-fn retrace cap for --run (default 8)")
+    ap.add_argument("--max-bubble", type=float, default=0.6,
+                    help="bubble_fraction cap for --run (default 0.6)")
+    ap.add_argument("--stale-days", type=int, default=7,
+                    help="device-leg staleness horizon (default 7)")
+    ap.add_argument("--strict", action="store_true",
+                    help="promote warnings (stale device leg, missing "
+                         "records) to failures")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    opts = ap.parse_args(argv)
+
+    series = bench_history(opts.bench_dir)
+    if not series and opts.run is None:
+        print(f"no BENCH_r*.json history under {opts.bench_dir}",
+              file=sys.stderr)
+        return 2
+
+    gates = [
+        gate_evals(series, opts.tol),
+        gate_dispatch(opts.bench_dir, opts.min_dispatch_red),
+        gate_bubble(opts.bench_dir, opts.min_bubble_red,
+                    opts.max_host_fraction),
+        gate_staleness(series, opts.stale_days),
+    ]
+    if opts.run is not None:
+        gates.extend(gate_run(opts.run, opts.max_retraces,
+                              opts.max_bubble))
+
+    failed = [g for g in gates if g["status"] == "fail"]
+    warned = [g for g in gates if g["status"] == "warn"]
+    ok = not failed and not (opts.strict and warned)
+
+    trends = {
+        "bench_dir": os.path.abspath(opts.bench_dir),
+        "run": (os.path.abspath(opts.run) if opts.run else None),
+        "series": {"evals_per_s": series},
+        "thresholds": {
+            "tol": opts.tol,
+            "min_dispatch_reduction": opts.min_dispatch_red,
+            "min_bubble_reduction": opts.min_bubble_red,
+            "max_host_fraction": opts.max_host_fraction,
+            "max_retraces": opts.max_retraces,
+            "max_bubble": opts.max_bubble,
+            "stale_days": opts.stale_days,
+            "strict": bool(opts.strict),
+        },
+        "gates": gates,
+        "pass": ok,
+    }
+    out_path = opts.out or os.path.join(opts.bench_dir, "TRENDS.json")
+    _atomic_write_json(out_path, trends)
+
+    if not opts.quiet:
+        for g in gates:
+            print(f"[{g['status'].upper():4s}] {g['name']}: "
+                  f"{g['detail']}")
+        print(f"sentinel: {'PASS' if ok else 'FAIL'} "
+              f"({len(failed)} failed, {len(warned)} warning(s)) "
+              f"-> {out_path}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
