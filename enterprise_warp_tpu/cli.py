@@ -223,10 +223,29 @@ def _run_samplers(params, opts, resume, likes, first_id, config_hash):
                       "run per-model for evidences (reference Bilby "
                       "branch behavior)")
             kw = params.sampler_kwargs
+            # blocked-path knobs (samplers/nested.py): 0 = auto for
+            # kbatch/nsteps; block_iters 0 is the seed per-iteration
+            # hatch, -1 (the paramfile default) keeps the blocked
+            # default; kernel selects the constrained-exploration move
+            nkw = {}
+            if int(kw.get("kbatch", 0) or 0) > 0:
+                nkw["kbatch"] = int(kw["kbatch"])
+            if int(kw.get("nsteps", 0) or 0) > 0:
+                nkw["nsteps"] = int(kw["nsteps"])
+            if int(kw.get("block_iters", -1)) >= 0:
+                nkw["block_iters"] = int(kw["block_iters"])
+            if kw.get("kernel") and kw["kernel"] != "slice":
+                # forward only a NON-default choice: "slice" is the
+                # paramfile default for every nested sampler, and
+                # forwarding it unconditionally would make the
+                # EWT_NESTED_BLOCK=0 hatch log a spurious
+                # "kernel ignored" warning on untouched paramfiles
+                nkw["kernel"] = str(kw["kernel"])
             run_nested(like, outdir=params.output_dir,
                        label=params.label,
                        nlive=int(kw.get("nlive", 500)),
-                       dlogz=float(kw.get("dlogz", 0.1)), resume=resume)
+                       dlogz=float(kw.get("dlogz", 0.1)),
+                       resume=resume, **nkw)
 
 
 if __name__ == "__main__":

@@ -43,11 +43,22 @@ IMPLEMENTED_SAMPLERS = {
                           covUpdate=1000, burn=10000, thin=10,
                           advi_init=False, advi_steps=800,
                           anneal_init=False),
-    "dynesty": dict(nlive=500, dlogz=0.1),
-    "nestle": dict(nlive=500, dlogz=0.1),
-    "pymultinest": dict(nlive=500, dlogz=0.1),
-    "pypolychord": dict(nlive=500, dlogz=0.1),
-    "ultranest": dict(nlive=500, dlogz=0.1),
+    # nested samplers share the native blocked device-resident
+    # implementation (samplers/nested.py). 0 = auto: kbatch ->
+    # nlive//5, nsteps -> kernel-matched eval budget. block_iters:
+    # -1 = default block length (EWT_NESTED_BLOCK / 16), 0 = the seed
+    # per-iteration hatch path. kernel: "slice" (whitened slice,
+    # default) or "walk" (seed Gaussian+DE).
+    "dynesty": dict(nlive=500, dlogz=0.1, kbatch=0, nsteps=0,
+                    block_iters=-1, kernel="slice"),
+    "nestle": dict(nlive=500, dlogz=0.1, kbatch=0, nsteps=0,
+                   block_iters=-1, kernel="slice"),
+    "pymultinest": dict(nlive=500, dlogz=0.1, kbatch=0, nsteps=0,
+                        block_iters=-1, kernel="slice"),
+    "pypolychord": dict(nlive=500, dlogz=0.1, kbatch=0, nsteps=0,
+                        block_iters=-1, kernel="slice"),
+    "ultranest": dict(nlive=500, dlogz=0.1, kbatch=0, nsteps=0,
+                      block_iters=-1, kernel="slice"),
     "emcee": dict(nwalkers=64, nsteps=10000),
     "ptemcee": dict(nwalkers=64, nsteps=10000, ntemps=4),
     # native gradient-based sampler (no reference counterpart: the

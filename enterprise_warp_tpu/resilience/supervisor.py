@@ -209,7 +209,10 @@ class BlockSupervisor:
     """Supervised execution of one sampler's device-block calls.
 
     One instance per sampler, named by its injection ``site``
-    (``pt.dispatch``, ``hmc.dispatch``, ``nested.iteration``).
+    (``pt.dispatch``, ``hmc.dispatch``, ``nested.iteration`` — the
+    latter BLOCK-granular since the blocked nested path: one
+    supervised call per ``block_iters``-iteration dispatch, with the
+    commit-side sync under the ``nested.commit`` site).
     ``on_checkpoint`` — a callable the circuit breaker invokes before
     demoting, so the last committed state is durable (the PT sampler
     binds its host-pipeline flush here).

@@ -22,6 +22,10 @@ discipline:
   throttled diagnostics) is deferred and executed AFTER the next block
   has been dispatched, so the device computes block ``k+1`` while the
   host folds block ``k``. Strictly ordered, explicitly flushed.
+  Adopted by PTMCMC (``_dispatch_block``/``_commit_block``) and by
+  the blocked nested sampler (``samplers/nested.py``: ledger
+  harvest, checkpoint serialization, and heartbeats run behind the
+  next ``block_iters``-iteration scan dispatch).
 - :func:`chain_sharding` — ``NamedSharding`` specs for walker-axis
   arrays over a mesh's chain axis, composing with the existing
   TOA/pulsar-axis consts sharding (``models/build.py``,

@@ -4,8 +4,32 @@ Native replacement for the nested samplers the reference reaches through
 Bilby (dynesty/nestle/PolyChord..., ``docs/index.rst:43``), following the
 batched GPU/TPU nested-sampling pattern (cf. PAPERS.md, arXiv:2509.04336):
 instead of one live-point replacement per iteration, the K worst points are
-deleted together and refilled by constrained random-walk steps seeded from
+deleted together and refilled by constrained exploration seeded from
 random survivors — every likelihood call is a ``vmap`` batch on device.
+
+Blocked device residency (default path)
+---------------------------------------
+The hot loop is *blocked*: ``block_iters`` NS iterations fold into ONE
+``lax.scan`` dispatch. Evidence accumulation ``(lnz, ln_x)``, walk-scale
+adaptation, the per-iteration ``dlogz`` termination statistic, and the
+insertion-rank diagnostic all live inside the scan; dead points land in a
+preallocated on-device ``(block_iters, kbatch)`` ring (the scan's stacked
+outputs) instead of per-iteration host appends. The live-point state
+``(u, lnl, key, scale, lnz, ln_x)`` is donated between blocks
+(``samplers/devicestate.py``), and the per-block host work — ledger
+harvest, checkpoint serialization, heartbeats — runs double-buffered
+behind the next dispatched block (``HostPipeline``), mirroring the PTMCMC
+``_dispatch_block``/``_commit_block`` split. Termination is a
+block-boundary check on the returned per-iteration delta trace; blocks
+align to an absolute iteration grid so kill-and-resume reproduces the
+uninterrupted run bit-for-bit (see docs/performance.md, "nested device
+residency").
+
+The default constrained kernel is a vectorized **whitened slice sampler**
+(hit-and-run with shrinkage in the live-point covariance frame, the
+blackjax-ns kernel; docs/kernels.md) with the budget-slide move kept as a
+mixture component. ``EWT_NESTED_BLOCK=0`` (or ``block_iters=0``) restores
+the seed per-iteration Gaussian+DE path bit-for-bit.
 
 Evidence bookkeeping treats a batch deletion as K sequential deletions
 (live counts N, N-1, ..., N-K+1), the standard estimator. Termination on
@@ -33,9 +57,18 @@ from ..resilience.supervisor import (BlockSupervisor, PlatformDemotion,
 from ..utils import profiling, telemetry
 from ..utils.flightrec import flight_recorder
 from ..utils.logging import EvalRateMeter, get_logger
-from ..utils.profiling import span
+from ..utils.profiling import monotonic, span
 
 _log = get_logger("ewt.nested")
+
+#: default number of NS iterations folded into one device dispatch —
+#: the amortization factor for host syncs (>= 10x is the committed
+#: floor gated by BENCH_NESTED.json + tools/sentinel.py)
+DEFAULT_BLOCK_ITERS = 16
+
+#: eval rounds per slice UPDATE (the shrink budget): rounds group into
+#: complete, reversible slice transitions — see ``slice_kernel``
+_SLICE_SHRINK_BUDGET = 4
 
 
 def slide_effective(like, slide_moves=None):
@@ -52,12 +85,45 @@ def slide_effective(like, slide_moves=None):
     return bool(slide_moves) and avail
 
 
+def _resolve_block_iters(block_iters):
+    """The blocked/per-iteration decision: explicit ``block_iters``
+    wins (0 = the seed per-iteration path); otherwise
+    ``EWT_NESTED_BLOCK`` sets it (0 = hatch to the seed path, N = block
+    length), defaulting to :data:`DEFAULT_BLOCK_ITERS`."""
+    if block_iters is not None:
+        return int(block_iters)
+    env = os.environ.get("EWT_NESTED_BLOCK")
+    if env is not None and env.strip() != "":
+        return int(env)
+    return DEFAULT_BLOCK_ITERS
+
+
 # ewt: allow-host-sync — one-time refill-protocol setup: coerces the
 # static bounds to host arrays before the loop compiles
-def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
-    """One jitted NS iteration: delete the K worst, refill by constrained
-    random walks from random survivors. Likelihood device arrays flow in
-    as the ``consts`` argument (samplers/evalproto.py)."""
+def _make_iteration(like, nlive, kbatch, nsteps, slide_moves=None,
+                    kernel="walk", extras=False):
+    """Build one pure NS iteration: delete the K worst, refill by
+    constrained exploration from random survivors. Likelihood device
+    arrays flow in as the ``consts`` argument (samplers/evalproto.py).
+
+    ``kernel`` selects the constrained exploration move:
+
+    - ``"walk"`` — the seed Gaussian+DE random walk (kept verbatim:
+      the ``EWT_NESTED_BLOCK=0`` hatch must reproduce the seed path
+      bit-for-bit);
+    - ``"slice"`` — the vectorized whitened slice sampler
+      (docs/kernels.md), the blocked path's default.
+
+    ``extras=False`` returns the seed signature
+    ``(u, lnl, key, dead_u, dead_lnl, acc, lnz, ln_x, delta)``;
+    ``extras=True`` (the blocked scan body) additionally adapts the
+    walk scale on device and returns
+    ``(u, lnl, key, scale, lnz, ln_x, dead_u, dead_lnl, acc, delta,
+    ranks, lnx0)`` where ``ranks`` is the insertion-rank diagnostic
+    (each replacement's rank among the surviving live points — uniform
+    when the constrained kernel truly samples the prior above L*) and
+    ``lnx0`` the iteration-entry ln X for the host-side ledger fold.
+    """
     from .evalproto import eval_protocol
     batch_eval, _, _ = eval_protocol(like)
 
@@ -114,28 +180,13 @@ def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
     _lnx_offsets = jnp.concatenate(
         [jnp.zeros(1), jnp.cumsum(_dlnx_per)[:-1]])
     _dlnx_batch = jnp.sum(_dlnx_per)
+    nd = like.ndim
 
-    def iteration(u, lnl, key, scale, lnz, ln_x, consts):
-        order = jnp.argsort(lnl)
-        u = u[order]
-        lnl = lnl[order]
-        lstar = lnl[kbatch - 1]          # hard floor for replacements
-        dead_u = u[:kbatch]
-        dead_lnl = lnl[:kbatch]
-        # evidence bookkeeping on device: folding this into the jit
-        # removes ~50 ms/iteration of host numpy + transfers from the
-        # sequential critical path
-        batch_lw = dead_lnl + (ln_x - _lnx_offsets) \
-            + jnp.log(_dlnx_per)
-        lnz = jax.scipy.special.logsumexp(
-            jnp.concatenate([jnp.array([lnz]), batch_lw]))
-        ln_x = ln_x - _dlnx_batch
-
-        key, kseed = jax.random.split(key)
-        seed_idx = jax.random.randint(kseed, (kbatch,), kbatch, nlive)
-        walk_u = u[seed_idx]
-        walk_lnl = lnl[seed_idx]
-
+    def walk_kernel(u, lnl, walk_u, walk_lnl, key, scale, lstar,
+                    consts):
+        """The seed constrained random walk: scaled-Gaussian +
+        DE-difference mixture with cube reflection (kept verbatim —
+        the hatch path's bit-equality contract)."""
         # per-dimension proposal scale from the live-point spread
         sig = jnp.std(u, axis=0) + 1e-7
 
@@ -198,6 +249,169 @@ def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
 
         (walk_u, walk_lnl, key, nacc), _ = jax.lax.scan(
             step, (walk_u, walk_lnl, key, 0.0), None, length=nsteps)
+        return walk_u, walk_lnl, key, nacc / nsteps, nacc / nsteps
+
+    def slice_kernel(u, lnl, walk_u, walk_lnl, key, scale, lstar,
+                     consts):
+        """Vectorized whitened slice sampler (docs/kernels.md).
+
+        Hit-and-run with Neal shrinkage in the live-point covariance
+        frame: each walker carries a slice anchor ``x0`` (always
+        inside the constraint); a slice *update* draws a direction
+        ``L z`` (L = the live set's Cholesky factor, z isotropic —
+        the whitening that makes one step length fit every posterior
+        orientation) scaled by the adaptive ``scale``, positions a
+        unit bracket ``[t_lo, t_hi]`` randomly around t=0, and then
+        shrink-samples: t ~ U(t_lo, t_hi); inside the constraint ->
+        the update's output; outside -> shrink the bracket toward 0.
+
+        Rounds are grouped into COMPLETE updates of
+        ``_SLICE_SHRINK_BUDGET`` eval rounds: a walker that accepts
+        freezes until every lane's update window closes, and a walker
+        that exhausts the budget stays at its anchor ("at most S
+        shrinkage draws, else stay" is exactly reversible — the
+        forward and reverse rejection sequences have identical length
+        and densities). The grouping matters for *correctness*, not
+        just efficiency: sampling a free-running shrink machine at
+        fixed eval-round boundaries over-weights anchors whose slices
+        shrink slowly (an inspection-paradox bias toward the
+        constraint boundary, measured at ~+0.04 on the mean rank
+        before this structure). Every round still costs exactly ONE
+        batched likelihood call for all ``kbatch`` walkers, so
+        ``it*kbatch*nsteps`` remains the exact eval count; frozen
+        lanes ride the batch as masked no-ops.
+
+        The budget-slide move rides along as a mixture component at
+        the seed path's 25% weight: a picked walker spends its update
+        window on one slide MH proposal instead of a slice update
+        (the mixture choice is state-independent, as pi-invariance
+        requires)."""
+        K = walk_u.shape[0]
+        # whitening frame from the full pre-refill live set (fixed
+        # within the iteration -> a valid kernel parameter)
+        mu = jnp.mean(u, axis=0)
+        dc = u - mu
+        C = (dc.T @ dc) / (nlive - 1)
+        C = C + (1e-12 + 1e-6 * jnp.mean(jnp.diag(C))) * jnp.eye(nd)
+        L = jnp.linalg.cholesky(C)
+
+        def new_slice(k):
+            k1, k2 = jax.random.split(k)
+            z = jax.random.normal(k1, (K, nd))
+            dirn = (z @ L.T) * scale
+            r = jax.random.uniform(k2, (K,))
+            return dirn, -r, 1.0 - r
+
+        frozen0 = jnp.zeros(K, dtype=bool)
+
+        def step(carry, i):
+            x0, lnl0, dirn, t_lo, t_hi, frozen, key, \
+                acc_evt, first_evt, upd_cnt = carry
+            is_reset = (i % _SLICE_SHRINK_BUDGET) == 0
+            key, kt, kn, ka = jax.random.split(key, 4)
+            # update boundary: fresh direction + bracket for every
+            # lane, everyone unfrozen, slide lottery drawn
+            dirn_n, tlo_n, thi_n = new_slice(kn)
+            dirn = jnp.where(is_reset, dirn_n, dirn)
+            t_lo = jnp.where(is_reset, tlo_n, t_lo)
+            t_hi = jnp.where(is_reset, thi_n, t_hi)
+            frozen = jnp.where(is_reset, False, frozen)
+            pick = jnp.zeros(K, dtype=bool)
+            if use_slide:
+                key, kc, kb, kf = jax.random.split(key, 4)
+                pick = is_reset & (
+                    jax.random.uniform(kc, (K,)) < 0.25)
+                s_prop, s_qc, s_in = jax.vmap(slide_one)(
+                    x0, jax.random.split(kb, K),
+                    jax.random.split(kf, K))
+            t = t_lo + (t_hi - t_lo) * jax.random.uniform(kt, (K,))
+            sl_prop = x0 + t[:, None] * dirn
+            incube = jnp.all((sl_prop > 0.0) & (sl_prop < 1.0),
+                             axis=1)
+            prop = sl_prop
+            if use_slide:
+                prop = jnp.where(pick[:, None], s_prop, prop)
+            # clip only what the likelihood SEES: an out-of-cube draw
+            # is already a guaranteed rejection via ``incube``, the
+            # clip just keeps from_unit away from wild corners
+            lnl_p = batch_eval(
+                like.from_unit(jnp.clip(prop, 1e-12, 1.0 - 1e-12)),
+                consts)
+            ok = incube & (lnl_p > lstar)
+            if use_slide:
+                ok_slide = s_in & (lnl_p > lstar) & (
+                    jnp.log(jax.random.uniform(ka, (K,))) < s_qc)
+                ok = jnp.where(pick, ok_slide, ok)
+            active = ~frozen
+            ok = ok & active
+            x0 = jnp.where(ok[:, None], prop, x0)
+            lnl0 = jnp.where(ok, lnl_p, lnl0)
+            # a slide lane spends its whole window on the one MH
+            # round; a slice lane freezes on acceptance
+            frozen = frozen | pick | ok
+            shrink = active & ~pick & ~ok
+            t_lo = jnp.where(shrink & (t < 0.0), t, t_lo)
+            t_hi = jnp.where(shrink & (t >= 0.0), t, t_hi)
+            # bracket-scale feedback from the slice updates only
+            # (slide acceptance is scale-independent, as in the walk):
+            # completed-update rate + first-draw rate drive the
+            # shrink/grow rule in ``iteration``
+            is_sl = ~pick
+            acc_evt = acc_evt + jnp.sum(ok & is_sl)
+            first_evt = first_evt + jnp.where(
+                is_reset, jnp.sum(ok & is_sl), 0)
+            upd_cnt = upd_cnt + jnp.where(
+                is_reset, jnp.sum(active & is_sl), 0)
+            return (x0, lnl0, dirn, t_lo, t_hi, frozen, key,
+                    acc_evt, first_evt, upd_cnt), None
+
+        key, k0 = jax.random.split(key)
+        dirn0, tlo0, thi0 = new_slice(k0)
+        (walk_u, walk_lnl, _, _, _, _, key,
+         acc_evt, first_evt, upd_cnt), _ = jax.lax.scan(
+            step, (walk_u, walk_lnl, dirn0, tlo0, thi0, frozen0, key,
+                   0.0, 0.0, 0.0), jnp.arange(nsteps))
+        denom = jnp.maximum(upd_cnt, 1.0)
+        return walk_u, walk_lnl, key, acc_evt / denom, \
+            first_evt / denom
+
+    kern = walk_kernel if kernel == "walk" else slice_kernel
+
+    def iteration(u, lnl, key, scale, lnz, ln_x, consts):
+        order = jnp.argsort(lnl)
+        u = u[order]
+        lnl = lnl[order]
+        lstar = lnl[kbatch - 1]          # hard floor for replacements
+        dead_u = u[:kbatch]
+        dead_lnl = lnl[:kbatch]
+        lnx0 = ln_x
+        # evidence bookkeeping on device: folding this into the jit
+        # removes ~50 ms/iteration of host numpy + transfers from the
+        # sequential critical path
+        batch_lw = dead_lnl + (ln_x - _lnx_offsets) \
+            + jnp.log(_dlnx_per)
+        lnz = jax.scipy.special.logsumexp(
+            jnp.concatenate([jnp.array([lnz]), batch_lw]))
+        ln_x = ln_x - _dlnx_batch
+
+        key, kseed = jax.random.split(key)
+        seed_idx = jax.random.randint(kseed, (kbatch,), kbatch, nlive)
+        walk_u = u[seed_idx]
+        walk_lnl = lnl[seed_idx]
+
+        walk_u, walk_lnl, key, acc, first = kern(
+            u, lnl, walk_u, walk_lnl, key, scale, lstar, consts)
+
+        if extras:
+            # insertion-rank diagnostic (Fowlie, Handley & Su 2020,
+            # batched form): each replacement's rank among the
+            # nlive - kbatch SURVIVORS — iid draws from the prior
+            # above lstar, exactly the population a correct
+            # replacement joins — must be uniform on
+            # {0..nlive-kbatch}. Ranks are emitted per iteration and
+            # KS-folded per block at commit.
+            ranks = jnp.sum(
+                lnl[kbatch:][None, :] < walk_lnl[:, None], axis=1)
 
         u = u.at[:kbatch].set(walk_u)
         lnl = lnl.at[:kbatch].set(walk_lnl)
@@ -207,9 +421,41 @@ def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
         lnz_live = jax.scipy.special.logsumexp(lnl) \
             - jnp.log(nlive) + ln_x
         delta = jnp.logaddexp(lnz, lnz_live) - lnz
-        return (u, lnl, key, dead_u, dead_lnl, nacc / nsteps,
-                lnz, ln_x, delta)
+        if not extras:
+            return (u, lnl, key, dead_u, dead_lnl, acc,
+                    lnz, ln_x, delta)
+        if kernel == "walk":
+            # walk-scale adaptation on device (the host rule verbatim:
+            # same thresholds, same multipliers, same clip — f64 IEEE
+            # ops, so the blocked walk path stays bit-equal to the
+            # hatch path)
+            scale = jnp.where(acc < 0.15, scale * 0.7,
+                              jnp.where(acc > 0.6, scale * 1.3,
+                                        scale))
+            scale = jnp.clip(scale, 1e-3, 2.0)
+        else:
+            # slice-bracket adaptation: shrink when updates exhaust
+            # their shrink budget too often (bracket far larger than
+            # the slice), grow when the FIRST draw usually lands
+            # inside (bracket smaller than the slice — longer moves
+            # are free decorrelation). ``acc`` = completed-update
+            # rate, ``first`` = first-draw acceptance rate.
+            scale = jnp.where(acc < 0.75, scale * 0.7,
+                              jnp.where(first > 0.5, scale * 1.3,
+                                        scale))
+            scale = jnp.clip(scale, 1e-3, 10.0)
+        return (u, lnl, key, scale, lnz, ln_x,
+                dead_u, dead_lnl, acc, delta, ranks, lnx0)
 
+    return iteration
+
+
+def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
+    """The seed per-iteration jit (the ``EWT_NESTED_BLOCK=0`` hatch):
+    one traced NS iteration, scale adaptation left on the host."""
+    iteration = _make_iteration(like, nlive, kbatch, nsteps,
+                                slide_moves=slide_moves, kernel="walk",
+                                extras=False)
     # traced jit: one trace per (nlive, kbatch, nsteps) geometry — a
     # retrace mid-run means the configuration changed under the sampler.
     # The live-point state (u, lnl, key — args 0-2) is donated: it
@@ -222,23 +468,66 @@ def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
                             donate_argnums=donate)
 
 
+def _make_block(like, nlive, kbatch, nsteps, block_iters,
+                slide_moves=None, kernel="slice", device_state=True):
+    """The blocked dispatch: ``block_iters`` NS iterations folded into
+    one ``lax.scan`` jit. The whole live-point state — walkers, lnl,
+    RNG key, walk scale, evidence accumulator ``(lnz, ln_x)`` — is the
+    scan carry and is DONATED between blocks (args 0-5, XLA in-place
+    update; ``devicestate.place_resident`` guarantees XLA-owned
+    buffers); the stacked per-iteration outputs are the preallocated
+    on-device ``(block_iters, kbatch)`` dead-point ring plus the
+    accept/delta/rank/lnx traces the commit folds on the host."""
+    it_fn = _make_iteration(like, nlive, kbatch, nsteps,
+                            slide_moves=slide_moves, kernel=kernel,
+                            extras=True)
+
+    def block(u, lnl, key, scale, lnz, ln_x, consts):
+        def body(carry, _):
+            u, lnl, key, scale, lnz, ln_x = carry
+            (u, lnl, key, scale, lnz, ln_x,
+             du, dl, acc, delta, ranks, lnx0) = it_fn(
+                u, lnl, key, scale, lnz, ln_x, consts)
+            return ((u, lnl, key, scale, lnz, ln_x),
+                    (du, dl, acc, delta, ranks, lnx0))
+        # named for jax.profiler captures (EWT_PROFILE_CAPTURE): the
+        # whole block shows up as one legible region
+        with jax.named_scope("nested_block"):
+            carry, ys = jax.lax.scan(
+                body, (u, lnl, key, scale, lnz, ln_x), None,
+                length=block_iters)
+        return carry + ys
+
+    donate = (0, 1, 2, 3, 4, 5) if device_state else ()
+    return telemetry.traced(block, name="nested_block",
+                            donate_argnums=donate)
+
+
 def run_nested(like, outdir=None, **kw):
     """Nested sampling over a compiled likelihood object.
 
     Returns a dict with ``log_evidence``, ``log_evidence_err``,
     ``posterior`` (equal-weight samples), ``samples``/``log_weights`` (raw
-    dead points), and writes ``<label>_result.json`` into ``outdir``.
+    dead points), ``insertion_rank`` (the per-run KS fold of the
+    insertion-index diagnostic, blocked path), ``dispatch_stats``
+    (dispatches + host syncs per iteration — the amortization the
+    blocked path exists for), and writes ``<label>_result.json`` into
+    ``outdir``.
 
-    Checkpoint/resume: every ``checkpoint_every`` iterations the full
-    sampler state (live points, dead arrays, evidence accumulator, RNG
-    key, walk scale) is written to ``<label>_nested_ckpt.npz``; with
-    ``resume=True`` (default, matching the reference's Bilby behavior at
+    Checkpoint/resume: at block boundaries every ``checkpoint_every``
+    iterations the full sampler state (live points, dead arrays,
+    evidence accumulator, RNG key, walk scale) is written to
+    ``<label>_nested_ckpt.npz``; with ``resume=True`` (default, matching
+    the reference's Bilby behavior at
     ``/root/reference/examples/bilby_example.py:44``) an existing
     checkpoint is loaded and the run continues with an identical random
     stream, so kill-and-resume reproduces the uninterrupted run
-    bit-for-bit. The checkpoint is removed when the run converges.
+    bit-for-bit (blocks re-align to the absolute iteration grid). The
+    checkpoint is removed when the run converges. A checkpoint from a
+    different geometry — including a changed ``block_iters`` or
+    ``kernel`` — is incompatible and starts fresh.
 
-    Supervised execution (resilience/supervisor.py): each iteration
+    Supervised execution (resilience/supervisor.py): each block
     dispatch runs under the watchdog/retry wrapper; a circuit-breaker
     :class:`PlatformDemotion` is re-entered here in-process for the
     megakernel -> classic rung (resuming from the checkpoint) and
@@ -255,16 +544,102 @@ def run_nested(like, outdir=None, **kw):
             kw["resume"] = True
 
 
-# ewt: allow-host-sync — the NS outer loop harvests each iteration's
-# dead points at the iteration boundary: that per-iteration commit IS
-# the nested-sampling design (evidence accumulation is host-side)
+def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1,
+                     nsteps=None, kbatch=None, seed=0, max_iter=100000,
+                     verbose=True, label="result", resume=True,
+                     checkpoint_every=50, slide_moves=None,
+                     block_iters=None, kernel=None):
+    block_iters = _resolve_block_iters(block_iters)
+    if block_iters <= 0:
+        if kernel not in (None, "walk"):
+            _log.warning("kernel=%r ignored: the per-iteration hatch "
+                         "path always runs the seed walk kernel",
+                         kernel)
+        return _run_nested_periter(
+            like, outdir=outdir, nlive=nlive, dlogz=dlogz,
+            nsteps=25 if nsteps is None else nsteps, kbatch=kbatch,
+            seed=seed, max_iter=max_iter, verbose=verbose, label=label,
+            resume=resume, checkpoint_every=checkpoint_every,
+            slide_moves=slide_moves)
+    kernel = kernel or "slice"
+    if nsteps is None:
+        # kernel-matched eval budget per iteration: the walk keeps the
+        # seed default; the slice kernel needs ~1.5*ndim COMPLETE
+        # hit-and-run updates to decorrelate a replacement from its
+        # seed survivor (each update resamples one random whitened
+        # direction; measured on a 16-dim analytic target: 6 updates
+        # bias lnZ by +1.3 nats, ~1.5*ndim updates are unbiased), at
+        # _SLICE_SHRINK_BUDGET eval rounds per update
+        nsteps = 25 if kernel == "walk" else \
+            _SLICE_SHRINK_BUDGET * max(8, int(np.ceil(1.5 * like.ndim)))
+    return _run_nested_blocked(
+        like, outdir=outdir, nlive=nlive, dlogz=dlogz, nsteps=nsteps,
+        kbatch=kbatch, seed=seed, max_iter=max_iter, verbose=verbose,
+        label=label, resume=resume, checkpoint_every=checkpoint_every,
+        slide_moves=slide_moves, block_iters=block_iters,
+        kernel=kernel)
+
+
+def _ckpt_load_compatible(ckpt_path, want):
+    """Load a checkpoint archive iff its identity matches ``want``.
+
+    A stale checkpoint from a different configuration must not be
+    silently resumed against the new run — live points / shrinkage
+    schedule / random stream would all be wrong and lnZ silently
+    corrupted. Identity = sampler geometry (+ block geometry on the
+    blocked path) + model fingerprint. Returns the materialized field
+    dict or None; the archive handle is closed either way (the seed
+    code leaked it open and re-opened a second handle)."""
+    with np.load(ckpt_path, allow_pickle=False) as z:
+        for k, v in want.items():
+            if k not in z.files or str(z[k]) != str(v):
+                _log.warning(
+                    "NS checkpoint incompatible (%s: %s != %s); "
+                    "starting fresh", k,
+                    z[k] if k in z.files else "missing", v)
+                return None
+        return {k: z[k] for k in z.files}
+
+
+# ewt: allow-host-sync — fresh-ensemble draw: the redraw guard must
+# see concrete lnl values to re-draw non-finite starters before any
+# block/iteration is dispatched
+# ewt: allow-precision — the live-point cube is f64 BY CONTRACT: the
+# shrinkage arithmetic loses the evidence tail in f32
+# (docs/kernels.md f64-island list)
+def _fresh_live(like, nlive, seed):
+    """Draw the initial live set (identical RNG stream on both the
+    blocked and the per-iteration path), re-drawing non-finite
+    starters."""
+    nd = like.ndim
+    rng_key = jax.random.PRNGKey(seed)
+    rng_key, k0 = jax.random.split(rng_key)
+    u = jax.random.uniform(k0, (nlive, nd), dtype=jnp.float64)
+    lnl = like.loglike_batch(like.from_unit(u))
+    for _ in range(20):
+        bad = ~jnp.isfinite(lnl)
+        if not bool(jnp.any(bad)):
+            break
+        rng_key, kr = jax.random.split(rng_key)
+        u2 = jax.random.uniform(kr, (nlive, nd), dtype=jnp.float64)
+        u = jnp.where(bad[:, None], u2, u)
+        lnl = like.loglike_batch(like.from_unit(u))
+    return u, lnl, rng_key
+
+
+# ewt: allow-host-sync — the seed per-iteration hatch path
+# (EWT_NESTED_BLOCK=0): it exists precisely to reproduce the
+# per-iteration host harvest bit-for-bit, so its one sync per NS
+# iteration is the contract, not a leak (the default blocked path
+# amortizes this to one sync per block_iters iterations)
 # ewt: allow-precision — live points / lnZ ledger stay f64: the
 # shrinkage arithmetic (ln X after ~n*H iterations) loses the
 # evidence tail in f32 (docs/kernels.md f64-island list)
-def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
-                     kbatch=None, seed=0, max_iter=100000, verbose=True,
-                     label="result", resume=True, checkpoint_every=50,
-                     slide_moves=None):
+def _run_nested_periter(like, outdir=None, nlive=500, dlogz=0.1,
+                        nsteps=25, kbatch=None, seed=0,
+                        max_iter=100000, verbose=True, label="result",
+                        resume=True, checkpoint_every=50,
+                        slide_moves=None):
     nd = like.ndim
     kbatch = kbatch or max(1, nlive // 5)
 
@@ -288,32 +663,35 @@ def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
     # N, N-1, ..., N-K+1: per-deletion shrinkage 1/count, per-deletion
     # lnX offset the running cumulative sum
     # host copies of the shrinkage tables (the device twins live in
-    # _make_refill): only the per-dead-point lnX records for the final
-    # weight fold use these — the running (lnz, ln_x) accumulators are
-    # device-side
+    # _make_iteration): only the per-dead-point lnX records for the
+    # final weight fold use these — the running (lnz, ln_x)
+    # accumulators are device-side
     counts = nlive - np.arange(kbatch)
     dlnx_per = 1.0 / counts
     lnx_offsets = np.concatenate([[0.0], np.cumsum(dlnx_per)[:-1]])
 
-    def _ckpt_compatible(z):
-        """A stale checkpoint from a different configuration must not be
-        silently resumed against the new run — live points / shrinkage
-        schedule / random stream would all be wrong and lnZ silently
-        corrupted. Identity = sampler geometry + model fingerprint."""
-        want = dict(nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
-                    params_fp=_params_fingerprint(like))
-        for k, v in want.items():
-            if k not in z.files or str(z[k]) != str(v):
-                _log.warning(
-                    "NS checkpoint incompatible (%s: %s != %s); "
-                    "starting fresh", k,
-                    z[k] if k in z.files else "missing", v)
-                return False
-        return True
-
-    if resume and ckpt_path is not None and os.path.exists(ckpt_path) \
-            and _ckpt_compatible(np.load(ckpt_path, allow_pickle=False)):
-        z = np.load(ckpt_path)
+    # nsteps joins the identity (it was unfingerprinted in the seed
+    # code): the walk consumes nsteps RNG rounds per iteration, so a
+    # checkpoint taken under a different eval budget must start
+    # fresh — resuming it would mix two different random streams into
+    # one ledger and silently corrupt lnZ
+    want = dict(nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
+                nsteps=nsteps, params_fp=_params_fingerprint(like))
+    z = None
+    if resume and ckpt_path is not None and os.path.exists(ckpt_path):
+        z = _ckpt_load_compatible(ckpt_path, want)
+    if z is not None and "block_iters" in z \
+            and int(z["block_iters"]) != 0:
+        # geometry incompatibility is TWO-way: a blocked-path
+        # checkpoint (different kernel, different scale clip,
+        # block-aligned grid) must not silently resume on the
+        # per-iteration hatch path just because the seed-era identity
+        # fields happen to match
+        _log.warning("NS checkpoint is from the blocked path "
+                     "(block_iters=%d); starting fresh on the "
+                     "per-iteration path", int(z["block_iters"]))
+        z = None
+    if z is not None:
         u = jnp.asarray(z["u"])
         lnl = jnp.asarray(z["lnl"])
         rng_key = jnp.asarray(z["rng_key"])
@@ -328,19 +706,7 @@ def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
         if verbose:
             _log.info("NS resuming from iteration %d", it)
     else:
-        rng_key = jax.random.PRNGKey(seed)
-        rng_key, k0 = jax.random.split(rng_key)
-        u = jax.random.uniform(k0, (nlive, nd), dtype=jnp.float64)
-        lnl = like.loglike_batch(like.from_unit(u))
-        # re-draw non-finite starts
-        for _ in range(20):
-            bad = ~jnp.isfinite(lnl)
-            if not bool(jnp.any(bad)):
-                break
-            rng_key, kr = jax.random.split(rng_key)
-            u2 = jax.random.uniform(kr, (nlive, nd), dtype=jnp.float64)
-            u = jnp.where(bad[:, None], u2, u)
-            lnl = like.loglike_batch(like.from_unit(u))
+        u, lnl, rng_key = _fresh_live(like, nlive, seed)
         dead_u, dead_lnl, dead_lnx, dead_dlnx = [], [], [], []
         ln_x = 0.0
         scale = 0.5
@@ -369,7 +735,7 @@ def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
             dead_dlnx=(np.concatenate(dead_dlnx) if dead_dlnx
                        else np.zeros(0)),
             nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
-            params_fp=_params_fingerprint(like))
+            nsteps=nsteps, params_fp=_params_fingerprint(like))
         durable_replace(tmp, ckpt_path)
         # kill-after-durable-checkpoint injection boundary (resilience)
         faults.fire("nested.ckpt", path=ckpt_path, iteration=int(it))
@@ -430,20 +796,8 @@ def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
             # ~isfinite, not isnan: live points are redrawn/walked to
             # finite lnl, so ANY non-finite dead point means a bad
             # evaluation leaked into the evidence accumulator
-            badm = ~np.isfinite(dead_lnl[-1])
-            nbad = int(np.sum(badm))
-            if nbad:
-                telemetry.registry().counter(
-                    "nonfinite_eval", where="nested").inc(nbad)
-                fr = flight_recorder()
-                fr.record("nonfinite_eval", where="nested",
-                          count=nbad, iteration=it)
-                fr.anomaly(
-                    "nonfinite_eval", run_dir=outdir,
-                    once_key=f"nonfinite_eval:{outdir}",
-                    iteration=it, n_bad=nbad,
-                    bad_u=dead_u[-1][badm][:8],
-                    bad_lnl=dead_lnl[-1][badm][:8])
+            _escalate_nonfinite_dead(dead_u[-1], dead_lnl[-1], outdir,
+                                     it)
             dead_lnx.append(ln_x - lnx_offsets)
             dead_dlnx.append(dlnx_per)
             lnz = float(lnz_d)
@@ -502,7 +856,470 @@ def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
     elif not converged:
         _write_ckpt()              # max_iter hit: keep state resumable
 
-    # fold the remaining live points in: each carries X_final / nlive
+    return _finalize(like, outdir, label, seed, nlive, kbatch, nsteps,
+                     it, converged, u, lnl, ln_x, dead_u, dead_lnl,
+                     dead_lnx, dead_dlnx,
+                     slide_eff=slide_effective(like, slide_moves),
+                     dispatch_stats=dict(
+                         dispatches=it, host_syncs=it, iterations=it,
+                         block_iters=0,
+                         dispatches_per_iteration=1.0,
+                         host_syncs_per_iteration=1.0),
+                     insertion_rank=None)
+
+
+# ewt: allow-host-sync,precision — THE block-commit boundary of the
+# blocked nested path: ONE designed sync per block pulls the finished
+# block's dead-point ring + state snapshot while the host folds it
+# behind the next dispatched block (devicestate pipeline); ledger
+# arithmetic stays f64 (lnZ spans ~1e3 nats)
+def _run_nested_blocked(like, outdir, nlive, dlogz, nsteps, kbatch,
+                        seed, max_iter, verbose, label, resume,
+                        checkpoint_every, slide_moves, block_iters,
+                        kernel):
+    """The blocked, device-resident nested hot loop (module
+    docstring): mirror of the PTMCMC ``_dispatch_block`` /
+    ``_commit_block`` split at NS-iteration granularity."""
+    nd = like.ndim
+    kbatch = kbatch or max(1, nlive // 5)
+    device_state = os.environ.get("EWT_DEVICE_STATE", "1") != "0"
+
+    from ..parallel.distributed import is_primary
+    from .devicestate import (HostPipeline, host_snapshot,
+                              place_resident, resolve_placement)
+    from .evalproto import eval_protocol
+    _consts = eval_protocol(like)[2]
+
+    ckpt_path = None
+    if outdir is not None:
+        if is_primary():
+            os.makedirs(outdir, exist_ok=True)
+        ckpt_path = os.path.join(outdir, f"{label}_nested_ckpt.npz")
+
+    counts = nlive - np.arange(kbatch)
+    dlnx_per = 1.0 / counts
+    lnx_offsets = np.concatenate([[0.0], np.cumsum(dlnx_per)[:-1]])
+
+    # block geometry joins the checkpoint identity: the dead-point
+    # ring layout, the block-aligned termination/checkpoint grid, the
+    # per-iteration RNG stream (nsteps eval rounds consume the key),
+    # and the kernel's move mixture are all functions of these — a
+    # checkpoint from any different geometry must start fresh, never
+    # resume (nsteps is now kernel-dependent and caller-exposed, so
+    # accidental mismatch is easy)
+    want = dict(nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
+                nsteps=nsteps, block_iters=block_iters, kernel=kernel,
+                slide=int(slide_effective(like, slide_moves)),
+                params_fp=_params_fingerprint(like))
+    z = None
+    if resume and ckpt_path is not None and os.path.exists(ckpt_path):
+        z = _ckpt_load_compatible(ckpt_path, want)
+    ks_blocks = []
+    ckpt_dispatch = ckpt_sync = 0
+    if z is not None:
+        u, lnl, rng_key = z["u"], z["lnl"], z["rng_key"]
+        scale = float(z["scale"])
+        ln_x = float(z["ln_x"])
+        lnz = float(z["lnz"])
+        it = int(z["it"])
+        dead_u = [z["dead_u"]] if len(z["dead_u"]) else []
+        dead_lnl = [z["dead_lnl"]] if len(z["dead_lnl"]) else []
+        dead_lnx = [z["dead_lnx"]] if len(z["dead_lnx"]) else []
+        dead_dlnx = [z["dead_dlnx"]] if len(z["dead_dlnx"]) else []
+        ranks_all = [z["ranks"]] if "ranks" in z and len(z["ranks"]) \
+            else []
+        # scheduling provenance stays cumulative across sessions so
+        # the written result is identical to an uninterrupted run's
+        # (the kill-and-resume bit-equality contract)
+        if "ks_blocks" in z:
+            ks_blocks = [float(v) for v in z["ks_blocks"]]
+        ckpt_dispatch = int(z["n_dispatch"]) if "n_dispatch" in z \
+            else 0
+        ckpt_sync = int(z["n_sync"]) if "n_sync" in z else 0
+        if verbose:
+            _log.info("NS resuming from iteration %d (blocked, "
+                      "block_iters=%d, kernel=%s)", it, block_iters,
+                      kernel)
+    else:
+        u, lnl, rng_key = _fresh_live(like, nlive, seed)
+        dead_u, dead_lnl, dead_lnx, dead_dlnx = [], [], [], []
+        ranks_all = []
+        ln_x = 0.0
+        scale = 0.5
+        it = 0
+        lnz = -np.inf
+
+    # committed-consistent placement for the DONATED state leaves
+    # (devicestate contract): jnp.array real copies for host arrays,
+    # pass-through for resident device outputs; replicated over the
+    # consts' mesh when the likelihood is TOA/psr-sharded
+    placement = resolve_placement(_consts)
+
+    def _place(v):
+        if not device_state:
+            return jnp.asarray(v)
+        return place_resident(v, placement)
+
+    u = _place(np.asarray(u))
+    lnl = _place(np.asarray(lnl))
+    rng_key = _place(np.asarray(rng_key))
+    scale_d = _place(np.float64(scale))
+    lnz_d = _place(np.float64(lnz))
+    lnx_d = _place(np.float64(ln_x))
+
+    # one compiled block per scan length: full blocks share one trace,
+    # the (rare) resume-/max_iter-alignment partials get their own
+    blocks = {}
+
+    def _block_fn(todo):
+        if todo not in blocks:
+            blocks[todo] = _make_block(
+                like, nlive, kbatch, nsteps, todo,
+                slide_moves=slide_moves, kernel=kernel,
+                device_state=device_state)
+        return blocks[todo]
+
+    def _write_ckpt_payload(state, n_led, it_now, nd_now=0, ns_now=0,
+                            n_ks=None):
+        """Serialize one block-boundary checkpoint (donation-safe host
+        snapshot arrays + the ledger up to ``n_led`` blocks), atomic +
+        durable."""
+        if ckpt_path is None or not is_primary():
+            return
+        if n_ks is None:
+            n_ks = len(ks_blocks)
+        tmp = ckpt_path[:-len(".npz")] + ".tmp.npz"
+        np.savez(
+            tmp, u=state["u"], lnl=state["lnl"],
+            rng_key=state["key"], scale=state["scale"],
+            ln_x=state["ln_x"], lnz=state["lnz"], it=it_now,
+            n_dispatch=nd_now, n_sync=ns_now,
+            ks_blocks=np.asarray(ks_blocks[:n_ks], dtype=np.float64),
+            dead_u=(np.concatenate(dead_u[:n_led]) if n_led
+                    else np.zeros((0, nd))),
+            dead_lnl=(np.concatenate(dead_lnl[:n_led]) if n_led
+                      else np.zeros(0)),
+            dead_lnx=(np.concatenate(dead_lnx[:n_led]) if n_led
+                      else np.zeros(0)),
+            dead_dlnx=(np.concatenate(dead_dlnx[:n_led]) if n_led
+                       else np.zeros(0)),
+            ranks=(np.concatenate(ranks_all[:n_led]) if n_led
+                   else np.zeros(0, dtype=np.int64)),
+            nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
+            nsteps=nsteps, block_iters=block_iters, kernel=kernel,
+            slide=int(slide_effective(like, slide_moves)),
+            params_fp=_params_fingerprint(like))
+        durable_replace(tmp, ckpt_path)
+        # kill-after-durable-checkpoint injection boundary (resilience)
+        faults.fire("nested.ckpt", path=ckpt_path, iteration=it_now)
+
+    # the double buffer (samplers/devicestate.py): block k's host work
+    # — ledger KS fold, checkpoint serialization, heartbeat — runs
+    # AFTER block k+1 is dispatched, so the device never idles on host
+    # IO. Degrades to synchronous execution with EWT_DEVICE_STATE=0.
+    pipe = HostPipeline(enabled=device_state)
+    # circuit-breaker checkpoint guarantee: a demotion must resume
+    # from the LAST COMMITTED block boundary, not from the last
+    # checkpoint_every-aligned one (which may not exist yet). The
+    # commit loop refreshes ``last_commit``; the breaker drains the
+    # deferred host work, then force-writes that boundary.
+    last_commit = {}
+
+    def _breaker_checkpoint():
+        pipe.flush()
+        if last_commit:
+            _write_ckpt_payload(**last_commit)
+
+    supervisor = BlockSupervisor("nested.iteration",
+                                 on_checkpoint=_breaker_checkpoint)
+    g_sync = telemetry.registry().gauge("host_sync_wall_s")
+    g_bubble = telemetry.registry().gauge("block_bubble_s")
+    n_dispatch, n_sync = ckpt_dispatch, ckpt_sync
+    sync_total_s = bubble_total_s = 0.0
+    t_ready = None
+    last_ckpt_it = it
+    converged = False
+    nmax = nlive - kbatch           # insertion-rank support: {0..nmax}
+
+    with telemetry.run_scope(outdir, sampler="nested", label=label,
+                             nlive=int(nlive), kbatch=int(kbatch),
+                             nsteps=int(nsteps), ndim=int(nd),
+                             dlogz=float(dlogz),
+                             block_iters=int(block_iters),
+                             kernel=str(kernel),
+                             param_names=list(like.param_names)) as rec:
+        meter = EvalRateMeter(initial_total=it * kbatch * nsteps)
+        try:
+            while it < max_iter and not converged:
+                if preemption_requested():
+                    _log.warning("preemption requested: stopping at "
+                                 "iteration %d", it)
+                    break
+                # blocks align to the ABSOLUTE iteration grid: a
+                # resume from a mid-grid checkpoint first runs a
+                # partial block back onto the grid, so termination is
+                # checked at the same iterations as the uninterrupted
+                # run (kill-and-resume bit-equality)
+                todo = min(block_iters - (it % block_iters),
+                           max_iter - it)
+                blk = _block_fn(todo)
+                with span("ns.dispatch", it=it, iters=todo):
+                    out = supervisor.call(
+                        lambda: blk(u, lnl, rng_key, scale_d, lnz_d,
+                                    lnx_d, _consts),
+                        iteration_idx=int(it), block_iters=int(todo))
+                n_dispatch += 1
+                # block-boundary bubble: host wall between the
+                # previous block's results landing (device went idle)
+                # and this dispatch handing the device new work
+                now = monotonic()
+                last_bubble_s = 0.0
+                if t_ready is not None:
+                    last_bubble_s = now - t_ready
+                    bubble_total_s += last_bubble_s
+                    g_bubble.set(last_bubble_s)
+                # device is busy with this block: fold the previous
+                # block's deferred host work into the gap
+                pipe.run_pending()
+                # ---- commit: the ONE host sync per block ----------- #
+                t0 = monotonic()
+                leaves = dict(
+                    u=out[0], lnl=out[1], key=out[2], scale=out[3],
+                    lnz=out[4], ln_x=out[5], dead_u=out[6],
+                    dead_lnl=out[7], acc=out[8], delta=out[9],
+                    ranks=out[10], lnx0=out[11])
+                with span("ns.commit", it=it, iters=todo):
+                    # the commit sync is where a dead relay manifests
+                    # (the dispatch above is async) — supervised, but
+                    # never retried: the donated inputs of a
+                    # half-finished block cannot be reconstructed
+                    snap = supervisor.call(
+                        lambda: host_snapshot(leaves),
+                        retryable=False, site="nested.commit",
+                        iteration=int(it))
+                n_sync += 1
+                t_ready = monotonic()
+                sync_s = t_ready - t0
+                sync_total_s += sync_s
+                g_sync.set(sync_s)
+                if device_state:
+                    u, lnl, rng_key, scale_d, lnz_d, lnx_d = out[:6]
+                else:
+                    u = _place(snap["u"])
+                    lnl = _place(snap["lnl"])
+                    rng_key = _place(snap["key"])
+                    scale_d = _place(snap["scale"])
+                    lnz_d = _place(snap["lnz"])
+                    lnx_d = _place(snap["ln_x"])
+
+                spec = faults.fire("nested.nonfinite",
+                                   iteration=int(it))
+                if spec is not None and spec.kind == "nonfinite":
+                    # poison one dead point in the committed ring:
+                    # exercises the counted escalation + anomaly dump
+                    # exactly as a genuinely bad evaluation would
+                    snap["dead_lnl"] = np.asarray(
+                        snap["dead_lnl"]).copy()
+                    snap["dead_lnl"][0, 0] = np.nan
+
+                # ---- ledger append (host views of the ring) -------- #
+                du = np.asarray(snap["dead_u"]).reshape(-1, nd)
+                dl = np.asarray(snap["dead_lnl"]).reshape(-1)
+                lnx0 = np.asarray(snap["lnx0"])
+                rk = np.asarray(snap["ranks"]).reshape(-1)
+                dead_u.append(du)
+                dead_lnl.append(dl)
+                dead_lnx.append(
+                    (lnx0[:, None] - lnx_offsets[None, :]).reshape(-1))
+                dead_dlnx.append(np.tile(dlnx_per, todo))
+                ranks_all.append(rk)
+                _escalate_nonfinite_dead(du, dl, outdir, it)
+
+                deltas = np.asarray(snap["delta"])
+                accs = np.asarray(snap["acc"])
+                lnz = float(snap["lnz"])
+                ln_x = float(snap["ln_x"])
+                scale = float(snap["scale"])
+                it += todo
+                meter.add(todo * kbatch * nsteps)
+                # termination: a block-boundary check on the returned
+                # per-iteration delta trace — the run would have
+                # stopped at the first crossing; the (at most
+                # block_iters-1) extra harvested iterations are valid
+                # NS iterations that only tighten the estimate
+                converged = bool(np.any(deltas < dlogz))
+                delta_last = float(deltas[-1])
+                acc_last = float(accs[-1])
+                profiling.capture_tick()
+                flight_recorder().note_state(
+                    sampler="nested", outdir=outdir, iteration=it,
+                    lnz=lnz, scale=scale,
+                    block_iters=int(block_iters))
+
+                # per-block insertion-rank KS (host fold of the ring's
+                # rank trace): the posterior-correctness diagnostic,
+                # emitted in every heartbeat and folded by report.py
+                from .convergence import insertion_rank_ks
+                ks = insertion_rank_ks(rk, nmax)
+                if ks is not None:
+                    ks_blocks.append(ks)
+
+                due_ckpt = (it - last_ckpt_it >= checkpoint_every
+                            or it >= max_iter or converged)
+                if due_ckpt:
+                    last_ckpt_it = it
+                n_led = len(dead_u)
+                n_ks = len(ks_blocks)
+                it_now = it
+                # the breaker's resume point (donation-safe snapshot
+                # refs — see _breaker_checkpoint above)
+                last_commit.clear()
+                last_commit.update(
+                    state=dict(u=snap["u"], lnl=snap["lnl"],
+                               key=snap["key"], scale=snap["scale"],
+                               ln_x=snap["ln_x"], lnz=snap["lnz"]),
+                    n_led=n_led, it_now=it_now, nd_now=n_dispatch,
+                    ns_now=n_sync, n_ks=n_ks)
+
+                def _host_work(snap=snap, n_led=n_led, n_ks=n_ks,
+                               it_now=it_now, due_ckpt=due_ckpt,
+                               ks=ks, sync_s=sync_s,
+                               delta_last=delta_last,
+                               acc_last=acc_last, lnz=lnz,
+                               scale=scale, bubble_s=last_bubble_s,
+                               nd_now=n_dispatch, ns_now=n_sync):
+                    with span("ns.host_work", it=it_now):
+                        if due_ckpt:
+                            state = dict(u=snap["u"], lnl=snap["lnl"],
+                                         key=snap["key"],
+                                         scale=snap["scale"],
+                                         ln_x=snap["ln_x"],
+                                         lnz=snap["lnz"])
+                            _write_ckpt_payload(state, n_led, it_now,
+                                                nd_now=nd_now,
+                                                ns_now=ns_now,
+                                                n_ks=n_ks)
+                            rec.checkpoint(iteration=it_now)
+                        hb = dict(iteration=it_now,
+                                  lnz=round(lnz, 3),
+                                  dlogz=round(delta_last, 4),
+                                  accept=round(acc_last, 3),
+                                  scale=round(scale, 4),
+                                  evals_per_s=round(
+                                      meter.window_rate(), 1),
+                                  evals_total=int(meter.total),
+                                  host_sync_wall_s=round(sync_s, 4),
+                                  block_bubble_s=round(bubble_s, 4))
+                        if ks is not None:
+                            hb["insertion_ks"] = round(ks, 4)
+                        mem = profiling.memory_watermark()
+                        if mem is not None:
+                            hb.update(mem)
+                        rss = profiling.host_rss_bytes()
+                        if rss is not None:
+                            hb["rss_bytes"] = rss
+                        pp = telemetry.pallas_path_summary()
+                        if pp:
+                            hb["pallas_path"] = pp
+                        rec.heartbeat(**hb)
+                        if verbose:
+                            _log.info(
+                                "NS it=%d lnZ=%.3f dlogz=%.4f "
+                                "acc=%.2f scale=%.3f ks=%.3f", it_now,
+                                lnz, delta_last, acc_last, scale,
+                                ks if ks is not None else float("nan"))
+                pipe.defer(_host_work)
+        finally:
+            # the last block's checkpoint/heartbeat must land before
+            # the caller (resume, tests, report) reads the directory
+            pipe.flush()
+        rec.heartbeat(iteration=it, lnz=round(lnz, 3),
+                      converged=bool(converged),
+                      evals_per_s=round(meter.rate(), 1),
+                      evals_total=int(meter.total))
+
+    if converged and ckpt_path is not None and is_primary() \
+            and os.path.exists(ckpt_path):
+        os.remove(ckpt_path)       # run complete; next run starts fresh
+    elif not converged and it > last_ckpt_it:
+        state = dict(u=np.asarray(u), lnl=np.asarray(lnl),
+                     key=np.asarray(rng_key), scale=scale, ln_x=ln_x,
+                     lnz=lnz)
+        _write_ckpt_payload(state, len(dead_u), it,
+                            nd_now=n_dispatch, ns_now=n_sync)
+
+    from .convergence import (insertion_rank_ks, insertion_rank_neff,
+                              insertion_rank_pass)
+    rk_pooled = (np.concatenate(ranks_all) if ranks_all
+                 else np.zeros(0, dtype=np.int64))
+    ks_pooled = insertion_rank_ks(rk_pooled, nmax)
+    insertion = None
+    if ks_pooled is not None:
+        insertion = dict(
+            ks_pooled=round(ks_pooled, 5),
+            ks_block_worst=round(max(ks_blocks), 5) if ks_blocks
+            else None,
+            n=int(rk_pooled.size), n_blocks=len(ks_blocks),
+            **insertion_rank_pass(
+                ks_pooled, rk_pooled.size,
+                n_eff=insertion_rank_neff(rk_pooled.size, nlive,
+                                          kbatch)))
+    nb = max(n_dispatch - ckpt_dispatch, 1)
+    its = max(it, 1)
+    return _finalize(
+        like, outdir, label, seed, nlive, kbatch, nsteps, it,
+        converged, u, lnl, ln_x, dead_u, dead_lnl, dead_lnx,
+        dead_dlnx, slide_eff=slide_effective(like, slide_moves),
+        # deterministic scheduling provenance only — it lands in the
+        # written result.json, which kill-and-resume must reproduce
+        # byte-for-byte (counters are cumulative across sessions)
+        dispatch_stats=dict(
+            dispatches=n_dispatch, host_syncs=n_sync, iterations=it,
+            block_iters=block_iters,
+            dispatches_per_iteration=round(n_dispatch / its, 4),
+            host_syncs_per_iteration=round(n_sync / its, 4)),
+        # wall-clock figures are session-local and non-reproducible:
+        # returned to the caller (bench) but kept OUT of the artifact
+        dispatch_timing=dict(
+            host_sync_wall_s=round(sync_total_s, 4),
+            block_bubble_s=round(bubble_total_s, 4),
+            sync_wall_per_block_s=round(sync_total_s / nb, 5)),
+        insertion_rank=insertion, block_iters=block_iters,
+        kernel=kernel)
+
+
+def _escalate_nonfinite_dead(du, dl, outdir, it):
+    """Counted escalation of non-finite dead points (the likelihood
+    builders map NaN -> -inf, so the test is ~isfinite): registry
+    counter + flight-recorder record + one-shot anomaly dump."""
+    badm = ~np.isfinite(dl)
+    nbad = int(np.sum(badm))
+    if not nbad:
+        return
+    telemetry.registry().counter(
+        "nonfinite_eval", where="nested").inc(nbad)
+    fr = flight_recorder()
+    fr.record("nonfinite_eval", where="nested", count=nbad,
+              iteration=it)
+    fr.anomaly(
+        "nonfinite_eval", run_dir=outdir,
+        once_key=f"nonfinite_eval:{outdir}",
+        iteration=it, n_bad=nbad,
+        bad_u=du[badm][:8], bad_lnl=dl[badm][:8])
+
+
+# ewt: allow-host-sync,precision — run epilogue: folds the completed
+# host-side dead ledger into evidence/posterior (f64 — lnZ spans
+# ~1e3 nats); the live set is pulled once, after the loop
+def _finalize(like, outdir, label, seed, nlive, kbatch, nsteps, it,
+              converged, u, lnl, ln_x, dead_u, dead_lnl, dead_lnx,
+              dead_dlnx, slide_eff, dispatch_stats, insertion_rank,
+              block_iters=0, kernel="walk", dispatch_timing=None):
+    """Shared run epilogue: fold the remaining live points, compute
+    evidence/weights/posterior, write the Bilby-style result."""
+    from ..parallel.distributed import is_primary
+    nd = like.ndim
+
     order = np.argsort(np.asarray(lnl))
     dead_u.append(np.asarray(u)[order])
     dead_lnl.append(np.asarray(lnl)[order])
@@ -530,6 +1347,19 @@ def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
     idx = rng.choice(len(w), size=max(neff, 100), p=w)
     posterior = theta_all[idx]
 
+    # the WRITTEN result holds only sampling-determined fields, so
+    # kill-and-resume reproduces the artifact byte-for-byte under ANY
+    # interrupt pattern: scheduling history (dispatch counts, the
+    # block partition of the KS trace) depends on where a session was
+    # cut and is attached to the RETURNED dict only, below. The
+    # pooled insertion-rank fields are partition-independent.
+    insertion_written = None
+    if insertion_rank is not None:
+        insertion_written = {
+            k: insertion_rank[k]
+            for k in ("ks_pooled", "n", "n_eff", "pass", "ks_sqrt_n",
+                      "crit")
+            if k in insertion_rank}
     result = dict(
         label=label,
         converged=bool(converged),
@@ -537,7 +1367,10 @@ def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
         log_evidence_err=lnz_err,
         log_noise_evidence=float("nan"),
         sampler="enterprise_warp_tpu.nested",
-        slide_moves_effective=slide_effective(like, slide_moves),
+        slide_moves_effective=slide_eff,
+        block_iters=int(block_iters),
+        kernel=kernel,
+        insertion_rank=insertion_written,
         parameter_labels=list(like.param_names),
         posterior={n: posterior[:, i].tolist()
                    for i, n in enumerate(like.param_names)},
@@ -555,6 +1388,12 @@ def _run_nested_impl(like, outdir=None, nlive=500, dlogz=0.1, nsteps=25,
     result["samples"] = theta_all
     result["log_weights"] = logw_norm
     result["posterior_samples"] = posterior
+    # session-local scheduling/wall-clock provenance: returned, never
+    # written (the on-disk result must be kill-and-resume
+    # reproducible; these depend on where sessions were cut)
+    result["dispatch_stats"] = dispatch_stats
+    result["dispatch_timing"] = dispatch_timing
+    result["insertion_rank"] = insertion_rank
     return result
 
 

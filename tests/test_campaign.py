@@ -591,6 +591,48 @@ class TestSentinel:
         assert sentinel.main(["--bench-dir", d, "--out",
                               str(tmp_path / "T.json"), "-q"]) == 1
 
+    def test_nested_gate_fails_on_regression(self, tmp_path):
+        """BENCH_NESTED.json gates (ISSUE 11): a lost dispatch
+        amortization or a failing insertion-rank diagnostic must fail
+        the sentinel; a healthy record passes."""
+        sentinel = _load_tool("sentinel")
+        d = str(tmp_path / "hist")
+        _bench_fixture(d)
+        healthy = {
+            "dispatch_reduction": 16.0,
+            "lnz_agree_1e9": True, "lnz_abs_diff": 0.0,
+            "insertion_rank": {"pass": True, "ks_sqrt_n": 0.8,
+                               "crit": 1.95},
+            "per_iteration": {"evals_per_s": 1000.0},
+            "blocked_walk": {"evals_per_s": 1200.0},
+        }
+        path = os.path.join(d, "BENCH_NESTED.json")
+        json.dump(healthy, open(path, "w"))
+        out = tmp_path / "T.json"
+        assert sentinel.main(["--bench-dir", d, "--out", str(out),
+                              "-q"]) == 0
+        # amortization regression: blocked dispatches crept back up
+        json.dump(dict(healthy, dispatch_reduction=4.0),
+                  open(path, "w"))
+        assert sentinel.main(["--bench-dir", d, "--out", str(out),
+                              "-q"]) == 1
+        gate = {g["name"]: g for g in
+                json.loads(out.read_text())["gates"]}["nested"]
+        assert gate["status"] == "fail"
+        # posterior-correctness regression: rank diagnostic failing
+        json.dump(dict(healthy, insertion_rank={
+            "pass": False, "ks_sqrt_n": 11.0, "crit": 1.95}),
+            open(path, "w"))
+        assert sentinel.main(["--bench-dir", d, "--out", str(out),
+                              "-q"]) == 1
+        # missing record is a warning, not a silent pass
+        os.remove(path)
+        assert sentinel.main(["--bench-dir", d, "--out", str(out),
+                              "-q"]) == 0
+        gate = {g["name"]: g for g in
+                json.loads(out.read_text())["gates"]}["nested"]
+        assert gate["status"] == "warn"
+
     def test_stale_device_leg_warns_and_strict_fails(self, tmp_path):
         sentinel = _load_tool("sentinel")
         d = str(tmp_path / "hist")

@@ -1,0 +1,249 @@
+"""Blocked device-resident nested sampling (samplers/nested.py).
+
+Pins the PR's contracts: the ``EWT_NESTED_BLOCK=0`` hatch restores the
+seed per-iteration path bit-for-bit; blocking the walk kernel is pure
+scheduling (bit-equal ledger); kill/resume re-aligns to the absolute
+block grid and reproduces the uninterrupted run; a checkpoint from a
+different block geometry starts fresh; the whitened slice kernel
+samples an analytic constrained-uniform target correctly (lnZ +
+insertion-rank KS); dispatches/host-syncs are amortized >= 10x; and
+the heartbeat/report plumbing carries the new per-block fields.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from test_samplers import GaussianLike
+
+from enterprise_warp_tpu.samplers.convergence import (
+    insertion_rank_ks, insertion_rank_pass)
+from enterprise_warp_tpu.samplers.nested import run_nested
+
+
+def _like():
+    return GaussianLike([0.5, -1.0], [0.4, 0.8])
+
+
+# fixed-work settings: dlogz pinned tiny so every run does exactly
+# max_iter iterations and the ledgers are comparable array-for-array
+FIXED = dict(nlive=120, kbatch=24, nsteps=10, dlogz=1e-12, seed=3,
+             verbose=False)
+
+
+class TestBlockedEquality:
+    def test_blocked_walk_bit_equal_to_periter(self):
+        """Blocking the outer loop is SCHEDULING, not sampling: the
+        walk kernel folded into lax.scan blocks must reproduce the
+        per-iteration path's dead-point ledger bit-for-bit (same RNG
+        stream, same on-device evidence/scale arithmetic)."""
+        r_leg = run_nested(_like(), max_iter=12, block_iters=0,
+                           **FIXED)
+        r_blk = run_nested(_like(), max_iter=12, block_iters=4,
+                           kernel="walk", **FIXED)
+        assert r_leg["log_evidence"] == r_blk["log_evidence"]
+        assert np.array_equal(r_leg["samples"], r_blk["samples"])
+        assert np.array_equal(r_leg["log_weights"],
+                              r_blk["log_weights"])
+
+    def test_env_hatch_restores_periter(self, monkeypatch):
+        """EWT_NESTED_BLOCK=0 == block_iters=0 == the seed path."""
+        monkeypatch.setenv("EWT_NESTED_BLOCK", "0")
+        r_env = run_nested(_like(), max_iter=8, **FIXED)
+        monkeypatch.delenv("EWT_NESTED_BLOCK")
+        r_leg = run_nested(_like(), max_iter=8, block_iters=0,
+                           **FIXED)
+        assert r_env["log_evidence"] == r_leg["log_evidence"]
+        assert np.array_equal(r_env["samples"], r_leg["samples"])
+        # and the hatch really is the per-iteration dispatch schedule
+        assert r_env["dispatch_stats"]["dispatches_per_iteration"] \
+            == 1.0
+        assert r_env["block_iters"] == 0
+
+    def test_host_mode_matches_device_mode(self, monkeypatch):
+        """EWT_DEVICE_STATE=0 (no donation, per-block host rebind)
+        must not change the blocked path's sampling."""
+        r_dev = run_nested(_like(), max_iter=8, block_iters=4, **FIXED)
+        monkeypatch.setenv("EWT_DEVICE_STATE", "0")
+        r_host = run_nested(_like(), max_iter=8, block_iters=4,
+                            **FIXED)
+        assert r_dev["log_evidence"] == r_host["log_evidence"]
+        assert np.array_equal(r_dev["samples"], r_host["samples"])
+
+
+class TestBlockedResume:
+    def test_resume_realigns_to_block_grid(self, tmp_path):
+        """A kill at a NON-block-aligned iteration (max_iter mid-block
+        here) must resume onto the absolute block grid and reproduce
+        the uninterrupted run bit-for-bit — including the scheduling
+        provenance written into the result artifact."""
+        kw = dict(nlive=100, kbatch=20, nsteps=8, dlogz=0.1, seed=3,
+                  verbose=False, checkpoint_every=6, block_iters=6)
+        full = run_nested(_like(), outdir=str(tmp_path / "full"), **kw)
+        out2 = str(tmp_path / "resumed")
+        run_nested(_like(), outdir=out2, max_iter=14, **kw)
+        assert os.path.exists(
+            tmp_path / "resumed" / "result_nested_ckpt.npz")
+        res = run_nested(_like(), outdir=out2, resume=True, **kw)
+        assert not os.path.exists(
+            tmp_path / "resumed" / "result_nested_ckpt.npz")
+        assert res["num_iterations"] == full["num_iterations"]
+        assert res["log_evidence"] == full["log_evidence"]
+        assert np.array_equal(res["samples"], full["samples"])
+        assert (tmp_path / "full" / "result_result.json").read_bytes() \
+            == (tmp_path / "resumed" / "result_result.json").read_bytes()
+
+    def test_ckpt_incompatible_on_changed_block_iters(self, tmp_path):
+        """The block geometry is part of the checkpoint identity: a
+        resume under a different block_iters must start fresh, not
+        silently continue a mismatched grid."""
+        kw = dict(nlive=80, kbatch=16, nsteps=6, dlogz=1e-12, seed=1,
+                  verbose=False, checkpoint_every=3)
+        run_nested(_like(), outdir=str(tmp_path), max_iter=6,
+                   block_iters=3, **kw)
+        assert os.path.exists(tmp_path / "result_nested_ckpt.npz")
+        # resumed=True but incompatible -> fresh: only 4 iterations
+        res = run_nested(_like(), outdir=str(tmp_path), max_iter=4,
+                         block_iters=2, resume=True, **kw)
+        assert res["num_iterations"] == 4
+
+    def test_blocked_ckpt_rejected_by_periter_path(self, tmp_path):
+        """Geometry incompatibility is TWO-way: a blocked-path
+        checkpoint must not silently resume on the per-iteration
+        hatch path (different kernel, scale clip, block grid)."""
+        kw = dict(nlive=80, kbatch=16, nsteps=6, dlogz=1e-12, seed=1,
+                  verbose=False, checkpoint_every=4)
+        run_nested(_like(), outdir=str(tmp_path), max_iter=4,
+                   block_iters=4, **kw)
+        assert os.path.exists(tmp_path / "result_nested_ckpt.npz")
+        res = run_nested(_like(), outdir=str(tmp_path), max_iter=2,
+                         block_iters=0, resume=True, **kw)
+        assert res["num_iterations"] == 2       # fresh, not resumed
+
+    def test_breaker_demotion_resumes_last_commit(self, monkeypatch,
+                                                  tmp_path):
+        """A circuit-breaker trip between checkpoint_every marks must
+        still find a checkpoint at the LAST COMMITTED block boundary
+        (the supervisor's on_checkpoint contract): the demotion
+        re-entry reproduces the uninterrupted run exactly."""
+        monkeypatch.setenv("EWT_FAULT_PLAN", json.dumps(
+            {"faults": [{"site": "nested.iteration", "kind": "error",
+                         "at": 3, "count": 10}]}))
+        monkeypatch.setenv("EWT_DISPATCH_RETRIES", "1")
+        monkeypatch.setenv("EWT_DISPATCH_STRIKES", "1")
+        kw = dict(nlive=80, kbatch=16, nsteps=6, dlogz=1e-12, seed=1,
+                  verbose=False, checkpoint_every=40, block_iters=4)
+        res = run_nested(_like(), outdir=str(tmp_path), max_iter=12,
+                         **kw)
+        monkeypatch.delenv("EWT_FAULT_PLAN")
+        ref = run_nested(_like(), outdir=str(tmp_path / "ref"),
+                         max_iter=12, **kw)
+        assert res["num_iterations"] == 12
+        assert res["log_evidence"] == ref["log_evidence"]
+        assert np.array_equal(res["samples"], ref["samples"])
+
+    def test_ckpt_incompatible_on_changed_kernel(self, tmp_path):
+        kw = dict(nlive=80, kbatch=16, nsteps=6, dlogz=1e-12, seed=1,
+                  verbose=False, checkpoint_every=4, block_iters=4)
+        run_nested(_like(), outdir=str(tmp_path), max_iter=4,
+                   kernel="slice", **kw)
+        res = run_nested(_like(), outdir=str(tmp_path), max_iter=4,
+                         kernel="walk", resume=True, **kw)
+        assert res["num_iterations"] == 4       # fresh, not resumed
+
+
+class TestSliceKernel:
+    def test_constrained_uniform_analytic_target(self):
+        """Whitened-slice kernel against an analytic target whose
+        constrained sets are balls: lnl = -|x-c|^2/(2*0.5^2)-like via a
+        truncated isotropic Gaussian in the unit box. Checks the two
+        measurables: lnZ against the (erf) analytic value, and the
+        insertion-rank KS (each replacement uniform among survivors
+        iff the kernel truly samples the constrained prior)."""
+        from scipy.special import erf
+        sig = 1.0 / np.sqrt(2.0)
+        like = GaussianLike([0.5] * 3, [sig] * 3, lo=0.0, hi=1.0)
+        # Z = prod_i int_0^1 N(x; 0.5, sig^2) dx (truncation mass)
+        lnz_true = 3.0 * np.log(erf(0.5 / (sig * np.sqrt(2.0))))
+        res = run_nested(like, nlive=300, dlogz=0.05, seed=2,
+                         verbose=False, kernel="slice")
+        assert res["kernel"] == "slice"
+        ir = res["insertion_rank"]
+        assert ir is not None and ir["pass"], ir
+        assert res["log_evidence"] == pytest.approx(
+            lnz_true, abs=max(4 * res["log_evidence_err"], 0.25))
+
+    def test_insertion_rank_ks_helpers(self):
+        rng = np.random.default_rng(0)
+        uni = rng.integers(0, 101, size=4000)
+        d = insertion_rank_ks(uni, 100)
+        assert insertion_rank_pass(d, uni.size)["pass"]
+        # a broken kernel clusters ranks near the floor
+        bad = rng.integers(0, 30, size=4000)
+        d_bad = insertion_rank_ks(bad, 100)
+        assert not insertion_rank_pass(d_bad, bad.size)["pass"]
+        assert insertion_rank_ks(np.zeros(0), 100) is None
+
+
+class TestDispatchAmortization:
+    def test_dispatches_amortized_10x(self):
+        """The committed contract (also gated by tools/sentinel.py on
+        BENCH_NESTED.json): at the default block_iters the blocked
+        path performs >= 10x fewer dispatches AND host round-trips
+        per NS iteration than the seed path."""
+        r = run_nested(_like(), max_iter=32, **FIXED)
+        ds = r["dispatch_stats"]
+        assert ds["block_iters"] >= 10
+        assert ds["dispatches_per_iteration"] <= 0.1
+        assert ds["host_syncs_per_iteration"] <= 0.1
+        assert ds["iterations"] == 32
+        # timing provenance returned but NOT in the artifact (resume
+        # byte-reproducibility)
+        assert "host_sync_wall_s" in r["dispatch_timing"]
+
+    def test_partial_final_block_counts(self):
+        """max_iter off the grid: the final partial block is one more
+        dispatch, iterations stay exact."""
+        r = run_nested(_like(), max_iter=20, block_iters=16, **FIXED)
+        ds = r["dispatch_stats"]
+        assert ds["iterations"] == 20
+        assert ds["dispatches"] == 2       # 16 + 4
+
+
+class TestTelemetryParity:
+    def test_heartbeats_and_report_fold(self, tmp_path):
+        """Nested heartbeats carry the PTMCMC-parity fields
+        (host_sync_wall_s / block_bubble_s) plus per-block
+        insertion_ks; tools/report.py folds them."""
+        run_nested(_like(), outdir=str(tmp_path), max_iter=12,
+                   block_iters=4, kernel="slice", **{
+                       **FIXED, "dlogz": 1e-12})
+        events = [json.loads(ln) for ln in
+                  (tmp_path / "events.jsonl").read_text().splitlines()]
+        hbs = [e for e in events if e["type"] == "heartbeat"
+               and "insertion_ks" in e]
+        assert hbs, "no heartbeat carried insertion_ks"
+        assert all("host_sync_wall_s" in h and "block_bubble_s" in h
+                   for h in hbs)
+        assert hbs[-1]["iteration"] == 12
+        # compile event names the blocked jit
+        fns = {e.get("fn") for e in events if e["type"] == "compile"}
+        assert "nested_block" in fns
+        # report fold
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "report", os.path.join(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))),
+                "tools", "report.py"))
+        report = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(report)
+        evs, dropped = report.load_events(
+            str(tmp_path / "events.jsonl"))
+        rep = report.build_report(evs, dropped)
+        ir = rep["insertion_rank"]
+        assert ir and ir["blocks"] == 3
+        assert ir["worst_ks"] >= ir["last_ks"] * 0 and \
+            ir["last_ks"] == hbs[-1]["insertion_ks"]
+        assert rep["wall_clock"]["bubble_s"] is not None

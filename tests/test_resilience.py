@@ -442,9 +442,22 @@ s.sample(80, resume=True, verbose=False, block_size=20)
 
 NESTED_BODY = """\
 from enterprise_warp_tpu.samplers.nested import run_nested
+# blocked path with an explicit block grid: the nested.ckpt kill fires
+# at a BLOCK boundary (checkpoints land there now), so this leg pins
+# kill-and-resume bit-equality across the blocked dispatch
 run_nested(like, outdir=outdir, nlive=40, kbatch=8, nsteps=5,
            dlogz=0.5, seed=0, checkpoint_every=5, label="r",
-           verbose=False)
+           verbose=False, block_iters=5, kernel="slice")
+"""
+
+NESTED_PERITER_BODY = """\
+from enterprise_warp_tpu.samplers.nested import run_nested
+# the EWT_NESTED_BLOCK=0 hatch path (seed per-iteration dispatch):
+# its kill-and-resume contract must stay covered under real fault
+# injection, not just the blocked default's
+run_nested(like, outdir=outdir, nlive=40, kbatch=8, nsteps=5,
+           dlogz=0.5, seed=0, checkpoint_every=5, label="r",
+           verbose=False, block_iters=0)
 """
 
 
@@ -485,8 +498,11 @@ def _drive_to_completion(script, outdir, plan, max_attempts=5):
     (NESTED_BODY,
      {"faults": [{"site": "nested.ckpt", "kind": "kill", "at": 1}]},
      "r_result.json"),
+    (NESTED_PERITER_BODY,
+     {"faults": [{"site": "nested.ckpt", "kind": "kill", "at": 1}]},
+     "r_result.json"),
 ], ids=["pt-ckpt-kill", "pt-chain-kill", "hmc-ckpt-kill",
-        "nested-ckpt-kill"])
+        "nested-ckpt-kill", "nested-periter-ckpt-kill"])
 def test_kill_and_resume_reproduces_uninterrupted(tmp_path, body, plan,
                                                   artifact):
     script = tmp_path / "child.py"

@@ -28,7 +28,14 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 OUT = os.path.join(REPO, "NESTED_WIDTH_AB.json")
-NESTED_CFG = dict(nlive=800, dlogz=0.1, nsteps=12, kbatch=400)
+# kernel pinned to the seed Gaussian+DE walk: this tool's committed
+# artifact documents the slide-move effect ON THAT KERNEL (round-4
+# fix). The production default is now the whitened slice kernel
+# (docs/kernels.md), which carries the slide as a mixture component
+# and is gated separately (BENCH_NESTED.json insertion-rank +
+# NORTH_STAR nested legs).
+NESTED_CFG = dict(nlive=800, dlogz=0.1, nsteps=12, kbatch=400,
+                  kernel="walk")
 
 
 def _cpu_leg():

@@ -44,7 +44,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # presumed wedged (dropped accelerator tunnel blocks forever on a futex
 # inside the PJRT client — observed in round 3) and is killed + retried;
 # legs resume from their checkpoint so a retry costs only the last block
-IDLE_TIMEOUT_S = 1200
+IDLE_TIMEOUT_S = int(os.environ.get("EWT_NS_IDLE_TIMEOUT_S", "1200"))
 MAX_ATTEMPTS = 6
 PROBE_WAIT_S = 3600   # max wait for the device to come back per attempt
 
@@ -161,8 +161,17 @@ LEGS = {
     # error is far inside the nested error budget, and the dev-vs-cpu
     # lnZ agreement gate plus the pooled posterior gate validate it
     # directly against the refine=3 f64 CPU leg
+    # kernel="slice": the blocked device-resident path's whitened
+    # slice sampler (samplers/nested.py, docs/kernels.md) — the walk
+    # kernel at nsteps=12 is what produced the round-4 width-gate
+    # failure; the slice kernel needs ~1.5*ndim complete hit-and-run
+    # updates per replacement (ndim=12 -> 18 updates at 4 eval rounds
+    # each -> nsteps=72; measured unbiased on a 16-dim analytic
+    # target). block_iters=16 amortizes host syncs 16x per the
+    # BENCH_NESTED.json contract.
     "nested_device": dict(kind="nested", gram_mode="split", nlive=800,
-                          dlogz=0.1, nsteps=12, kbatch=400, refine=2),
+                          dlogz=0.1, nsteps=72, kbatch=400, refine=2,
+                          kernel="slice", block_iters=16),
     # second independent device seed: NESTED_WIDTH_AB.json measured
     # ~15-20% seed-to-seed scatter in single-run width estimates (far
     # above the per-run bootstrap stderr), so the unbiased width test
@@ -170,10 +179,18 @@ LEGS = {
     # gate a pooled one, and their lnZ agreement is a same-platform
     # reproducibility check on top of the device-vs-cpu one
     "nested_device2": dict(kind="nested", gram_mode="split", nlive=800,
-                           dlogz=0.1, nsteps=12, kbatch=400, seed=1,
-                           refine=2),
+                           dlogz=0.1, nsteps=72, kbatch=400, seed=1,
+                           refine=2, kernel="slice", block_iters=16),
     "nested_cpu": dict(kind="nested", gram_mode="f64", nlive=800,
-                       dlogz=0.1, nsteps=12, kbatch=400),
+                       dlogz=0.1, nsteps=72, kbatch=400,
+                       kernel="slice", block_iters=16),
+    # second CPU seed: when the device tunnel is down (ROADMAP
+    # standing maintenance), the pooled posterior verdict is taken
+    # over the two CPU seeds with nested_device_unavailable recorded
+    # honestly — the same pooling math as the device pair
+    "nested_cpu2": dict(kind="nested", gram_mode="f64", nlive=800,
+                        dlogz=0.1, nsteps=72, kbatch=400, seed=1,
+                        kernel="slice", block_iters=16),
 }
 
 # everything that defines the measurement besides the per-leg configs;
@@ -281,11 +298,23 @@ def run_leg(name):
             prior_wall = json.load(fh)
 
     if cfg.get("kind") == "nested":
+        from enterprise_warp_tpu.resilience.supervisor import \
+            install_graceful_sigterm
         from enterprise_warp_tpu.samplers.nested import run_nested
+
+        # a SIGTERM (watchdog retry, operator stop) must cost one
+        # block, not the whole multi-hour leg: graceful preemption +
+        # a checkpoint every block boundary (the default cadence of
+        # 50 iterations can exceed a short leg's entire run)
+        install_graceful_sigterm()
+        ckpt_every = cfg.get("block_iters") or 16
         t1 = time.perf_counter()
         res = run_nested(like, outdir=outdir, nlive=cfg["nlive"],
                          dlogz=cfg["dlogz"], nsteps=cfg["nsteps"],
                          kbatch=cfg["kbatch"], seed=cfg.get("seed", 0),
+                         kernel=cfg.get("kernel"),
+                         block_iters=cfg.get("block_iters"),
+                         checkpoint_every=ckpt_every,
                          resume=True, label="ns", verbose=True)
         wall_s = prior_wall["wall_s"] + (time.perf_counter() - t1)
         tmp = wall_path + ".tmp"
@@ -304,6 +333,8 @@ def run_leg(name):
             converged=bool(res["converged"]),
             steps=int(res["num_iterations"]),
             evals=int(res["num_likelihood_evaluations"]),
+            insertion_rank=res.get("insertion_rank"),
+            dispatch_stats=res.get("dispatch_stats"),
             lnZ=res["log_evidence"], lnZ_err=res["log_evidence_err"],
             wall_s=round(wall_s, 2),
             # no first-block exclusion: with a warm compile cache the
@@ -642,8 +673,8 @@ def run_legs(which):
                   flush=True)
             continue
         if name in LEGS:
-            env = _cpu_env() if name in ("cpu", "nested_cpu") \
-                else dict(os.environ)
+            env = _cpu_env() if name == "cpu" \
+                or name.startswith("nested_cpu") else dict(os.environ)
             if name != "cpu":
                 env["PYTHONPATH"] = REPO + os.pathsep + \
                     env.get("PYTHONPATH", "")
@@ -717,6 +748,56 @@ def _posterior_match(leg, cpu_leg):
                 ratio_adj=round(worst_adj, 3))
 
 
+#: the dynesty-equivalent per-iteration walk budget the >=30x nested
+#: gate was calibrated against (round 4: nsteps 20->12 validated at
+#: identical lnZ). The reference-shaped wall must price the
+#: REFERENCE'S eval count — iterations are compression-bound (shared),
+#: but our slice kernel's larger per-iteration eval budget (nsteps=72)
+#: is OUR cost, not the reference's: pricing our budget at the scalar
+#: rate would inflate nested_speedup_vs_reference_shape ~6x for free.
+REF_NESTED_NSTEPS = 12
+
+
+def _nested_ref_evals(leg):
+    """The reference stack's eval count for the posterior this leg
+    produced: same compression-bound iteration count, dynesty's own
+    walk budget. Legs missing the geometry echo (pre-slice records,
+    synthetic fixtures) fall back to the leg's own eval count — the
+    old, kernel-budget-priced behavior."""
+    if all(k in leg for k in ("steps", "kbatch", "nlive")):
+        return leg["steps"] * leg["kbatch"] * REF_NESTED_NSTEPS \
+            + leg["nlive"]
+    return leg["evals"]
+
+
+def _pool_seed_pair(leg1, leg2, cpu_leg):
+    """Seed-POOLED posterior gate for a same-platform nested pair:
+    NESTED_WIDTH_AB.json measured the single-run width estimator's
+    seed-to-seed scatter at ~15-20% — far above its bootstrap stderr —
+    so the unbiased bias test averages the two seeds' moments per
+    parameter before gating against the CPU MCMC leg. Each pooled
+    stderr keeps the larger of (bootstrap/sqrt2, half the seed
+    spread): the spread IS the estimator noise the bootstrap cannot
+    see. Returns ``(pooled_match_dict, lnZ_delta, lnZ_sigma)``."""
+    pooled = {}
+    for k, d1 in leg1["posterior"].items():
+        d2 = leg2["posterior"][k]
+        pooled[k] = {
+            "mean": 0.5 * (d1["mean"] + d2["mean"]),
+            "std": 0.5 * (d1["std"] + d2["std"]),
+            "std_err": max(
+                0.5 * (d1["std_err"] + d2["std_err"]) / 2 ** 0.5,
+                0.5 * abs(d1["std"] - d2["std"])),
+            "mean_err": max(
+                0.5 * (d1["mean_err"] + d2["mean_err"]) / 2 ** 0.5,
+                0.5 * abs(d1["mean"] - d2["mean"])),
+        }
+    ppm = _posterior_match({"posterior": pooled}, cpu_leg)
+    dz = abs(leg1["lnZ"] - leg2["lnZ"])
+    sz = (leg1["lnZ_err"] ** 2 + leg2["lnZ_err"] ** 2) ** 0.5
+    return ppm, dz, sz
+
+
 def assemble(out):
     scalar_steps_per_s = out["scalar_steps_per_s"]
     pm = _posterior_match(out["device"], out["cpu"])
@@ -766,6 +847,17 @@ def assemble(out):
                 out["cpu"]["steady_wall_s"] / p["steady_wall_s"], 2),
             north_star_met=bool(result["north_star_met"]
                                 or (pspeed >= 30.0 and pmatch)))
+    # insertion-index rank diagnostic (samplers/nested.py): posterior
+    # correctness MEASURED per leg — every recorded nested leg must
+    # pass for ANY published nested verdict to stand (the pooled
+    # moment comparison alone cannot see a kernel that samples the
+    # wrong constrained distribution with roughly right moments).
+    # ``None`` = no leg carried the diagnostic (pre-slice records).
+    _ir = [(out[k].get("insertion_rank") or {}).get("pass")
+           for k in ("nested_device", "nested_device2", "nested_cpu",
+                     "nested_cpu2") if k in out]
+    _ir = [p for p in _ir if p is not None]
+    ir_ok = bool(all(_ir)) if _ir else None
     if "nested_device" in out:
         # the reference's ACTUAL single-pulsar example configuration
         # (dynesty, nlive 800, dlogz 0.1): nested sampling's sequential
@@ -774,13 +866,14 @@ def assemble(out):
         # algorithm's eval count priced at the measured scalar
         # one-theta-per-call rate (the hot-loop shape of
         # bilby_warp.py:19-35); the MATCHED-POSTERIOR gate compares the
-        # nested posterior to the f64 CPU MCMC leg's, plus an lnZ
-        # cross-check between the two nested legs when both exist.
+        # nested posterior to the f64 CPU MCMC leg's (ANDed with the
+        # insertion-rank verdict above), plus an lnZ cross-check
+        # between the two nested legs when both exist.
         nd_ = out["nested_device"]
         scalar_evals_per_s = scalar_steps_per_s * META["scalar_w"]
-        nref = nd_["evals"] / scalar_evals_per_s
+        nref = _nested_ref_evals(nd_) / scalar_evals_per_s
         npm = _posterior_match(nd_, out["cpu"])
-        nmatch = npm["match"]
+        nmatch = bool(npm["match"] and ir_ok is not False)
         nspeed = nref / nd_["steady_wall_s"]
         result.update(
             nested_device=nd_,
@@ -792,35 +885,14 @@ def assemble(out):
             nested_worst_std_ratio_noise_adjusted=npm["ratio_adj"],
             nested_speedup_vs_reference_shape=round(nspeed, 2))
         if "nested_device2" in out:
-            # seed-POOLED gate: NESTED_WIDTH_AB.json measured the
-            # single-run width estimator's seed-to-seed scatter at
-            # ~15-20% — far above its bootstrap stderr — so the
-            # unbiased bias test averages the two device seeds'
-            # moments per parameter before gating against the CPU leg.
-            # Each pooled stderr keeps the larger of (bootstrap/sqrt2,
-            # half the seed spread): the spread IS the estimator noise
-            # the bootstrap cannot see.
+            # seed-POOLED gate over the two device seeds (shared
+            # pooling math: _pool_seed_pair)
             nd2 = out["nested_device2"]
-            pooled = {}
-            for k, d1 in nd_["posterior"].items():
-                d2 = nd2["posterior"][k]
-                pooled[k] = {
-                    "mean": 0.5 * (d1["mean"] + d2["mean"]),
-                    "std": 0.5 * (d1["std"] + d2["std"]),
-                    "std_err": max(
-                        0.5 * (d1["std_err"] + d2["std_err"]) / 2 ** 0.5,
-                        0.5 * abs(d1["std"] - d2["std"])),
-                    "mean_err": max(
-                        0.5 * (d1["mean_err"] + d2["mean_err"])
-                        / 2 ** 0.5,
-                        0.5 * abs(d1["mean"] - d2["mean"])),
-                }
-            ppm2 = _posterior_match({"posterior": pooled}, out["cpu"])
-            dzd = abs(nd_["lnZ"] - nd2["lnZ"])
-            szd = (nd_["lnZ_err"] ** 2 + nd2["lnZ_err"] ** 2) ** 0.5
+            ppm2, dzd, szd = _pool_seed_pair(nd_, nd2, out["cpu"])
             result.update(
                 nested_device2=nd2,
-                nested_pooled_posterior_match=ppm2["match"],
+                nested_pooled_posterior_match=bool(
+                    ppm2["match"] and ir_ok is not False),
                 nested_pooled_worst_mean_shift_sigma=ppm2["mean"],
                 nested_pooled_worst_mean_shift_sigma_noise_adjusted=
                 ppm2["mean_adj"],
@@ -835,11 +907,13 @@ def assemble(out):
             # estimates also reproduce: a same-platform reproducibility
             # failure must block the headline claim, same as every
             # other lnZ check here. The pooled verdict is published
-            # exclusively under nested_pooled_posterior_match;
+            # exclusively under nested_pooled_posterior_match (pooled
+            # widths AND the rank diagnostic; lnZ agreement is its own
+            # field — same semantics as the CPU-pair branch below);
             # nested_posterior_match stays the SINGLE-SEED verdict so
             # it remains consistent with the single-seed shift/ratio
             # stats it sits next to.
-            nmatch = bool(ppm2["match"]
+            nmatch = bool(ppm2["match"] and ir_ok is not False
                           and result["nested_device_seed_lnZ_agree"])
         lnz_ok = None
         if "nested_cpu" in out:
@@ -861,6 +935,52 @@ def assemble(out):
         result["north_star_met"] = bool(
             result["north_star_met"]
             or (nspeed >= 30.0 and nmatch and lnz_ok is True))
+    elif "nested_cpu" in out:
+        # no device leg this round (tunnel down — ROADMAP standing
+        # maintenance): publish the nested verdict from the CPU legs,
+        # honestly flagged ``nested_device_unavailable`` — posterior
+        # correctness is a property of the sampler kernel, not the
+        # silicon, so it must not wait on the tunnel. The speedup
+        # figure is the CPU leg's and can never claim the >=30x gate.
+        nc = out["nested_cpu"]
+        scalar_evals_per_s = scalar_steps_per_s * META["scalar_w"]
+        nref = _nested_ref_evals(nc) / scalar_evals_per_s
+        npm = _posterior_match(nc, out["cpu"])
+        result.update(
+            nested_cpu=nc,
+            nested_device_unavailable=True,
+            nested_reference_shaped_wall_s=round(nref, 1),
+            nested_posterior_match=bool(npm["match"]
+                                        and ir_ok is not False),
+            nested_worst_mean_shift_sigma=npm["mean"],
+            nested_worst_mean_shift_sigma_noise_adjusted=npm["mean_adj"],
+            nested_worst_std_ratio=npm["ratio"],
+            nested_worst_std_ratio_noise_adjusted=npm["ratio_adj"],
+            nested_speedup_vs_reference_shape=round(
+                nref / nc["steady_wall_s"], 2))
+        if "nested_cpu2" in out:
+            # seed-POOLED width gate over the two CPU seeds (shared
+            # pooling math: _pool_seed_pair); pooled match = pooled
+            # widths AND the rank diagnostic — the seed lnZ agreement
+            # is published as its own field, SAME semantics as the
+            # device-pair branch above
+            nc2 = out["nested_cpu2"]
+            ppm2, dzc, szc = _pool_seed_pair(nc, nc2, out["cpu"])
+            result.update(
+                nested_cpu2=nc2,
+                nested_pooled_posterior_match=bool(
+                    ppm2["match"] and ir_ok is not False),
+                nested_pooled_worst_mean_shift_sigma=ppm2["mean"],
+                nested_pooled_worst_mean_shift_sigma_noise_adjusted=
+                ppm2["mean_adj"],
+                nested_pooled_worst_std_ratio=ppm2["ratio"],
+                nested_pooled_worst_std_ratio_noise_adjusted=
+                ppm2["ratio_adj"],
+                nested_cpu_seed_lnZ_delta=round(dzc, 3),
+                nested_cpu_seed_lnZ_agree=bool(
+                    dzc <= 3.0 * max(szc, 0.1)))
+    if ir_ok is not None:
+        result["nested_insertion_rank_pass"] = ir_ok
     final = os.path.join(REPO, "NORTH_STAR.json")
     with open(final + ".tmp", "w") as fh:
         json.dump(result, fh, indent=1)

@@ -238,6 +238,7 @@ def build_report(events, dropped=0):
     rate_timeline, convergence, cache_hit = [], [], None
     bubble_s, host_sync_s, bubble_blocks = 0.0, 0.0, 0
     pallas_path = None
+    insertion_ks = []
     for hb in heartbeats:
         t_rel = round(hb["t"] - t0, 2) if t0 is not None else None
         if hb.get("evals_per_s") is not None:
@@ -263,6 +264,10 @@ def build_report(events, dropped=0):
             bubble_blocks += 1
         if hb.get("host_sync_wall_s") is not None:
             host_sync_s += float(hb["host_sync_wall_s"])
+        # nested-sampling insertion-rank diagnostic (one KS statistic
+        # per committed block): posterior correctness, measured
+        if hb.get("insertion_ks") is not None:
+            insertion_ks.append(float(hb["insertion_ks"]))
 
     rates = [r["evals_per_s"] for r in rate_timeline
              if r["evals_per_s"] is not None]
@@ -351,6 +356,11 @@ def build_report(events, dropped=0):
                           else None),
         },
         "cache_hit_rate": cache_hit,
+        "insertion_rank": ({
+            "last_ks": insertion_ks[-1],
+            "worst_ks": max(insertion_ks),
+            "blocks": len(insertion_ks),
+        } if insertion_ks else None),
         "pallas_path": pallas_path,
         "checkpoints": len(checkpoints),
         "spans": (span_stats or None),
@@ -426,6 +436,10 @@ def _human_summary(report, out=sys.stdout):
           f"{len(conv['trajectory'])} checks")
     if report["cache_hit_rate"] is not None:
         p(f"cache_hit_rate: {report['cache_hit_rate']}")
+    ir = report.get("insertion_rank")
+    if ir:
+        p(f"insertion rank: last KS {ir['last_ks']} "
+          f"(worst {ir['worst_ks']} over {ir['blocks']} blocks)")
     if report.get("pallas_path"):
         routes = "; ".join(
             f"{kern}: " + ",".join(f"{path}x{n}"

@@ -209,6 +209,67 @@ def gate_bubble(bench_dir, min_reduction, max_host_fraction):
                  host_boundary_fraction=host)
 
 
+def gate_nested(bench_dir, min_reduction, tol):
+    """Nested-sampling gates from BENCH_NESTED.json: the blocked
+    dispatch amortization must hold its floor, the insertion-rank
+    diagnostic must pass (posterior correctness, measured — the gate
+    that keeps ``nested_posterior_match`` honest between north-star
+    refreshes), the scheduling A/B must still agree on lnZ, and the
+    blocked path must not be slower than the per-iteration one it
+    replaced."""
+    doc = _load_json(os.path.join(bench_dir, "BENCH_NESTED.json"))
+    if not doc:
+        return _gate("nested", "warn", "no BENCH_NESTED.json record")
+    problems = []
+    red = doc.get("dispatch_reduction")
+    if red is None:
+        problems.append("record lacks dispatch_reduction")
+    elif red < min_reduction:
+        problems.append(f"dispatch_reduction {red}x < floor "
+                        f"{min_reduction}x")
+    ir = doc.get("insertion_rank") or {}
+    if "pass" not in ir:
+        # a record without the rank verdict must not sail through the
+        # gate whose whole job is posterior correctness (mirror the
+        # missing-dispatch_reduction contract)
+        problems.append("record lacks an insertion_rank verdict")
+    elif ir["pass"] is False:
+        problems.append(
+            f"insertion-rank KS failed (ks*sqrt(n)="
+            f"{ir.get('ks_sqrt_n')} > {ir.get('crit')}): the "
+            "constrained kernel is not sampling the prior above L*")
+    if "lnz_agree_1e9" not in doc:
+        problems.append("record lacks the lnz_agree_1e9 verdict")
+    elif doc["lnz_agree_1e9"] is False:
+        problems.append(
+            f"blocked-vs-periter lnZ disagree beyond 1e-9 "
+            f"(|dlnZ|={doc.get('lnz_abs_diff')}): blocking changed "
+            "the sampling, not just the scheduling")
+    per = (doc.get("per_iteration") or {}).get("evals_per_s")
+    blk = (doc.get("blocked_walk") or {}).get("evals_per_s")
+    if not per or not blk:
+        # missing/zero throughput arms disable the no-regression
+        # check — flag it like every other absent sub-verdict
+        problems.append("record lacks per_iteration/blocked_walk "
+                        "evals_per_s")
+    elif blk < (1.0 - tol) * per:
+        problems.append(
+            f"blocked path slower than per-iteration: {blk} < "
+            f"{(1.0 - tol) * per:.1f} evals/s "
+            f"({per} - {100 * tol:.0f}%)")
+    if problems:
+        return _gate("nested", "fail", "; ".join(problems),
+                     dispatch_reduction=red,
+                     insertion_ks_sqrt_n=ir.get("ks_sqrt_n"))
+    return _gate(
+        "nested", "pass",
+        f"dispatch_reduction {red}x (floor {min_reduction}x), "
+        f"insertion-rank ks*sqrt(n)={ir.get('ks_sqrt_n')} "
+        f"(crit {ir.get('crit')}), blocked {blk} vs per-iteration "
+        f"{per} evals/s", dispatch_reduction=red,
+        insertion_ks_sqrt_n=ir.get("ks_sqrt_n"))
+
+
 def gate_staleness(series, stale_days, now=None):
     """The "device leg went stale unnoticed" alarm: the newest
     headline must be a device measurement young enough to trust."""
@@ -336,6 +397,10 @@ def main(argv=None):
                          "(default 5.0, the committed contract)")
     ap.add_argument("--min-bubble-red", type=float, default=2.0,
                     help="pipeline bubble-reduction floor (default 2)")
+    ap.add_argument("--min-nested-dispatch-red", type=float,
+                    default=10.0,
+                    help="nested blocked-dispatch amortization floor "
+                         "(default 10.0, the committed contract)")
     ap.add_argument("--max-host-fraction", type=float, default=0.5,
                     help="host_boundary_fraction cap (default 0.5)")
     ap.add_argument("--max-retraces", type=int, default=8,
@@ -361,6 +426,8 @@ def main(argv=None):
         gate_dispatch(opts.bench_dir, opts.min_dispatch_red),
         gate_bubble(opts.bench_dir, opts.min_bubble_red,
                     opts.max_host_fraction),
+        gate_nested(opts.bench_dir, opts.min_nested_dispatch_red,
+                    opts.tol),
         gate_staleness(series, opts.stale_days),
     ]
     if opts.run is not None:
@@ -378,6 +445,8 @@ def main(argv=None):
         "thresholds": {
             "tol": opts.tol,
             "min_dispatch_reduction": opts.min_dispatch_red,
+            "min_nested_dispatch_reduction":
+                opts.min_nested_dispatch_red,
             "min_bubble_reduction": opts.min_bubble_red,
             "max_host_fraction": opts.max_host_fraction,
             "max_retraces": opts.max_retraces,

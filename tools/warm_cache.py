@@ -63,10 +63,18 @@ def main():
                      and ncfg["gram_mode"] == cfg["gram_mode"]) \
         else build_problem(ncfg["gram_mode"])
     with tempfile.TemporaryDirectory() as d:
+        # the warmed scan must match the leg's FULL block geometry
+        # (kernel + block_iters change the compiled program): warm one
+        # full block, not a truncated one whose partial-size trace the
+        # leg would never reuse
         run_nested(nlike, outdir=d, nlive=ncfg["nlive"],
                    dlogz=ncfg["dlogz"], nsteps=ncfg["nsteps"],
                    kbatch=ncfg["kbatch"], seed=1, resume=False,
-                   verbose=False, max_iter=2, label="warm")
+                   kernel=ncfg.get("kernel"),
+                   block_iters=ncfg.get("block_iters"),
+                   verbose=False,
+                   max_iter=ncfg.get("block_iters") or 2,
+                   label="warm")
     # the vanilla device leg's block shape too (rebuilt when its baked
     # refine or gram mode differs from the pipeline build's)
     dcfg = LEGS["device"]
