@@ -986,6 +986,108 @@ def nested_bench():
     print(json.dumps(out))
 
 
+def mixing_ab():
+    """Streaming-vs-host-exact mixing-diagnostics A/B (``python
+    bench.py --mixing``; writes BENCH_MIXING.json).
+
+    The device diagnostics plane (``utils/devicemetrics.py``) streams
+    split-R-hat / moment-ESS from in-scan accumulators harvested at
+    the block-commit snapshot. This leg proves the two claims the
+    ``tools/sentinel.py`` ``mixing`` gate enforces, on the committed
+    MIXING.json analytic targets (banana / bimodal):
+
+    - **agreement**: the streaming figures match the host-exact
+      ``utils/diagnostics.py`` estimators (|drhat| cap; ESS ratio
+      band — batch-means vs Geyer are different estimators, the band
+      catches a broken fold, not estimator variance);
+    - **zero overhead**: an instrumented run performs EXACTLY the
+      same number of block dispatches and commit host-syncs as a bare
+      (``EWT_DEVICE_DIAG=0``) run of the same seed, and its chains
+      are bit-equal — the accumulators ride the existing block
+      program and the existing snapshot, adding no device traffic.
+    """
+    import tempfile
+
+    force_cpu()
+    # the leg MEASURES the diagnostics plane, so the plane must be on
+    # in the instrumented arm regardless of the caller's environment
+    # (the bare arm flips EWT_DEVICE_DIAG per run below)
+    os.environ["EWT_TELEMETRY"] = "1"
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from mixing_bench import banana_like, bimodal_like
+    from enterprise_warp_tpu.samplers import PTSampler
+    from enterprise_warp_tpu.utils.diagnostics import summarize_chains
+
+    # 250-step blocks: the streaming ledger folds 16 blocks (12 kept
+    # post-burn), enough batches for the batch-means ESS to resolve
+    NSAMP, BLOCK, BURN = 4000, 250, 0.25
+    out = {"metric": "mixing_stream_ab",
+           "unit": "|drhat| / ess ratio (CPU backend)",
+           "nsamp": NSAMP, "block_size": BLOCK, "burn_frac": BURN}
+
+    def run_arm(mk_like, seed, diag):
+        os.environ["EWT_DEVICE_DIAG"] = "1" if diag else "0"
+        try:
+            blocks = []
+            with tempfile.TemporaryDirectory() as d:
+                s = PTSampler(mk_like(), d, ntemps=4, nchains=8,
+                              seed=seed, cov_update=1000)
+                s.sample(NSAMP, resume=False, verbose=False,
+                         block_size=BLOCK, collect=blocks)
+            c = np.concatenate(blocks, axis=0)
+            return s, c
+        finally:
+            os.environ.pop("EWT_DEVICE_DIAG", None)
+
+    for name, mk_like, seed in (("banana", banana_like, 0),
+                                ("bimodal", bimodal_like, 1)):
+        s_on, c_on = run_arm(mk_like, seed, diag=True)
+        s_off, c_off = run_arm(mk_like, seed, diag=False)
+        keep = int(c_on.shape[0] * (1.0 - BURN))
+        chains = np.transpose(c_on[-keep:], (1, 0, 2)).astype(
+            np.float64)
+        exact = summarize_chains(
+            chains, s_on.like.param_names)["_worst"]
+        stream = s_on.diag_ledger.worst(BURN)
+        arm = {
+            "exact": {"rhat": exact["rhat"], "ess": exact["ess"]},
+            "stream": {"rhat": stream["rhat"], "ess": stream["ess"]},
+            "rhat_abs_diff": (
+                round(abs(stream["rhat"] - exact["rhat"]), 5)
+                if None not in (stream["rhat"], exact["rhat"])
+                else None),
+            "ess_ratio": (
+                round(stream["ess"] / exact["ess"], 4)
+                if stream["ess"] is not None
+                and exact["ess"] not in (None, 0.0) else None),
+            "ess_per_step": (round(exact["ess"] / NSAMP, 4)
+                             if exact["ess"] is not None else None),
+            # the zero-overhead proof: identical dispatch/commit-sync
+            # counts with the plane on vs off, and bit-equal chains
+            "dispatches": {"diag_on": s_on.n_dispatch,
+                           "diag_off": s_off.n_dispatch},
+            "host_syncs": {"diag_on": s_on.n_sync,
+                           "diag_off": s_off.n_sync},
+            "added_dispatches": s_on.n_dispatch - s_off.n_dispatch,
+            "added_host_syncs": s_on.n_sync - s_off.n_sync,
+            "chains_bit_equal": bool(np.array_equal(c_on, c_off)),
+        }
+        out[name] = arm
+        print(f"# {name}: |drhat|={arm['rhat_abs_diff']} "
+              f"ess_ratio={arm['ess_ratio']} "
+              f"added_dispatches={arm['added_dispatches']} "
+              f"added_syncs={arm['added_host_syncs']} "
+              f"bit_equal={arm['chains_bit_equal']}", file=sys.stderr)
+
+    out["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    from enterprise_warp_tpu.io.writers import atomic_write_json
+    atomic_write_json(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_MIXING.json"), out)
+    print(json.dumps(out))
+
+
 def config_benches():
     """Per-config throughput for every BASELINE.json config (run with
     ``python bench.py --configs``; writes CONFIGS_BENCH.json). Kept out
@@ -1138,6 +1240,7 @@ if __name__ == "__main__":
     micro_mode = "--micro" in sys.argv
     pipeline_mode = "--pipeline" in sys.argv
     nested_mode = "--nested" in sys.argv
+    mixing_mode = "--mixing" in sys.argv
     try:
         if configs_mode:
             config_benches()
@@ -1147,6 +1250,8 @@ if __name__ == "__main__":
             pipeline_bench()
         elif nested_mode:
             nested_bench()
+        elif mixing_mode:
+            mixing_ab()
         else:
             main()
     except Exception as e:                              # noqa: BLE001
@@ -1171,6 +1276,12 @@ if __name__ == "__main__":
             print(json.dumps({"metric": "nested_blocked_ab",
                               "unit": "evals/s (CPU backend)",
                               "dispatch_reduction": None,
+                              "error": f"{type(e).__name__}: {e}"}))
+            sys.exit(1)
+        if mixing_mode:
+            print(json.dumps({"metric": "mixing_stream_ab",
+                              "unit": "|drhat| / ess ratio "
+                                      "(CPU backend)",
                               "error": f"{type(e).__name__}: {e}"}))
             sys.exit(1)
         if configs_mode:

@@ -182,6 +182,21 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
     last block rather than the whole run. Assumes the driver samples
     unthinned (this function always does).
 
+    **Streaming gate** (device diagnostics plane,
+    ``utils/devicemetrics.py``): when the driven sampler carries a
+    fresh streaming ledger (``sampler.diag_ledger`` covering exactly
+    the sampled steps — cumulative across resumes via the checkpoint),
+    each negative check reads the streaming split-R-hat / moment-ESS
+    instead of folding the in-memory chains — the O(steps) concat +
+    Geyer pass that used to COST MORE THAN THE SAMPLING on long device
+    runs is skipped while the gate obviously fails. A streaming PASS
+    is always CONFIRMED with the host-exact estimators before the
+    function returns converged (the batch-means ESS can over-read
+    while batches are shorter than the autocorrelation time — see
+    docs/observability.md), so the gate's verdict is exactly as honest
+    as before; only the cadence of the expensive exact folds changes.
+    ``EWT_STREAMING_DIAG=0`` restores exact checks everywhere.
+
     Returns a :class:`ConvergenceReport`. Wall-clock covers the sampling
     loop only (the likelihood build happens before this call); the first
     block includes jit compilation, so ``steady_wall_s`` is the honest
@@ -222,6 +237,37 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
                               "checkpoint counter", nsteps, ckpt_step)
                     z = dict(np.load(sampler._ckpt_path))
                     z["step"] = nsteps
+                    # the streaming-diagnostics ledger (diag_* keys,
+                    # utils/devicemetrics.py) covers ckpt_step steps;
+                    # left as-is it would double-fold the re-sampled
+                    # window AND break the gate's freshness check
+                    # (total_steps > steps forever). Truncate trailing
+                    # ledger blocks back to nsteps when they align on
+                    # a block boundary; otherwise drop the ledger —
+                    # the streaming gate then simply falls back to
+                    # exact checks, which is honest.
+                    if "diag_counts" in z:
+                        # ewt: allow-host-sync — checkpoint repair:
+                        # wraps an npz host array, never a device leaf
+                        counts = np.asarray(z["diag_counts"])
+                        cum = np.cumsum(counts)
+                        keep = int(np.searchsorted(cum, nsteps,
+                                                   side="left")) + 1
+                        aligned = keep <= len(counts) \
+                            and cum[keep - 1] == nsteps
+                        for k in list(z):
+                            if not k.startswith("diag_"):
+                                continue
+                            if aligned and k in (
+                                    "diag_counts", "diag_mean",
+                                    "diag_m2", "diag_min",
+                                    "diag_max"):
+                                z[k] = z[k][:keep]
+                            else:
+                                # the cumulative histogram / family
+                                # matrices have no per-block
+                                # granularity to truncate — drop them
+                                del z[k]
                     tmp = sampler._ckpt_path + ".tmp.npz"
                     np.savez(tmp, **z)
                     os.replace(tmp, sampler._ckpt_path)
@@ -280,6 +326,7 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
     t_start = monotonic()
     t_after_first = None
     report = None
+    use_stream = os.environ.get("EWT_STREAMING_DIAG", "1") != "0"
     # the run-level scope: the inner sampler.sample() calls join this
     # event stream (block heartbeats), and each convergence check adds
     # a heartbeat carrying the gate diagnostics it already computed
@@ -300,11 +347,48 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
             if t_after_first is None:
                 t_after_first = monotonic()
             steps = min(steps + todo, max_steps)
+
+            # streaming gate: when the sampler's ledger is FRESH
+            # (covers exactly the sampled steps), read the streaming
+            # worst figures first — if they already fail the gate,
+            # skip the exact O(steps) chain fold entirely; a streaming
+            # pass falls through to the exact confirmation below
+            led = getattr(sampler, "diag_ledger", None) \
+                if use_stream else None
+            stream = (led.worst(burn_frac)
+                      if led is not None and len(led)
+                      and led.total_steps == steps else None)
+            # skip only on a DEFINITE streaming failure (both figures
+            # present and at least one failing); an estimate the short
+            # ledger cannot produce yet falls through to exact
+            if stream is not None and stream["rhat"] is not None \
+                    and stream["ess"] is not None \
+                    and (stream["rhat"] > rhat_max
+                         or stream["ess"] < target_ess):
+                rh, es = stream["rhat"], stream["ess"]
+                rec.heartbeat(phase="convergence_check",
+                              step=int(steps), diag_mode="stream",
+                              rhat=stream["rhat"], ess=stream["ess"],
+                              wall_s=round(monotonic() - t_start, 2),
+                              bubble_s=round(getattr(
+                                  sampler, "bubble_total_s", 0.0), 3),
+                              host_sync_s=round(getattr(
+                                  sampler, "host_sync_total_s", 0.0),
+                                  3))
+                if verbose:
+                    _log.info("step %d: rhat_max=%.4f ess_min=%.0f "
+                              "(streaming)", steps, rh, es)
+                if on_check is not None:
+                    on_check(steps, monotonic() - t_start,
+                             monotonic() - t_after_first)
+                continue
+
             with span("convergence.check", step=steps):
                 chains = _chains_from_blocks(blocks, burn_frac)
                 s = _diag(chains)
             rh, es = _worst_floats(s)
             rec.heartbeat(phase="convergence_check", step=int(steps),
+                          diag_mode="exact",
                           rhat=s["_worst"]["rhat"],
                           ess=s["_worst"]["ess"],
                           wall_s=round(monotonic() - t_start, 2),

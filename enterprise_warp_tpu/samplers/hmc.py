@@ -47,7 +47,7 @@ from ..resilience import faults
 from ..resilience.supervisor import (BlockSupervisor, PlatformDemotion,
                                      apply_demotion,
                                      preemption_requested)
-from ..utils import profiling, telemetry
+from ..utils import devicemetrics, profiling, telemetry
 from ..utils.flightrec import flight_recorder
 from ..utils.logging import EvalRateMeter, get_logger
 from ..utils.profiling import monotonic, span
@@ -158,6 +158,15 @@ class HMCSampler:
         # retry + circuit-breaker demotion on the block dispatch; a
         # direct inline call when unarmed (the default)
         self._supervisor = BlockSupervisor("hmc.dispatch")
+        # device diagnostics plane (utils/devicemetrics.py): in-scan
+        # leapfrog-energy-error and step-size accumulators (harvested
+        # at the existing block sync) plus the host-side streaming
+        # moment ledger over the emitted theta chains — HMC's chain
+        # emission crosses to host every block anyway, so the ledger
+        # uses the host twin of the accumulator contract
+        self.diag_ledger = (
+            devicemetrics.MomentLedger(nchains, self.ndim)
+            if devicemetrics.enabled() else None)
         os.makedirs(outdir, exist_ok=True)
 
     # ---------------- init / checkpoint -------------------------------- #
@@ -209,17 +218,29 @@ class HMCSampler:
         if not _is_primary():
             return
         tmp = self._ckpt_path + ".tmp.npz"
+        # diagnostics-plane continuity (devicemetrics): the streaming
+        # ledger rides the checkpoint so post-resume streaming R-hat
+        # continues from the committed statistics
+        diag = {}
+        if self.diag_ledger is not None and len(self.diag_ledger):
+            diag = {f"diag_{k}": v for k, v in
+                    self.diag_ledger.state_dict().items()}
         np.savez(tmp, z=st.z, key=st.key, log_eps=st.log_eps,
                  log_eps_bar=st.log_eps_bar, h_bar=st.h_bar,
                  mass=st.mass, step=st.step, accepted=st.accepted,
                  divergences=st.divergences, mu=st.mu,
-                 da_iter=st.da_iter, ngrad=st.ngrad)
+                 da_iter=st.da_iter, ngrad=st.ngrad, **diag)
         durable_replace(tmp, self._ckpt_path)
         # kill-after-durable-checkpoint injection boundary (resilience)
         faults.fire("hmc.ckpt", path=self._ckpt_path, step=int(st.step))
 
     def _load_state(self):
         z = np.load(self._ckpt_path)
+        if self.diag_ledger is not None and "diag_counts" in z.files:
+            self.diag_ledger = devicemetrics.MomentLedger.from_state(
+                self.W, self.ndim,
+                {k: z[f"diag_{k}"] for k in
+                 ("counts", "mean", "m2", "min", "max")})
         return HMCState(z=z["z"], key=z["key"],
                         log_eps=float(z["log_eps"]),
                         log_eps_bar=float(z["log_eps_bar"]),
@@ -246,6 +267,14 @@ class HMCSampler:
 
         jitter_L = self.jitter_L
         l_min = max(1, n_leap // 2)
+        # device diagnostics plane: leapfrog-energy-error (the MH
+        # log-ratio magnitude over finite trajectories) and step-size
+        # extrema, accumulated in-scan in fixed-shape scalars and
+        # harvested at the existing block sync. Off, the carry slot is
+        # an empty pytree — bit-identical block program. (Unlike the
+        # PT sampler, no harvest flag is stored: the block returns
+        # dstate directly and the commit reads its truthiness.)
+        emit_diag = devicemetrics.enabled()
 
         # ewt: allow-precision — dual-averaging step-size adaptation:
         # the h_bar/log-eps running means accumulate O(1/t) terms over
@@ -253,7 +282,7 @@ class HMCSampler:
         # f64-island list)
         def one_step(carry, t_glob):
             (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar, mass, acc,
-             ndiv, mu, ngrad, consts) = carry
+             ndiv, mu, ngrad, consts, dstate) = carry
             key, kp, ke, ka, kl = jax.random.split(key, 5)
 
             eps = jnp.exp(log_eps)
@@ -317,21 +346,45 @@ class HMCSampler:
                 w = t ** (-kappa)
                 log_eps_bar = w * log_eps + (1.0 - w) * log_eps_bar
 
+            if emit_diag:
+                # energy-error accumulators over trajectories with a
+                # finite MH log-ratio (an -inf endpoint is a prior-
+                # corner rejection, not an integrator error), plus the
+                # post-adaptation step-size extrema of the block
+                e_n, e_sum, e_sq, e_max, le_min, le_max = dstate
+                fin = jnp.isfinite(log_ratio)
+                dh = jnp.where(fin, -log_ratio, 0.0)
+                e_n = e_n + jnp.sum(fin)
+                e_sum = e_sum + jnp.sum(dh)
+                e_sq = e_sq + jnp.sum(dh * dh)
+                e_max = jnp.maximum(
+                    e_max, jnp.max(jnp.where(fin, jnp.abs(dh), 0.0)))
+                le_min = jnp.minimum(le_min, log_eps)
+                le_max = jnp.maximum(le_max, log_eps)
+                dstate = (e_n, e_sum, e_sq, e_max, le_min, le_max)
+
             return (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar,
-                    mass, acc, ndiv, mu, ngrad, consts), (z, lnl, p_acc)
+                    mass, acc, ndiv, mu, ngrad, consts,
+                    dstate), (z, lnl, p_acc)
 
         def block(z, key, log_eps, log_eps_bar, h_bar, mass, acc, ndiv,
                   iter0, mu, ngrad, consts):
             (lp, lnl), g = vgrad(z, consts)
             ngrad = ngrad + 1          # the block-entry gradient
+            if emit_diag:
+                dstate0 = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()),
+                           jnp.zeros(()), jnp.full((), jnp.inf),
+                           jnp.full((), -jnp.inf))
+            else:
+                dstate0 = ()
             carry = (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar,
-                     mass, acc, ndiv, mu, ngrad, consts)
+                     mass, acc, ndiv, mu, ngrad, consts, dstate0)
             carry, (zs, lnls, p_accs) = jax.lax.scan(
                 one_step, carry, iter0 + jnp.arange(nsteps))
             (z, lp, lnl, g, key, log_eps, log_eps_bar, h_bar, mass, acc,
-             ndiv, mu, ngrad, consts) = carry
+             ndiv, mu, ngrad, consts, dstate) = carry
             return (z, key, log_eps, log_eps_bar, h_bar, acc, ndiv, zs,
-                    lnls, jnp.mean(p_accs), ngrad)
+                    lnls, jnp.mean(p_accs), ngrad, dstate)
 
         # traced jit: each (block size, adapt) pair is a separate trace;
         # the telemetry makes that retrace pattern visible per run.
@@ -405,6 +458,12 @@ class HMCSampler:
                     os.replace(tmp, chain_path0)
         else:
             st = self._fresh_state()
+            # fresh run on a reused instance: the streaming ledger
+            # must not carry a previous sample() call's statistics
+            # (mirrors PTSampler._reset_diag)
+            if self.diag_ledger is not None:
+                self.diag_ledger = devicemetrics.MomentLedger(
+                    self.W, self.ndim)
             if _is_primary():
                 open(os.path.join(self.outdir, "chain_1.txt"),
                      "w").close()
@@ -453,7 +512,7 @@ class HMCSampler:
                 # is safe; hangs and exhausted retries demote through
                 # the checkpoint/resume path
                 (z, key, log_eps, log_eps_bar, h_bar, acc, ndiv, zs,
-                 lnls, mean_acc, ngrad) = self._supervisor.call(
+                 lnls, mean_acc, ngrad, dstate) = self._supervisor.call(
                     lambda: blocks[bkey](
                         self._place(st.z), self._place(st.key),
                         st.log_eps, st.log_eps_bar, st.h_bar,
@@ -489,6 +548,25 @@ class HMCSampler:
             if adapt:
                 st.da_iter += todo
             mean_acc = float(mean_acc)
+            # diagnostics-plane harvest at the SAME sync the scalar
+            # conversions above already forced — no extra round-trip
+            diag_hb = {}
+            if dstate:
+                e_n = float(dstate[0])
+                if e_n > 0:
+                    e_mean = float(dstate[1]) / e_n
+                    diag_hb["energy_err_mean"] = round(e_mean, 6)
+                    diag_hb["energy_err_std"] = round(float(np.sqrt(
+                        max(float(dstate[2]) / e_n - e_mean ** 2,
+                            0.0))), 6)
+                    diag_hb["energy_err_max"] = round(
+                        float(dstate[3]), 4)
+                le_min, le_max = float(dstate[4]), float(dstate[5])
+                if np.isfinite(le_min):
+                    diag_hb["eps_min"] = round(float(np.exp(le_min)),
+                                               6)
+                    diag_hb["eps_max"] = round(float(np.exp(le_max)),
+                                               6)
             # the scalar conversions above forced the host sync — the
             # device is idle from here until the next block dispatch
             self._last_sync_s = monotonic() - t_sync0
@@ -571,6 +649,12 @@ class HMCSampler:
             if collect is not None:
                 collect.append(thetas.reshape(todo, self.W, self.ndim)
                                .astype(np.float32))
+            if self.diag_ledger is not None:
+                # streaming moment ledger over the theta chains (the
+                # host twin of the in-scan contract — this emission is
+                # already on the host for the chain files)
+                self.diag_ledger.append_samples(
+                    thetas.reshape(todo, self.W, self.ndim))
             self._save_state(st)
             rec.checkpoint(step=int(st.step))
 
@@ -591,6 +675,19 @@ class HMCSampler:
                           host_sync_wall_s=round(self._last_sync_s, 4),
                           block_bubble_s=round(self._last_bubble_s, 4),
                           warmup=bool(adapt))
+                hb.update(diag_hb)
+                if self.diag_ledger is not None:
+                    worst_stream = self.diag_ledger.worst()
+                    if worst_stream is not None:
+                        hb["rhat_stream"] = worst_stream["rhat"]
+                        hb["ess_stream"] = worst_stream["ess"]
+                        reg = telemetry.registry()
+                        if worst_stream["rhat"] is not None:
+                            reg.gauge("stream_rhat").set(
+                                worst_stream["rhat"])
+                        if worst_stream["ess"] is not None:
+                            reg.gauge("stream_ess").set(
+                                worst_stream["ess"])
                 mem = profiling.memory_watermark()
                 if mem is not None:
                     hb.update(mem)

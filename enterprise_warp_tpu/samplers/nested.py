@@ -54,7 +54,7 @@ from ..resilience import faults
 from ..resilience.supervisor import (BlockSupervisor, PlatformDemotion,
                                      apply_demotion,
                                      preemption_requested)
-from ..utils import profiling, telemetry
+from ..utils import devicemetrics, profiling, telemetry
 from ..utils.flightrec import flight_recorder
 from ..utils.logging import EvalRateMeter, get_logger
 from ..utils.profiling import monotonic, span
@@ -119,10 +119,14 @@ def _make_iteration(like, nlive, kbatch, nsteps, slide_moves=None,
     ``extras=True`` (the blocked scan body) additionally adapts the
     walk scale on device and returns
     ``(u, lnl, key, scale, lnz, ln_x, dead_u, dead_lnl, acc, delta,
-    ranks, lnx0)`` where ``ranks`` is the insertion-rank diagnostic
-    (each replacement's rank among the surviving live points — uniform
-    when the constrained kernel truly samples the prior above L*) and
-    ``lnx0`` the iteration-entry ln X for the host-side ledger fold.
+    ranks, lnx0, first)`` where ``ranks`` is the insertion-rank
+    diagnostic (each replacement's rank among the surviving live
+    points — uniform when the constrained kernel truly samples the
+    prior above L*), ``lnx0`` the iteration-entry ln X for the
+    host-side ledger fold, and ``first`` the kernel's first-draw
+    acceptance rate (slice kernel: the bracket-vs-slice size signal;
+    with ``acc`` = completed-update rate it yields the shrink-budget
+    exhaustion diagnostic the device diagnostics plane emits).
     """
     from .evalproto import eval_protocol
     batch_eval, _, _ = eval_protocol(like)
@@ -445,7 +449,7 @@ def _make_iteration(like, nlive, kbatch, nsteps, slide_moves=None,
                                         scale))
             scale = jnp.clip(scale, 1e-3, 10.0)
         return (u, lnl, key, scale, lnz, ln_x,
-                dead_u, dead_lnl, acc, delta, ranks, lnx0)
+                dead_u, dead_lnl, acc, delta, ranks, lnx0, first)
 
     return iteration
 
@@ -469,7 +473,8 @@ def _make_refill(like, nlive, kbatch, nsteps, slide_moves=None):
 
 
 def _make_block(like, nlive, kbatch, nsteps, block_iters,
-                slide_moves=None, kernel="slice", device_state=True):
+                slide_moves=None, kernel="slice", device_state=True,
+                diag=False):
     """The blocked dispatch: ``block_iters`` NS iterations folded into
     one ``lax.scan`` jit. The whole live-point state — walkers, lnl,
     RNG key, walk scale, evidence accumulator ``(lnz, ln_x)`` — is the
@@ -477,7 +482,14 @@ def _make_block(like, nlive, kbatch, nsteps, block_iters,
     update; ``devicestate.place_resident`` guarantees XLA-owned
     buffers); the stacked per-iteration outputs are the preallocated
     on-device ``(block_iters, kbatch)`` dead-point ring plus the
-    accept/delta/rank/lnx traces the commit folds on the host."""
+    accept/delta/rank/lnx traces the commit folds on the host.
+
+    ``diag`` (the device diagnostics plane, utils/devicemetrics.py)
+    additionally stacks the per-iteration walk-scale and first-draw-
+    acceptance traces — values the scan already carries, emitted as
+    two extra trace outputs and harvested at the same commit snapshot
+    (zero extra dispatches/syncs; off, the outputs do not exist and
+    the block program is unchanged)."""
     it_fn = _make_iteration(like, nlive, kbatch, nsteps,
                             slide_moves=slide_moves, kernel=kernel,
                             extras=True)
@@ -486,10 +498,12 @@ def _make_block(like, nlive, kbatch, nsteps, block_iters,
         def body(carry, _):
             u, lnl, key, scale, lnz, ln_x = carry
             (u, lnl, key, scale, lnz, ln_x,
-             du, dl, acc, delta, ranks, lnx0) = it_fn(
+             du, dl, acc, delta, ranks, lnx0, first) = it_fn(
                 u, lnl, key, scale, lnz, ln_x, consts)
-            return ((u, lnl, key, scale, lnz, ln_x),
-                    (du, dl, acc, delta, ranks, lnx0))
+            ys = (du, dl, acc, delta, ranks, lnx0)
+            if diag:
+                ys = ys + (scale, first)
+            return ((u, lnl, key, scale, lnz, ln_x), ys)
         # named for jax.profiler captures (EWT_PROFILE_CAPTURE): the
         # whole block shows up as one legible region
         with jax.named_scope("nested_block"):
@@ -883,6 +897,10 @@ def _run_nested_blocked(like, outdir, nlive, dlogz, nsteps, kbatch,
     nd = like.ndim
     kbatch = kbatch or max(1, nlive // 5)
     device_state = os.environ.get("EWT_DEVICE_STATE", "1") != "0"
+    # device diagnostics plane: per-iteration walk-scale and first-
+    # draw traces ride the block's stacked outputs (zero extra
+    # dispatches/syncs; see _make_block)
+    diag_on = devicemetrics.enabled()
 
     from ..parallel.distributed import is_primary
     from .devicestate import (HostPipeline, host_snapshot,
@@ -976,7 +994,7 @@ def _run_nested_blocked(like, outdir, nlive, dlogz, nsteps, kbatch,
             blocks[todo] = _make_block(
                 like, nlive, kbatch, nsteps, todo,
                 slide_moves=slide_moves, kernel=kernel,
-                device_state=device_state)
+                device_state=device_state, diag=diag_on)
         return blocks[todo]
 
     def _write_ckpt_payload(state, n_led, it_now, nd_now=0, ns_now=0,
@@ -1088,6 +1106,11 @@ def _run_nested_blocked(like, outdir, nlive, dlogz, nsteps, kbatch,
                     lnz=out[4], ln_x=out[5], dead_u=out[6],
                     dead_lnl=out[7], acc=out[8], delta=out[9],
                     ranks=out[10], lnx0=out[11])
+                if diag_on:
+                    # the diagnostics-plane traces ride the SAME
+                    # commit snapshot — no extra sync
+                    leaves["scale_tr"] = out[12]
+                    leaves["first_tr"] = out[13]
                 with span("ns.commit", it=it, iters=todo):
                     # the commit sync is where a dead relay manifests
                     # (the dispatch above is async) — supervised, but
@@ -1137,6 +1160,27 @@ def _run_nested_blocked(like, outdir, nlive, dlogz, nsteps, kbatch,
 
                 deltas = np.asarray(snap["delta"])
                 accs = np.asarray(snap["acc"])
+                diag_hb = {}
+                if diag_on:
+                    # walk-scale trajectory + shrink-budget telemetry
+                    # from the harvested traces (host math on the
+                    # committed snapshot; slice kernel: ``acc`` is the
+                    # completed-update rate, so 1 - acc is the
+                    # shrink-budget exhaustion fraction)
+                    sc_tr = np.asarray(snap["scale_tr"])
+                    fi_tr = np.asarray(snap["first_tr"])
+                    diag_hb["scale_min"] = round(float(sc_tr.min()), 4)
+                    diag_hb["scale_max"] = round(float(sc_tr.max()), 4)
+                    if kernel == "slice":
+                        diag_hb["budget_exhaust_frac"] = round(
+                            float(np.mean(1.0 - accs)), 4)
+                        diag_hb["first_accept_frac"] = round(
+                            float(fi_tr.mean()), 4)
+                    reg = telemetry.registry()
+                    reg.gauge("walk_scale").set(float(sc_tr[-1]))
+                    if kernel == "slice":
+                        reg.gauge("budget_exhaust_frac").set(
+                            diag_hb["budget_exhaust_frac"])
                 lnz = float(snap["lnz"])
                 ln_x = float(snap["ln_x"])
                 scale = float(snap["scale"])
@@ -1187,7 +1231,8 @@ def _run_nested_blocked(like, outdir, nlive, dlogz, nsteps, kbatch,
                                delta_last=delta_last,
                                acc_last=acc_last, lnz=lnz,
                                scale=scale, bubble_s=last_bubble_s,
-                               nd_now=n_dispatch, ns_now=n_sync):
+                               nd_now=n_dispatch, ns_now=n_sync,
+                               diag_hb=diag_hb):
                     with span("ns.host_work", it=it_now):
                         if due_ckpt:
                             state = dict(u=snap["u"], lnl=snap["lnl"],
@@ -1210,8 +1255,11 @@ def _run_nested_blocked(like, outdir, nlive, dlogz, nsteps, kbatch,
                                   evals_total=int(meter.total),
                                   host_sync_wall_s=round(sync_s, 4),
                                   block_bubble_s=round(bubble_s, 4))
+                        hb.update(diag_hb)
                         if ks is not None:
                             hb["insertion_ks"] = round(ks, 4)
+                            telemetry.registry().gauge(
+                                "insertion_ks").set(float(ks))
                         mem = profiling.memory_watermark()
                         if mem is not None:
                             hb.update(mem)

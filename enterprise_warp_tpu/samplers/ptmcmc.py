@@ -45,7 +45,7 @@ from ..resilience import faults
 from ..resilience.supervisor import (BlockSupervisor, PlatformDemotion,
                                      apply_demotion,
                                      preemption_requested)
-from ..utils import profiling, telemetry
+from ..utils import devicemetrics, profiling, telemetry
 from ..utils.flightrec import flight_recorder
 from ..utils.logging import EvalRateMeter, get_logger
 from ..utils.profiling import monotonic, span
@@ -53,6 +53,12 @@ from ..utils.profiling import monotonic, span
 _log = get_logger("ewt.ptmcmc")
 
 _HISTORY = 1000     # DE history ring length (per walker)
+
+#: the proposal-family order of every per-family counter in this
+#: module (jump_probs, fam_accept/fam_propose, the per-rung
+#: attribution matrices, and the mixing telemetry they feed)
+_FAM_NAMES = ("scam", "am", "de", "pd", "ind", "cg", "kde", "ns")
+_NFAM = len(_FAM_NAMES)
 
 
 @dataclass
@@ -261,6 +267,29 @@ class PTSampler:
         self.use_maskstats = getattr(like, "param_blocks", None) \
             is not None
         self.mask_counts = np.zeros(3)
+        # device diagnostics plane (utils/devicemetrics.py): fixed-
+        # shape in-scan accumulators over the cold rung (Welford
+        # moments, extrema, fixed-bin histograms) plus per-rung
+        # per-family proposal attribution, harvested once per block
+        # at the existing commit snapshot; the host-side ledger
+        # streams split-R-hat / moment-ESS at block cadence. Master-
+        # gated by EWT_TELEMETRY, plane-gated by EWT_DEVICE_DIAG —
+        # off, the carry slot is an empty pytree and the block
+        # program is bit-identical.
+        self.diag_ledger = (
+            devicemetrics.MomentLedger(nchains, self.ndim)
+            if devicemetrics.enabled() else None)
+        self._hist_lo, self._hist_span = devicemetrics.hist_bounds(
+            like.params)
+        self.diag_hist = np.zeros((self.ndim,
+                                   devicemetrics.DEFAULT_NBINS))
+        self.fam_rung_accept = np.zeros((ntemps, _NFAM))
+        self.fam_rung_propose = np.zeros((ntemps, _NFAM))
+        # per-block dispatch/commit-sync counters: the zero-overhead
+        # proof surface for the diagnostics plane (bench.py --mixing
+        # records them in an instrumented-vs-bare A/B)
+        self.n_dispatch = 0
+        self.n_sync = 0
         # supervised execution (resilience/supervisor.py): every device
         # block and commit-side sync routes through this wrapper —
         # watchdog, bounded retry, circuit-breaker demotion. With the
@@ -367,6 +396,30 @@ class PTSampler:
             sprop = np.zeros(self.ntemps - 1)
         ladder = (np.asarray(z["ladder"]) if "ladder" in z.files
                   else self.init_ladder.copy())
+        # diagnostics-plane resume: restore the streaming accumulator
+        # state checkpointed alongside the sampler state, so post-
+        # resume streaming R-hat continues from the committed
+        # statistics instead of restarting from empty (the ledger
+        # mirror of the EvalRateMeter evals_total seeding)
+        if self.diag_ledger is not None and "diag_counts" in z.files:
+            self.diag_ledger = devicemetrics.MomentLedger.from_state(
+                self.nchains, self.ndim,
+                {k: z[f"diag_{k}"] for k in
+                 ("counts", "mean", "m2", "min", "max")})
+            # the cumulative hist/family matrices may be absent (a
+            # resume-rewind drops them — convergence.py) or from a
+            # different geometry: restore only matching shapes
+            if "diag_hist" in z.files \
+                    and z["diag_hist"].shape == self.diag_hist.shape:
+                self.diag_hist = np.asarray(z["diag_hist"],
+                                            dtype=float)
+            if "diag_fam_acc" in z.files and \
+                    z["diag_fam_acc"].shape \
+                    == self.fam_rung_accept.shape:
+                self.fam_rung_accept = np.asarray(z["diag_fam_acc"],
+                                                  dtype=float)
+                self.fam_rung_propose = np.asarray(
+                    z["diag_fam_prop"], dtype=float)
         return PTState(x=z["x"], lnl=z["lnl"], lnp=z["lnp"], key=z["key"],
                        cov=z["cov"], history=z["history"],
                        hist_len=int(z["hist_len"]), step=int(z["step"]),
@@ -417,6 +470,18 @@ class PTSampler:
         # the block program is bit-identical to the uninstrumented one.
         emit_nf = telemetry.enabled()
         self._nf_emitted = emit_nf
+        # device diagnostics plane (utils/devicemetrics.py): in-scan
+        # accumulators — zero-initialized INSIDE the jit (no upload),
+        # fixed shapes in the scan carry (no retrace), harvested at
+        # the commit snapshot (no extra sync). When off, the carry
+        # slot is an empty tuple: zero leaves, and the lowered block
+        # program is bit-identical to the uninstrumented one.
+        emit_diag = devicemetrics.enabled()
+        self._diag_emitted = emit_diag
+        if emit_diag:
+            hist_lo = jnp.asarray(self._hist_lo)
+            hist_span = jnp.asarray(self._hist_span)
+            rung_idx = jnp.arange(W) // nchains
         use_ind = bool(self.jump_probs[4] > 0)
         use_cg = bool(self.jump_probs[5] > 0)
         use_kde = bool(self.jump_probs[6] > 0)
@@ -454,7 +519,8 @@ class PTSampler:
             x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop, \
                 fam_acc, fam_prop, mask_counts, \
                 eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL, \
-                lam, cg_rows, kde_pts, kde_bw, temps, consts = carry
+                lam, cg_rows, kde_pts, kde_bw, temps, consts, \
+                dstate = carry
             key, k1, k2, k3, k4, k5, k6, k7, k8, k9, k10, k11 = \
                 jax.random.split(key, 12)
 
@@ -737,6 +803,26 @@ class PTSampler:
                     (step_idx % swap_every) == swap_every - 1,
                     do_swap, lambda a: a, (x, lnl, lnp, key, sacc, sprop))
 
+            # --- diagnostics-plane accumulators (post-swap, so the
+            # moments describe exactly the emitted cold chain) -------
+            if emit_diag:
+                (dn, dmean, dm2, dmn, dmx, dhist,
+                 dfam_a, dfam_p) = dstate
+                cx = x[:nchains]
+                dn, dmean, dm2 = devicemetrics.welford_add(
+                    (dn, dmean, dm2), cx)
+                dmn = jnp.minimum(dmn, cx)
+                dmx = jnp.maximum(dmx, cx)
+                dhist = devicemetrics.hist_add(dhist, cx, hist_lo,
+                                               hist_span)
+                # per-rung per-family proposal attribution: which
+                # family proposed on which rung, and what it accepted
+                dfam_p = dfam_p.at[rung_idx, choice].add(1.0)
+                dfam_a = dfam_a.at[rung_idx, choice].add(
+                    accept.astype(dfam_p.dtype))
+                dstate = (dn, dmean, dm2, dmn, dmx, dhist,
+                          dfam_a, dfam_p)
+
             # --- DE history ring: store one cold walker per step ------
             slot = (hist_len + step_idx) % _HISTORY
             pick = step_idx % nchains
@@ -754,16 +840,26 @@ class PTSampler:
             return ((x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                      fam_acc, fam_prop, mask_counts,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
-                     lam, cg_rows, kde_pts, kde_bw, temps, consts), ys)
+                     lam, cg_rows, kde_pts, kde_bw, temps, consts,
+                     dstate), ys)
 
         def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                   fam_acc, fam_prop, mask_counts,
                   eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
                   lam, cg_rows, kde_pts, kde_bw, temps, consts):
+            if emit_diag:
+                dstate0 = (devicemetrics.welford_init((nchains, nd))
+                           + devicemetrics.minmax_init((nchains, nd))
+                           + (devicemetrics.hist_init(nd),
+                              jnp.zeros((ntemps, _NFAM)),
+                              jnp.zeros((ntemps, _NFAM))))
+            else:
+                dstate0 = ()
             carry = (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                      fam_acc, fam_prop, mask_counts,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
-                     lam, cg_rows, kde_pts, kde_bw, temps, consts)
+                     lam, cg_rows, kde_pts, kde_bw, temps, consts,
+                     dstate0)
             # named for jax.profiler captures (EWT_PROFILE_CAPTURE):
             # the whole K-step scan shows up as one legible region
             with jax.named_scope("ptmcmc_block"):
@@ -939,6 +1035,7 @@ class PTSampler:
                     ind_iL, lam, cg_rows, kde_pts, kde_bw, temps_in,
                     self._consts),
                 step=int(st.step), block_steps=int(todo))
+        self.n_dispatch += 1
         # block-boundary bubble: host wall between the previous block's
         # results landing (device went idle) and this dispatch handing
         # the device new work
@@ -981,6 +1078,17 @@ class PTSampler:
             cold_lnp=cold_lnp)
         if nf_steps is not None:
             leaves["nf_steps"] = nf_steps
+        # diagnostics-plane harvest rides the SAME commit snapshot —
+        # the one designed sync per block, so the plane adds zero host
+        # round-trips (the BENCH_MIXING zero-overhead contract)
+        dstate = carry[-1] if getattr(self, "_diag_emitted", False) \
+            else ()
+        if dstate:
+            leaves.update(
+                diag_n=dstate[0], diag_mean=dstate[1],
+                diag_m2=dstate[2], diag_min=dstate[3],
+                diag_max=dstate[4], diag_hist=dstate[5],
+                diag_fam_a=dstate[6], diag_fam_p=dstate[7])
         with span("pt.commit", steps=todo):
             # the commit sync is where a dead relay actually manifests
             # (the dispatch above is async) — watchdog-supervised, but
@@ -990,6 +1098,7 @@ class PTSampler:
             snap = self._supervisor.call(
                 lambda: host_snapshot(leaves), retryable=False,
                 site="pt.commit", step=int(st.step))
+        self.n_sync += 1
         spec = faults.fire("pt.nonfinite", step=int(st.step))
         if spec is not None and spec.kind == "nonfinite":
             # poison the committed snapshot: exercises the counted
@@ -1023,6 +1132,16 @@ class PTSampler:
         self.fam_accept = snap["fam_accept"]
         self.fam_propose = snap["fam_propose"]
         self.mask_counts = snap["mask_counts"]
+        if dstate and self.diag_ledger is not None:
+            # cumulative host-side fold of the block-local device
+            # accumulators: the streaming-diagnostics ledger plus the
+            # run-cumulative histogram and per-rung family attribution
+            self.diag_ledger.append_block(
+                snap["diag_n"], snap["diag_mean"], snap["diag_m2"],
+                snap["diag_min"], snap["diag_max"])
+            self.diag_hist += np.asarray(snap["diag_hist"])
+            self.fam_rung_accept += np.asarray(snap["diag_fam_a"])
+            self.fam_rung_propose += np.asarray(snap["diag_fam_p"])
         st.step += todo
         if nf_steps is not None:
             self._escalate_nonfinite(snap, st, todo)
@@ -1149,8 +1268,20 @@ class PTSampler:
         self.fam_accept = np.zeros(8)
         self.fam_propose = np.zeros(8)
         self.mask_counts = np.zeros(3)
+        self._reset_diag()
         self._anneal_state = st
         return st
+
+    def _reset_diag(self):
+        """Clear the diagnostics-plane accumulators (fresh start /
+        post-anneal measurement reset — the streaming ledger must
+        describe only the measured chain)."""
+        if self.diag_ledger is not None:
+            self.diag_ledger = devicemetrics.MomentLedger(
+                self.nchains, self.ndim)
+        self.diag_hist = np.zeros_like(self.diag_hist)
+        self.fam_rung_accept = np.zeros((self.ntemps, _NFAM))
+        self.fam_rung_propose = np.zeros((self.ntemps, _NFAM))
 
     def _truncate_chain_to(self, step, thin, block_size):
         """Resume repair: cut every chain file back to the rows the
@@ -1182,6 +1313,77 @@ class PTSampler:
                 write_table(path, raw[:want], append=False)
 
     # ---------------- telemetry ---------------------------------------- #
+    def _diag_ckpt_payload(self):
+        """Diagnostics-plane checkpoint leaves for ``state.npz``: the
+        streaming ledger's block statistics plus the run-cumulative
+        histogram and per-rung family attribution — copied NOW so the
+        deferred serialization writes a snapshot consistent with this
+        block (the live accumulators keep folding behind it)."""
+        if self.diag_ledger is None or not len(self.diag_ledger):
+            return {}
+        out = {f"diag_{k}": v
+               for k, v in self.diag_ledger.state_dict().items()}
+        out["diag_hist"] = self.diag_hist.copy()
+        out["diag_fam_acc"] = self.fam_rung_accept.copy()
+        out["diag_fam_prop"] = self.fam_rung_propose.copy()
+        return out
+
+    # ewt: allow-host-sync — deferred host work on the cumulative
+    # host-side mixing accumulators (folded at the commit boundary);
+    # the .tolist() serializations touch plain numpy, never a live
+    # device buffer
+    def _write_mixing_stats(self, step_now, ladder_now, accept_rung,
+                            swap_rung, summ):
+        """``<outdir>/mixing_stats.json`` — the on-disk mixing plane
+        (refreshed per block like ``mask_stats.json``, deferred host
+        work): per-parameter streaming moments/R-hat/ESS (``summ`` —
+        the block's single :meth:`MomentLedger.param_summary` fold) +
+        fixed-bin marginal histograms, the temperature ladder with
+        per-rung acceptance and per-edge swap rates, and the per-rung
+        per-family attribution matrix."""
+        rh, es = summ["rhat"], summ["ess"]
+        per_param = {}
+        for i, name in enumerate(self.like.param_names):
+            per_param[name] = {
+                "mean": round(float(summ["mean"][i]), 6),
+                "std": round(float(summ["std"][i]), 6),
+                "min": round(float(summ["min"][i]), 6),
+                "max": round(float(summ["max"][i]), 6),
+                "rhat_stream": (
+                    round(float(rh[i]), 5)
+                    if rh is not None and np.isfinite(rh[i])
+                    else None),
+                "ess_stream": (
+                    round(float(es[i]), 1)
+                    if es is not None and np.isfinite(es[i])
+                    else None),
+                "hist": [int(c) for c in self.diag_hist[i]],
+                "hist_lo": round(float(self._hist_lo[i]), 6),
+                "hist_hi": round(float(self._hist_lo[i]
+                                       + self._hist_span[i]), 6),
+            }
+        atomic_write_json(
+            os.path.join(self.outdir, "mixing_stats.json"),
+            {"step": int(step_now),
+             "steps_folded": self.diag_ledger.total_steps,
+             # two windows live in this record: the streaming
+             # moments/rhat/ess are post-burn, while the histograms
+             # and the attribution matrices are run-cumulative
+             # (counted in-scan with no per-block granularity)
+             "stream_burn_frac": devicemetrics.STREAM_BURN_FRAC,
+             "cumulative_fields": ["hist", "fam_rung_rate",
+                                   "fam_rung_propose"],
+             "params": per_param,
+             "ladder": [round(float(T), 4) for T in ladder_now],
+             "accept_rung": accept_rung,
+             "swap_rung": swap_rung,
+             "fam_names": list(_FAM_NAMES),
+             "fam_rung_rate": np.round(
+                 self.fam_rung_accept
+                 / np.maximum(self.fam_rung_propose, 1.0), 4).tolist(),
+             "fam_rung_propose": self.fam_rung_propose
+             .astype(np.int64).tolist()})
+
     def _block_diag(self, cs, diag_t):
         """Worst R-hat/ESS of one block's cold emission (throttled —
         see :func:`utils.diagnostics.throttled_block_worst`)."""
@@ -1249,6 +1451,10 @@ class PTSampler:
                 self._truncate_chain_to(st.step, thin, block_size)
         else:
             st = self._fresh_state()
+            # fresh run: the streaming ledger must not carry a
+            # previous sample() call's statistics on a reused instance
+            if st.step == 0:
+                self._reset_diag()
             # fresh run: truncate the cold chain and any stale hot-rung
             # files from a previous run in the same directory
             if _is_primary():
@@ -1355,7 +1561,8 @@ class PTSampler:
                     history=snap["history"], hist_len=st.hist_len,
                     step=st.step, accepted=st.accepted,
                     swaps_accepted=st.swaps_accepted,
-                    swaps_proposed=st.swaps_proposed, ladder=st.ladder)
+                    swaps_proposed=st.swaps_proposed, ladder=st.ladder,
+                    **self._diag_ckpt_payload())
                 pipe.defer(self._block_host_work(
                     nsamp, todo, chain_path, collect, rec, meter,
                     diag_t, verbose, snap, full_x, full_l, full_p,
@@ -1468,6 +1675,38 @@ class PTSampler:
             self._write_ckpt(payload)
             rec.checkpoint(step=step_now)
 
+            # --- mixing plane: per-rung rates + streaming R-hat/ESS -- #
+            # (device diagnostics plane; host math on the committed
+            # snapshot — tiny, off the critical path, no device sync;
+            # skipped entirely when nothing consumes it, so
+            # EWT_TELEMETRY=0 pays zero diagnostics cost)
+            accept_rung = swap_rung = summ = worst_stream = None
+            if rec.enabled or self.diag_ledger is not None:
+                accept_rung = [
+                    round(float(a), 4) for a in
+                    np.asarray(accepted).reshape(
+                        self.ntemps, self.nchains).mean(axis=1)
+                    / max(step_now, 1)]
+                swap_rung = [round(float(r), 4) for r in
+                             sacc / np.maximum(sprop, 1.0)]
+                if self.diag_ledger is not None:
+                    # ONE fold per block: the per-param summary feeds
+                    # the worst figures, the gauges, and the artifact
+                    summ = self.diag_ledger.param_summary()
+                    worst_stream = self.diag_ledger.worst(summary=summ)
+                reg = telemetry.registry()
+                for i, r in enumerate(swap_rung):
+                    reg.gauge("swap_rate", edge=i).set(r)
+                for i, a in enumerate(accept_rung):
+                    reg.gauge("rung_accept", rung=i).set(a)
+                if worst_stream is not None:
+                    if worst_stream["rhat"] is not None:
+                        reg.gauge("stream_rhat").set(
+                            worst_stream["rhat"])
+                    if worst_stream["ess"] is not None:
+                        reg.gauge("stream_ess").set(
+                            worst_stream["ess"])
+
             # --- heartbeat (from the commit-time host snapshot) ------- #
             # everything inside the rec.enabled gate exists only for
             # the event stream, so EWT_TELEMETRY=0 (or a disabled-on-
@@ -1477,6 +1716,13 @@ class PTSampler:
                 hb = dict(step=step_now, nsamp=int(nsamp),
                           accept=round(acc_rate, 4),
                           swap=round(swap_rate, 4),
+                          accept_rung=accept_rung,
+                          swap_rung=swap_rung,
+                          fam_accept={
+                              n: round(float(a / max(p, 1.0)), 4)
+                              for n, a, p in zip(_FAM_NAMES,
+                                                 fam_accept,
+                                                 fam_propose)},
                           ladder=[round(float(T), 4)
                                   for T in ladder_now],
                           evals_per_s=round(meter.window_rate(), 1),
@@ -1486,6 +1732,9 @@ class PTSampler:
                           host_sync_wall_s=round(sync_s, 4),
                           block_bubble_s=round(bubble_s, 4),
                           max_lnl=round(max_lnl, 3))
+                if worst_stream is not None:
+                    hb["rhat_stream"] = worst_stream["rhat"]
+                    hb["ess_stream"] = worst_stream["ess"]
                 # device-memory watermark gauges (profiling layer):
                 # present only on backends exposing memory_stats()
                 mem = profiling.memory_watermark()
@@ -1507,12 +1756,29 @@ class PTSampler:
                     hb["rhat"] = worst["rhat"]
                     hb["ess"] = worst["ess"]
                 rec.heartbeat(**hb)
+                if self.diag_ledger is not None:
+                    # the full attribution matrices are too wide for a
+                    # heartbeat — they get their own typed event
+                    # (tools/report.py --check knows the type)
+                    rec.event(
+                        "mixing", step=step_now,
+                        accept_rung=accept_rung, swap_rung=swap_rung,
+                        fam_names=list(_FAM_NAMES),
+                        fam_rung_rate=np.round(
+                            self.fam_rung_accept
+                            / np.maximum(self.fam_rung_propose, 1.0),
+                            4).tolist(),
+                        fam_rung_propose=self.fam_rung_propose
+                        .astype(np.int64).tolist(),
+                        rhat_stream=(worst_stream or {}).get("rhat"),
+                        ess_stream=(worst_stream or {}).get("ess"))
+            if summ is not None and _is_primary():
+                self._write_mixing_stats(step_now, ladder_now,
+                                         accept_rung, swap_rung, summ)
             if verbose:
                 fam = " ".join(
                     f"{n}={a / max(p, 1.0):.2f}" for n, a, p in zip(
-                        ("scam", "am", "de", "pd", "ind", "cg", "kde",
-                         "ns"),
-                        fam_accept, fam_propose))
+                        _FAM_NAMES, fam_accept, fam_propose))
                 mask = ""
                 if self.use_maskstats:
                     tot = max(mask_counts.sum(), 1.0)

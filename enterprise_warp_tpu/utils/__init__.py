@@ -11,9 +11,9 @@ the process-wide metrics registry, the ``events.jsonl`` run recorder,
 and compile/retrace tracking (see ``docs/observability.md``).
 """
 
-from . import telemetry
+from . import devicemetrics, telemetry
 from .logging import (EvalRateMeter, PhaseTimer, get_logger, log_phase,
                       profiler_trace)
 
 __all__ = ["get_logger", "PhaseTimer", "EvalRateMeter", "log_phase",
-           "profiler_trace", "telemetry"]
+           "profiler_trace", "telemetry", "devicemetrics"]

@@ -1,0 +1,499 @@
+"""Device-resident diagnostics plane: in-scan accumulators and
+streaming mixing diagnostics at block cadence.
+
+PRs 3 and 9 made every sampler device-resident and blocked (donated
+state, ``block_iters`` iterations per dispatch) — but statistical
+observability stayed behind: worst R-hat/ESS come from throttled host
+chain folds (``utils/diagnostics.py``), heartbeats carry one aggregate
+acceptance number, and per-rung swap dynamics are invisible between
+``MIXING.json`` refreshes. This module moves the diagnostics *inside*
+the scan, with a contract that costs the hot path nothing:
+
+**Device-side accumulator contract** (used inside sampler
+``lax.scan`` bodies — see ``samplers/ptmcmc.py:_make_block``,
+``samplers/hmc.py``, ``samplers/nested.py``):
+
+- fixed shapes: every accumulator is a fixed-shape array threaded
+  through the scan carry, so instrumentation can never retrace a
+  block;
+- zero uploads: accumulators are zero-initialized INSIDE the block
+  jit (block-local), never uploaded — the cumulative fold lives on
+  the host;
+- one harvest: accumulator outputs join the existing block-commit
+  ``host_snapshot`` (the ONE designed sync per block) — zero added
+  dispatches, zero added host syncs, proven by the
+  ``bench.py --mixing`` A/B (``BENCH_MIXING.json``, gated by
+  ``tools/sentinel.py``);
+- bit-inert when off: with ``EWT_TELEMETRY=0`` (master gate) or
+  ``EWT_DEVICE_DIAG=0`` (plane-only gate) the accumulator slot in the
+  carry is an EMPTY pytree — no leaves, no program change, the block
+  program stays bit-identical (the PR 3/5 invariant).
+
+Primitives: :func:`welford_init`/:func:`welford_add` (per-element
+streaming moments, Welford's update), :func:`minmax_init`/
+:func:`minmax_add` (extrema), :func:`hist_init`/:func:`hist_add`
+(fixed-bin histograms via clipped bucketize), and the host-side
+:func:`welford_merge` (Chan et al. parallel merge — associative, the
+property the block-granular fold relies on).
+
+**Host-side streaming diagnostics**: :class:`MomentLedger` keeps the
+per-block, per-chain sufficient statistics ``(count, mean, M2, min,
+max)`` harvested at each commit — a block-granular sufficient-
+statistics store over the whole run. From it, at block cadence and
+O(blocks) host cost:
+
+- :meth:`MomentLedger.split_rhat` — split-R-hat with the split at the
+  nearest block boundary (exactly the Gelman/BDA3 formula when the
+  boundary lands on the true halfway point; within one block of it
+  otherwise);
+- :meth:`MomentLedger.moment_ess` — batch-means ESS from per-block
+  means grouped into ~sqrt(blocks) batches. CAVEAT (documented in
+  docs/observability.md): batch means under-estimates the
+  autocorrelation time while batches are shorter than it, so the
+  streaming ESS can over-read early in a run — the convergence gate
+  therefore always CONFIRMS a streaming pass with the host-exact
+  Geyer estimator before declaring convergence
+  (``samplers/convergence.py``).
+
+The ledger serializes to flat arrays (:meth:`MomentLedger.state_dict`
+/ :meth:`MomentLedger.from_state`) so samplers checkpoint it alongside
+``state.npz`` — post-resume streaming R-hat continues from the
+checkpointed statistics instead of restarting from empty (mirroring
+the PR 8 ``EvalRateMeter`` seeding).
+
+Everything here is either pure jax (device-side, callable from traced
+code) or pure numpy (host-side folds at the commit boundary) — the
+ledger never touches a device array.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import telemetry
+
+__all__ = ["enabled", "welford_init", "welford_add", "welford_merge",
+           "welford_finalize", "minmax_init", "minmax_add",
+           "hist_init", "hist_add", "hist_bounds", "MomentLedger",
+           "DEFAULT_NBINS"]
+
+#: fixed bin count of the per-parameter marginal histograms — fixed at
+#: build time (retrace-free), sized for a heartbeat-grade marginal
+#: sketch, not a publication plot
+DEFAULT_NBINS = 32
+
+#: the post-burn window of every streaming diagnostic (the default
+#: ``burn_frac`` of the ledger's estimators) — referenced by the
+#: mixing artifacts so the honesty label and the math cannot drift
+STREAM_BURN_FRAC = 0.25
+
+#: ledger compaction threshold: at this many retained blocks adjacent
+#: pairs are merged (exactly — Welford merge), halving the count.
+#: Bounds every diagnostic fold, and therefore the per-commit host
+#: cost, at ~O(cap) regardless of run length; only the block
+#: granularity of the burn window / split point coarsens, which the
+#: streaming estimators tolerate by contract.
+COMPACT_CAP = 512
+
+
+def enabled() -> bool:
+    """Whether the device diagnostics plane is armed: master-gated by
+    ``EWT_TELEMETRY`` (off = bit-identical block program, zero
+    artifacts), with ``EWT_DEVICE_DIAG=0`` as the plane-only hatch."""
+    return telemetry.enabled() \
+        and os.environ.get("EWT_DEVICE_DIAG", "1") != "0"
+
+
+# ------------------------------------------------------------------ #
+#  device-side primitives (pure jax — callable from traced code)      #
+# ------------------------------------------------------------------ #
+
+def welford_init(shape):
+    """Zero Welford state ``(n, mean, M2)`` for element shape
+    ``shape`` (``n`` is a scalar: every element sees every sample)."""
+    import jax.numpy as jnp
+
+    return (jnp.zeros(()), jnp.zeros(shape), jnp.zeros(shape))
+
+
+def welford_add(state, x):
+    """One Welford update with a batch element ``x`` (same shape as
+    the state's mean). Numerically stable streaming moments — the
+    fixed-shape in-scan replacement for materializing the sample."""
+    n, mean, m2 = state
+    n1 = n + 1.0
+    d = x - mean
+    mean = mean + d / n1
+    m2 = m2 + d * (x - mean)
+    return (n1, mean, m2)
+
+
+def welford_merge(a, b):
+    """Chan et al. parallel merge of two Welford states (host-side
+    numpy; associative up to floating point — the property the
+    block-granular ledger fold relies on, pinned by
+    ``tests/test_devicemetrics.py``)."""
+    na, ma, m2a = a
+    nb, mb, m2b = b
+    na = np.asarray(na, dtype=np.float64)
+    nb = np.asarray(nb, dtype=np.float64)
+    n = na + nb
+    safe = np.maximum(n, 1.0)
+    d = np.asarray(mb, dtype=np.float64) - np.asarray(ma,
+                                                      dtype=np.float64)
+    mean = np.asarray(ma, dtype=np.float64) + d * (nb / safe)
+    m2 = (np.asarray(m2a, dtype=np.float64)
+          + np.asarray(m2b, dtype=np.float64)
+          + d * d * (na * nb / safe))
+    return (n, mean, m2)
+
+
+def welford_finalize(state, ddof=1):
+    """``(n, mean, var)`` from a Welford state (host-side numpy).
+    ``var`` is None-free: below ``ddof + 1`` samples it is NaN, which
+    callers must gate on ``n``."""
+    n, mean, m2 = state
+    n = float(np.asarray(n))
+    mean = np.asarray(mean, dtype=np.float64)
+    m2 = np.asarray(m2, dtype=np.float64)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        var = m2 / (n - ddof)
+    return n, mean, var
+
+
+def minmax_init(shape):
+    """Extrema state ``(min, max)`` initialized to (+inf, -inf)."""
+    import jax.numpy as jnp
+
+    return (jnp.full(shape, jnp.inf), jnp.full(shape, -jnp.inf))
+
+
+def minmax_add(state, x):
+    import jax.numpy as jnp
+
+    mn, mx = state
+    return (jnp.minimum(mn, x), jnp.maximum(mx, x))
+
+
+def hist_init(ndim, nbins=DEFAULT_NBINS):
+    """Zero fixed-bin histogram ``(ndim, nbins)``. Counts are f64 —
+    exact integers up to 2**53, one dtype for the whole carry."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((ndim, nbins))
+
+
+def hist_add(hist, x, lo, span):
+    """Scatter one batch ``x`` of shape ``(batch, ndim)`` into the
+    ``(ndim, nbins)`` histogram. Bin edges are the fixed affine grid
+    ``lo + span * [0..nbins]/nbins`` (host constants baked into the
+    trace — never uploaded); out-of-range values clamp into the edge
+    bins so the count stays exact."""
+    import jax.numpy as jnp
+
+    nbins = hist.shape[1]
+    idx = jnp.clip(((x - lo) / span * nbins).astype(jnp.int32),
+                   0, nbins - 1)
+    dims = jnp.broadcast_to(jnp.arange(x.shape[1])[None, :], x.shape)
+    return hist.at[dims.ravel(), idx.ravel()].add(1.0)
+
+
+def hist_bounds(params, nsigma=5.0):
+    """Per-parameter histogram bounds ``(lo, span)`` from the prior
+    declarations: box priors use their support, location-scale priors
+    ``mu +/- nsigma * sigma``, anything else the unit interval. Host
+    numpy — resolved once at sampler build time."""
+    lo, hi = [], []
+    for p in params:
+        pr = getattr(p, "prior", None)
+        a, b = 0.0, 1.0
+        if pr is not None and hasattr(pr, "lo"):
+            a, b = float(pr.lo), float(pr.hi)
+        elif pr is not None and hasattr(pr, "sigma"):
+            mu = float(getattr(pr, "mu", 0.0))
+            s = float(pr.sigma)
+            a, b = mu - nsigma * s, mu + nsigma * s
+        if not (np.isfinite(a) and np.isfinite(b)) or b <= a:
+            a, b = 0.0, 1.0
+        lo.append(a)
+        hi.append(b)
+    lo = np.asarray(lo, dtype=np.float64)
+    return lo, np.asarray(hi, dtype=np.float64) - lo
+
+
+# ------------------------------------------------------------------ #
+#  host-side streaming diagnostics                                    #
+# ------------------------------------------------------------------ #
+
+class MomentLedger:
+    """Block-granular sufficient statistics of a sampler's cold
+    chains: per block, per chain ``(count, mean, M2, min, max)`` over
+    every parameter — appended once per block commit from the device
+    harvest (:meth:`append_block`) or from an already-hauled emission
+    (:meth:`append_samples`, the host twin used by HMC).
+
+    Because the per-block statistics are retained (tiny: ``nblocks x
+    nchains x ndim`` floats), any contiguous block suffix can be folded
+    exactly — so the post-burn window of :meth:`split_rhat` /
+    :meth:`moment_ess` tracks the growing run the way the host-exact
+    estimators do, at block granularity.
+    """
+
+    def __init__(self, nchains, ndim):
+        self.nchains = int(nchains)
+        self.ndim = int(ndim)
+        self._counts: list[int] = []
+        self._means: list[np.ndarray] = []
+        self._m2s: list[np.ndarray] = []
+        self._mins: list[np.ndarray] = []
+        self._maxs: list[np.ndarray] = []
+
+    def __len__(self):
+        return len(self._counts)
+
+    @property
+    def total_steps(self) -> int:
+        """Total per-chain steps folded so far (cumulative across
+        kill/resume sessions when restored from a checkpoint)."""
+        return int(sum(self._counts))
+
+    # -------------------------- folds ------------------------------ #
+    def append_block(self, count, mean, m2, mn=None, mx=None):
+        """Fold one block's device harvest: ``count`` per-chain steps,
+        ``mean``/``m2`` the per-chain Welford moments (``(nchains,
+        ndim)``), optional extrema of the same shape."""
+        count = int(np.asarray(count))
+        if count <= 0:
+            return
+        shape = (self.nchains, self.ndim)
+        self._counts.append(count)
+        self._means.append(
+            np.asarray(mean, dtype=np.float64).reshape(shape))
+        self._m2s.append(
+            np.asarray(m2, dtype=np.float64).reshape(shape))
+        self._mins.append(
+            np.full(shape, np.nan) if mn is None
+            else np.asarray(mn, dtype=np.float64).reshape(shape))
+        self._maxs.append(
+            np.full(shape, np.nan) if mx is None
+            else np.asarray(mx, dtype=np.float64).reshape(shape))
+        if len(self._counts) >= COMPACT_CAP:
+            self._compact()
+
+    def _compact(self):
+        """Merge adjacent block pairs (exact — see
+        :data:`COMPACT_CAP`), halving the retained block count."""
+        n = len(self._counts)
+        counts, means, m2s, mins, maxs = [], [], [], [], []
+        with np.errstate(invalid="ignore"):
+            for i in range(0, n - 1, 2):
+                c, mu, m2 = welford_merge(
+                    (float(self._counts[i]), self._means[i],
+                     self._m2s[i]),
+                    (float(self._counts[i + 1]), self._means[i + 1],
+                     self._m2s[i + 1]))
+                counts.append(int(c))
+                means.append(mu)
+                m2s.append(m2)
+                mins.append(np.fmin(self._mins[i],
+                                    self._mins[i + 1]))
+                maxs.append(np.fmax(self._maxs[i],
+                                    self._maxs[i + 1]))
+        if n % 2:
+            counts.append(self._counts[-1])
+            means.append(self._means[-1])
+            m2s.append(self._m2s[-1])
+            mins.append(self._mins[-1])
+            maxs.append(self._maxs[-1])
+        self._counts, self._means, self._m2s = counts, means, m2s
+        self._mins, self._maxs = mins, maxs
+
+    def append_samples(self, block):
+        """Host twin of the in-scan accumulators: fold an already-
+        committed ``(steps, nchains, ndim)`` emission into one block
+        entry (used where the emission crosses to host anyway — the
+        HMC theta chains)."""
+        b = np.asarray(block, dtype=np.float64)
+        if b.ndim != 3 or b.shape[0] == 0:
+            return
+        mean = b.mean(axis=0)
+        m2 = ((b - mean[None]) ** 2).sum(axis=0)
+        self.append_block(b.shape[0], mean, m2,
+                          b.min(axis=0), b.max(axis=0))
+
+    # -------------------------- diagnostics ------------------------ #
+    def _start(self, burn_frac):
+        """Index of the first kept block: drop the earliest blocks
+        whose cumulative step count fits inside the burn window
+        (conservative — the straddling block is kept)."""
+        counts = np.asarray(self._counts)
+        burn = int(counts.sum() * float(burn_frac))
+        start = int(np.searchsorted(np.cumsum(counts), burn,
+                                    side="right"))
+        return min(start, len(counts) - 1) if len(counts) else 0
+
+    def _merge_range(self, a, b):
+        """Merged per-chain Welford state over blocks ``[a, b)``."""
+        state = (np.zeros(()),
+                 np.zeros((self.nchains, self.ndim)),
+                 np.zeros((self.nchains, self.ndim)))
+        for i in range(a, b):
+            state = welford_merge(
+                state, (float(self._counts[i]), self._means[i],
+                        self._m2s[i]))
+        return state
+
+    def split_rhat(self, burn_frac=STREAM_BURN_FRAC):
+        """Per-parameter streaming split-R-hat over the post-burn
+        block suffix, split at the block boundary nearest the halfway
+        point. Identical to :func:`utils.diagnostics.gelman_rubin`
+        when that boundary IS the halfway point; within one block of
+        the exact split otherwise. None when fewer than two kept
+        blocks (or segments shorter than 2 steps) exist."""
+        start = self._start(burn_frac)
+        counts = np.asarray(self._counts[start:], dtype=np.float64)
+        if len(counts) < 2:
+            return None
+        cum = np.cumsum(counts)
+        k = int(np.searchsorted(cum, cum[-1] / 2.0, side="left")) + 1
+        k = min(max(k, 1), len(counts) - 1)
+        n1, mu1, m21 = self._merge_range(start, start + k)
+        n2, mu2, m22 = self._merge_range(start + k,
+                                         len(self._counts))
+        n1, n2 = float(n1), float(n2)
+        if min(n1, n2) < 2:
+            return None
+        means = np.concatenate([mu1, mu2], axis=0)     # (2m, d)
+        variances = np.concatenate(
+            [m21 / (n1 - 1.0), m22 / (n2 - 1.0)], axis=0)
+        n = 0.5 * (n1 + n2)
+        w = variances.mean(axis=0)
+        var_plus = (n - 1.0) / n * w + np.var(means, axis=0, ddof=1)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            rhat = np.sqrt(var_plus / w)
+        return np.where(w > 0, rhat, 1.0)
+
+    def moment_ess(self, burn_frac=STREAM_BURN_FRAC):
+        """Per-parameter streaming batch-means ESS over the post-burn
+        block suffix: per-block chain means grouped into
+        ~sqrt(blocks) consecutive batches; ``ESS = m * nbatch *
+        var_plus / var(batch means)``. Over-reads while batches are
+        shorter than the autocorrelation time (see module docstring) —
+        consumers that GATE on it must confirm with the host-exact
+        estimator. None below 4 kept blocks."""
+        start = self._start(burn_frac)
+        nb_blocks = len(self._counts) - start
+        if nb_blocks < 4:
+            return None
+        counts = np.asarray(self._counts[start:], dtype=np.float64)
+        means = np.stack(self._means[start:])    # (B, m, d)
+        nbatch = max(2, int(nb_blocks ** 0.5))
+        groups = np.array_split(np.arange(nb_blocks), nbatch)
+        batch_means = []
+        for g in groups:
+            wsum = counts[g].sum()
+            batch_means.append(
+                np.tensordot(counts[g], means[g], axes=(0, 0)) / wsum)
+        bm = np.stack(batch_means)               # (nbatch, m, d)
+        bm = bm.reshape(nbatch * self.nchains, self.ndim)
+        n_tot, mu, var = welford_finalize(
+            self._merge_range(start, len(self._counts)))
+        w = np.nan_to_num(var, nan=0.0).mean(axis=0)
+        n_per_chain = counts.sum()
+        var_plus = (n_per_chain - 1.0) / n_per_chain * w
+        if self.nchains > 1:
+            var_plus = var_plus + np.var(mu, axis=0, ddof=1)
+        var_bm = np.var(bm, axis=0, ddof=1)
+        total = self.nchains * n_per_chain
+        with np.errstate(invalid="ignore", divide="ignore"):
+            ess = self.nchains * nbatch * var_plus / var_bm
+        ess = np.where(var_bm > 0, ess, total)
+        return np.minimum(np.maximum(ess, 0.0), total)
+
+    def worst(self, burn_frac=STREAM_BURN_FRAC, summary=None):
+        """The heartbeat figure: ``{"rhat": max, "ess": min,
+        "steps": kept}`` over the post-burn window, or None when the
+        ledger is too short. Non-finite estimates clamp to None per
+        the strict-JSON diagnostics contract. Pass an already-computed
+        :meth:`param_summary` (same ``burn_frac``) as ``summary`` to
+        reuse its per-param estimates instead of re-folding."""
+        if summary is not None:
+            rhat, ess = summary.get("rhat"), summary.get("ess")
+        else:
+            rhat = self.split_rhat(burn_frac)
+            ess = self.moment_ess(burn_frac)
+        if rhat is None and ess is None:
+            return None
+        start = self._start(burn_frac)
+        kept = int(sum(self._counts[start:]))
+        rh = float(np.max(rhat)) if rhat is not None else None
+        es = float(np.min(ess)) if ess is not None else None
+        return {
+            "rhat": rh if rh is not None and np.isfinite(rh) else None,
+            "ess": es if es is not None and np.isfinite(es) else None,
+            "steps": kept,
+        }
+
+    def param_summary(self, burn_frac=STREAM_BURN_FRAC):
+        """Per-parameter streaming table for the mixing artifact:
+        ``(mean, std, min, max, rhat, ess)`` arrays over the post-burn
+        window (std from the merged per-chain moments, pooled)."""
+        if not self._counts:
+            return None
+        start = self._start(burn_frac)
+        n, mu, var = welford_finalize(
+            self._merge_range(start, len(self._counts)))
+        mins = np.stack(self._mins[start:])
+        maxs = np.stack(self._maxs[start:])
+        with np.errstate(invalid="ignore"):
+            mn = np.nanmin(mins, axis=(0, 1))
+            mx = np.nanmax(maxs, axis=(0, 1))
+        return {
+            "mean": mu.mean(axis=0),
+            "std": np.sqrt(np.maximum(
+                np.nan_to_num(var, nan=0.0).mean(axis=0), 0.0)),
+            "min": mn,
+            "max": mx,
+            "rhat": self.split_rhat(burn_frac),
+            "ess": self.moment_ess(burn_frac),
+        }
+
+    # -------------------------- persistence ------------------------ #
+    def state_dict(self):
+        """Flat-array snapshot for ``np.savez`` checkpointing (copied
+        — safe to serialize off the critical path while the live
+        ledger keeps folding)."""
+        shape = (0, self.nchains, self.ndim)
+        if not self._counts:
+            z = np.zeros(shape)
+            return {"counts": np.zeros(0, dtype=np.int64),
+                    "mean": z, "m2": z.copy(), "min": z.copy(),
+                    "max": z.copy()}
+        return {
+            "counts": np.asarray(self._counts, dtype=np.int64),
+            "mean": np.stack(self._means),
+            "m2": np.stack(self._m2s),
+            "min": np.stack(self._mins),
+            "max": np.stack(self._maxs),
+        }
+
+    @classmethod
+    def from_state(cls, nchains, ndim, state):
+        """Rebuild a ledger from :meth:`state_dict` arrays; shape
+        mismatches (a checkpoint from a different chain geometry)
+        return a FRESH ledger rather than poisoning the fold."""
+        led = cls(nchains, ndim)
+        counts = np.asarray(state.get("counts", ()), dtype=np.int64)
+        mean = np.asarray(state.get("mean", ()))
+        if counts.size == 0 or mean.ndim != 3 \
+                or mean.shape[1:] != (led.nchains, led.ndim) \
+                or mean.shape[0] != counts.size:
+            return led
+        m2 = np.asarray(state["m2"])
+        mn = np.asarray(state["min"])
+        mx = np.asarray(state["max"])
+        for i in range(counts.size):
+            led.append_block(counts[i], mean[i], m2[i], mn[i], mx[i])
+        return led

@@ -1,0 +1,488 @@
+"""Device diagnostics plane (utils/devicemetrics.py + sampler wiring).
+
+Covers the ISSUE-12 acceptance surface: the accumulator contract
+(Welford merge associativity, fixed-bin histogram vs the numpy
+reference), streaming split-R-hat / moment-ESS vs the host-exact
+``utils/diagnostics.py`` estimators, block-program bit-equality under
+``EWT_TELEMETRY=0`` / ``EWT_DEVICE_DIAG=0`` with identical
+dispatch/host-sync counts (the zero-overhead claim), kill/resume
+continuity of the cumulative accumulators, the per-rung heartbeat and
+``mixing`` event surfacing, the convergence driver's streaming gate,
+the report/--check vocabulary, and the sentinel's mixing gate.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from test_samplers import GaussianLike
+
+from enterprise_warp_tpu.samplers import PTSampler
+from enterprise_warp_tpu.samplers.convergence import (
+    sample_to_convergence)
+from enterprise_warp_tpu.samplers.hmc import HMCSampler
+from enterprise_warp_tpu.utils import devicemetrics as dm
+from enterprise_warp_tpu.utils import telemetry
+from enterprise_warp_tpu.utils.diagnostics import (
+    effective_sample_size, gelman_rubin, summarize_chains)
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"ewt_{name}_cli_dm", str(REPO_ROOT / "tools" / f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on(monkeypatch):
+    monkeypatch.setenv("EWT_TELEMETRY", "1")
+    monkeypatch.delenv("EWT_DEVICE_DIAG", raising=False)
+    telemetry.registry().reset()
+    yield
+    telemetry.registry().reset()
+
+
+# ------------------------------------------------------------------ #
+#  accumulator primitives                                             #
+# ------------------------------------------------------------------ #
+
+def test_welford_merge_associative_and_exact():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((300, 4, 2))
+
+    def fold(chunk):
+        mean = chunk.mean(axis=0)
+        m2 = ((chunk - mean[None]) ** 2).sum(axis=0)
+        return (float(chunk.shape[0]), mean, m2)
+
+    a, b, c = fold(x[:50]), fold(x[50:120]), fold(x[120:])
+    left = dm.welford_merge(dm.welford_merge(a, b), c)
+    right = dm.welford_merge(a, dm.welford_merge(b, c))
+    n_l, mu_l, var_l = dm.welford_finalize(left)
+    n_r, mu_r, var_r = dm.welford_finalize(right)
+    assert n_l == n_r == 300
+    np.testing.assert_allclose(mu_l, mu_r, rtol=1e-12)
+    np.testing.assert_allclose(var_l, var_r, rtol=1e-10)
+    # and both agree with the direct numpy moments
+    np.testing.assert_allclose(mu_l, x.mean(axis=0), rtol=1e-12)
+    np.testing.assert_allclose(var_l, x.var(axis=0, ddof=1),
+                               rtol=1e-10)
+
+
+def test_device_welford_and_hist_vs_numpy():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-3.0, 3.0, size=(200, 5, 3))
+    state = dm.welford_init((5, 3))
+    mm = dm.minmax_init((5, 3))
+    lo = np.full(3, -4.0)
+    span = np.full(3, 8.0)
+    hist = dm.hist_init(3, nbins=16)
+    for t in range(x.shape[0]):
+        xi = jnp.asarray(x[t])
+        state = dm.welford_add(state, xi)
+        mm = dm.minmax_add(mm, xi)
+        hist = dm.hist_add(hist, xi, jnp.asarray(lo),
+                           jnp.asarray(span))
+    n, mean, var = dm.welford_finalize(
+        tuple(np.asarray(s) for s in state))
+    assert n == 200
+    np.testing.assert_allclose(mean, x.mean(axis=0), rtol=1e-10)
+    np.testing.assert_allclose(var, x.var(axis=0, ddof=1),
+                               rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(mm[0]), x.min(axis=0))
+    np.testing.assert_allclose(np.asarray(mm[1]), x.max(axis=0))
+    # fixed-bin histogram vs the numpy reference (same affine grid)
+    h = np.asarray(hist)
+    for d in range(3):
+        ref, _ = np.histogram(x[:, :, d].ravel(), bins=16,
+                              range=(-4.0, 4.0))
+        np.testing.assert_array_equal(h[d], ref)
+    assert h.sum() == 200 * 5 * 3
+
+
+def test_ledger_split_rhat_matches_exact_on_aligned_split():
+    rng = np.random.default_rng(2)
+    m, d, nblocks, L = 6, 3, 8, 125
+    data = rng.standard_normal((nblocks * L, m, d))
+    data[:, 0] += 0.3          # one offset chain: rhat must see it
+    led = dm.MomentLedger(m, d)
+    for b in range(nblocks):
+        led.append_samples(data[b * L:(b + 1) * L])
+    chains = np.transpose(data, (1, 0, 2))
+    exact = np.array([gelman_rubin(chains[:, :, i]) for i in range(d)])
+    stream = led.split_rhat(burn_frac=0.0)
+    # even equal-size blocks -> the block-boundary split IS the exact
+    # halfway split, so the two formulas agree to round-off
+    np.testing.assert_allclose(stream, exact, rtol=1e-10)
+    assert led.total_steps == nblocks * L
+
+
+def test_ledger_moment_ess_tracks_geyer():
+    rng = np.random.default_rng(3)
+    m, d, nblocks, L = 8, 2, 16, 125
+    n = nblocks * L
+    # AR(1) with a substantial autocorrelation time
+    rho = 0.9
+    x = np.zeros((n, m, d))
+    eps = rng.standard_normal((n, m, d)) * np.sqrt(1 - rho ** 2)
+    for t in range(1, n):
+        x[t] = rho * x[t - 1] + eps[t]
+    led = dm.MomentLedger(m, d)
+    for b in range(nblocks):
+        led.append_samples(x[b * L:(b + 1) * L])
+    chains = np.transpose(x, (1, 0, 2))
+    exact = np.array([effective_sample_size(chains[:, :, i])
+                      for i in range(d)])
+    stream = led.moment_ess(burn_frac=0.0)
+    assert stream is not None
+    # different estimators; the band catches a broken fold
+    ratio = stream / exact
+    assert np.all(ratio > 1.0 / 3.0) and np.all(ratio < 3.0)
+    # iid data: ESS must approach the sample count
+    led2 = dm.MomentLedger(m, d)
+    y = rng.standard_normal((n, m, d))
+    for b in range(nblocks):
+        led2.append_samples(y[b * L:(b + 1) * L])
+    iid = led2.moment_ess(burn_frac=0.0)
+    assert np.all(iid > 0.4 * m * n)
+
+
+def test_ledger_burn_drops_early_blocks():
+    rng = np.random.default_rng(4)
+    m, d, L = 4, 1, 100
+    led = dm.MomentLedger(m, d)
+    # a burn-in transient where each chain starts from its own corner
+    # (the real pre-convergence signature: between-chain variance)
+    start = rng.standard_normal((L, m, d))
+    start += (10.0 * np.arange(m))[None, :, None]
+    led.append_samples(start)
+    for _ in range(5):
+        led.append_samples(rng.standard_normal((L, m, d)))
+    bad = led.split_rhat(burn_frac=0.0)
+    good = led.split_rhat(burn_frac=0.2)
+    assert bad[0] > 1.1          # transient poisons the no-burn fold
+    assert good[0] < 1.02        # post-burn window is clean
+
+
+def test_ledger_state_roundtrip_and_shape_guard():
+    rng = np.random.default_rng(5)
+    led = dm.MomentLedger(4, 2)
+    for _ in range(5):
+        led.append_samples(rng.standard_normal((50, 4, 2)))
+    clone = dm.MomentLedger.from_state(4, 2, led.state_dict())
+    assert len(clone) == len(led)
+    np.testing.assert_allclose(clone.split_rhat(0.0),
+                               led.split_rhat(0.0))
+    # a mismatched geometry must come back FRESH, not poisoned
+    other = dm.MomentLedger.from_state(8, 2, led.state_dict())
+    assert len(other) == 0
+
+
+# ------------------------------------------------------------------ #
+#  PTMCMC wiring: zero overhead, bit-equality, surfacing              #
+# ------------------------------------------------------------------ #
+
+def _run_pt(outdir, nsamp=300, block_size=100, seed=0, ntemps=2,
+            resume=False, collect=None):
+    s = PTSampler(GaussianLike([0.0, 1.0], [0.5, 0.3]), str(outdir),
+                  ntemps=ntemps, nchains=4, seed=seed)
+    s.sample(nsamp, resume=resume, verbose=False,
+             block_size=block_size, collect=collect)
+    return s, np.loadtxt(os.path.join(str(outdir), "chain_1.txt"))
+
+
+def test_pt_zero_overhead_and_bit_equality(tmp_path, monkeypatch):
+    s_on, chain_on = _run_pt(tmp_path / "on")
+    monkeypatch.setenv("EWT_DEVICE_DIAG", "0")
+    s_off, chain_off = _run_pt(tmp_path / "off")
+    monkeypatch.setenv("EWT_TELEMETRY", "0")
+    monkeypatch.delenv("EWT_DEVICE_DIAG", raising=False)
+    s_tel, chain_tel = _run_pt(tmp_path / "tel")
+    # the zero-overhead contract: identical dispatch/commit-sync
+    # counts, bit-equal chains — instrumentation rode the existing
+    # block program and the existing snapshot
+    assert (s_on.n_dispatch, s_on.n_sync) \
+        == (s_off.n_dispatch, s_off.n_sync)
+    np.testing.assert_array_equal(chain_on, chain_off)
+    # EWT_TELEMETRY=0 bit-equality (the PR 3/5 invariant) and zero
+    # diagnostics artifacts
+    np.testing.assert_array_equal(chain_on, chain_tel)
+    assert s_off.diag_ledger is None and s_tel.diag_ledger is None
+    assert not (tmp_path / "off" / "mixing_stats.json").exists()
+    assert not (tmp_path / "tel" / "mixing_stats.json").exists()
+
+
+def test_pt_streaming_matches_exact_and_surfaces(tmp_path):
+    blocks = []
+    s, _ = _run_pt(tmp_path, nsamp=600, block_size=100,
+                   collect=blocks)
+    assert len(s.diag_ledger) == 6
+    assert s.diag_ledger.total_steps == 600
+    # streaming vs host-exact on the same post-burn window
+    c = np.concatenate(blocks, axis=0)
+    keep = int(c.shape[0] * 0.75)
+    chains = np.transpose(c[-keep:], (1, 0, 2)).astype(np.float64)
+    exact = summarize_chains(chains, s.like.param_names)["_worst"]
+    stream = s.diag_ledger.worst(0.25)
+    assert abs(stream["rhat"] - exact["rhat"]) < 0.1
+    assert stream["ess"] is not None and exact["ess"] is not None
+    assert 1 / 3 < stream["ess"] / exact["ess"] < 3
+    # heartbeat surfacing: per-rung acceptance, per-edge swap rates,
+    # streaming figures; plus the typed mixing event
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    hb = [e for e in events if e["type"] == "heartbeat"][-1]
+    assert len(hb["accept_rung"]) == s.ntemps
+    assert len(hb["swap_rung"]) == s.ntemps - 1
+    assert set(hb["fam_accept"]) == {"scam", "am", "de", "pd", "ind",
+                                     "cg", "kde", "ns"}
+    assert hb["rhat_stream"] is not None
+    mix = [e for e in events if e["type"] == "mixing"]
+    assert mix and len(mix[-1]["fam_rung_rate"]) == s.ntemps
+    # registry gauges feed the OpenMetrics exporters
+    gauges = telemetry.registry().snapshot()["gauges"]
+    assert "stream_rhat" in gauges
+    assert "swap_rate{edge=0}" in gauges
+    # mixing artifact: per-param stats + full-count histograms
+    ms = json.load(open(tmp_path / "mixing_stats.json"))
+    assert ms["steps_folded"] == 600
+    p0 = ms["params"]["p0"]
+    assert sum(p0["hist"]) == 600 * s.nchains
+    assert p0["rhat_stream"] is not None
+    # per-rung attribution matrix: rows = rungs
+    assert len(ms["fam_rung_rate"]) == s.ntemps
+    # the stream stays schema-clean under the extended vocabulary
+    report_cli = _load_tool("report")
+    assert report_cli.main([str(tmp_path), "--check"]) == 0
+
+
+def test_pt_resume_continuity(tmp_path):
+    # uninterrupted N+M vs N -> kill -> fresh sampler resumes M
+    s_ref, chain_ref = _run_pt(tmp_path / "full", nsamp=400)
+    _run_pt(tmp_path / "cut", nsamp=200)
+    s_res, chain_res = _run_pt(tmp_path / "cut", nsamp=400,
+                               resume=True)
+    assert s_res.diag_ledger.total_steps == 400
+    assert s_ref.diag_ledger.worst() == s_res.diag_ledger.worst()
+    np.testing.assert_array_equal(s_ref.diag_hist, s_res.diag_hist)
+    np.testing.assert_array_equal(chain_ref, chain_res)
+
+
+def test_convergence_rewind_truncates_ledger(tmp_path):
+    """A kill between the checkpoint write and the chain append makes
+    the convergence driver rewind the checkpoint's step counter; the
+    streaming ledger must be truncated with it, or the re-sampled
+    window would fold twice and the freshness check would never hold
+    again."""
+    s, _ = _run_pt(tmp_path, nsamp=400, ntemps=1)
+    chain = np.loadtxt(tmp_path / "chain_1.txt")
+    # simulate the crash artifact: chain holds 300 complete steps,
+    # checkpoint says 400 (block-aligned -> ledger truncates exactly)
+    np.savetxt(tmp_path / "chain_1.txt", chain[:300 * s.nchains])
+    s2 = PTSampler(GaussianLike([0.0, 1.0], [0.5, 0.3]),
+                   str(tmp_path), ntemps=1, nchains=4, seed=0)
+    sample_to_convergence(
+        s2, target_ess=1e9, rhat_max=1.0001, check_every=100,
+        max_steps=500, block_size=100, resume=True, verbose=False)
+    # no double fold: the ledger covers exactly the sampled steps,
+    # and the run-cumulative histogram was dropped (not truncatable)
+    assert s2.diag_ledger.total_steps == 500
+    assert s2.diag_hist.sum() == 200 * s2.nchains * s2.ndim
+
+
+def test_hmc_energy_accumulators_and_ledger(tmp_path):
+    s = HMCSampler(GaussianLike([0.5, -0.5], [0.4, 0.8]),
+                   str(tmp_path), nchains=8, seed=0, warmup=100,
+                   n_leapfrog=4)
+    s.sample(200, resume=False, verbose=False, block_size=50)
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    hb = [e for e in events if e["type"] == "heartbeat"][-1]
+    assert "energy_err_mean" in hb and "energy_err_max" in hb
+    assert hb["energy_err_std"] >= 0.0
+    assert hb["eps_min"] <= hb["eps_max"]
+    assert hb["rhat_stream"] is not None
+    assert s.diag_ledger.total_steps == 200
+    # ledger rides the checkpoint: a resumed sampler continues it
+    s2 = HMCSampler(GaussianLike([0.5, -0.5], [0.4, 0.8]),
+                    str(tmp_path), nchains=8, seed=0, warmup=100,
+                    n_leapfrog=4)
+    s2.sample(300, resume=True, verbose=False, block_size=50)
+    assert s2.diag_ledger.total_steps == 300
+    # a FRESH run on a reused instance resets the ledger — no
+    # carryover from the previous sample() call's chains
+    s2.sample(100, resume=False, verbose=False, block_size=50)
+    assert s2.diag_ledger.total_steps == 100
+
+
+def test_nested_scale_and_exhaustion_heartbeats(tmp_path):
+    from enterprise_warp_tpu.samplers import run_nested
+
+    run_nested(GaussianLike([0.0], [0.5]), outdir=str(tmp_path),
+               nlive=100, dlogz=0.5, nsteps=8, seed=3, verbose=False,
+               max_iter=64, label="dg", kernel="slice",
+               block_iters=16)
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    hbs = [e for e in events if e["type"] == "heartbeat"
+           and "scale_min" in e]
+    assert hbs
+    hb = hbs[-1]
+    assert hb["scale_min"] <= hb["scale_max"]
+    assert 0.0 <= hb["budget_exhaust_frac"] <= 1.0
+    assert 0.0 <= hb["first_accept_frac"] <= 1.0
+    report_cli = _load_tool("report")
+    assert report_cli.main([str(tmp_path), "--check"]) == 0
+
+
+def test_convergence_streaming_gate(tmp_path, monkeypatch):
+    s = PTSampler(GaussianLike([0.0, 1.0], [0.5, 0.3]),
+                  str(tmp_path), ntemps=1, nchains=8, seed=0)
+    rep = sample_to_convergence(
+        s, target_ess=200.0, rhat_max=1.05, check_every=400,
+        max_steps=4000, block_size=100, verbose=False)
+    events = [json.loads(ln) for ln in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    checks = [e for e in events if e.get("phase")
+              == "convergence_check"]
+    modes = {e.get("diag_mode") for e in checks}
+    # the streaming gate fielded at least one negative check, and the
+    # verdict was still confirmed by an exact fold
+    assert "exact" in modes
+    if rep.converged:
+        # a converged report's figures come from the exact estimators
+        assert rep.ess_min >= 200.0 and rep.rhat_max <= 1.05
+    # and the skip path is inert when disabled
+    monkeypatch.setenv("EWT_STREAMING_DIAG", "0")
+    s2 = PTSampler(GaussianLike([0.0], [0.5]),
+                   str(tmp_path / "off"), ntemps=1, nchains=8, seed=1)
+    rep2 = sample_to_convergence(
+        s2, target_ess=50.0, rhat_max=1.2, check_every=200,
+        max_steps=1000, block_size=100, verbose=False)
+    ev2 = [json.loads(ln) for ln in
+           (tmp_path / "off" / "events.jsonl").read_text()
+           .splitlines()]
+    assert all(e.get("diag_mode") != "stream" for e in ev2
+               if e.get("phase") == "convergence_check")
+    assert rep2.steps > 0
+
+
+# ------------------------------------------------------------------ #
+#  report / campaign / sentinel surfacing                             #
+# ------------------------------------------------------------------ #
+
+def test_report_mixing_section(tmp_path, capsys):
+    _run_pt(tmp_path, nsamp=300)
+    report_cli = _load_tool("report")
+    assert report_cli.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "mixing:" in out
+    rpt = json.load(open(tmp_path / "run_report.json"))
+    mx = rpt["mixing"]
+    assert mx["stream_trajectory"]
+    assert mx["accept_rung"] is not None
+    assert mx["mixing_events"] >= 1
+    json.dumps(rpt, allow_nan=False)
+
+
+def test_check_flags_unknown_heartbeat_field(tmp_path, capsys):
+    stream = tmp_path / "events.jsonl"
+    stream.write_text("\n".join([
+        json.dumps({"t": 1.0, "type": "run_start", "run_id": "a"}),
+        json.dumps({"t": 2.0, "type": "heartbeat", "step": 1,
+                    "rhat_stream": 1.01}),
+        json.dumps({"t": 3.0, "type": "mixing", "step": 1,
+                    "accept_rung": [0.3]}),
+        json.dumps({"t": 4.0, "type": "heartbeat", "step": 2,
+                    "bogus_field": 1}),
+        json.dumps({"t": 5.0, "type": "run_end", "status": "ok"}),
+    ]) + "\n")
+    report_cli = _load_tool("report")
+    assert report_cli.main([str(stream), "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "bogus_field" in out
+    assert "mixing" not in [ln for ln in out.splitlines()
+                            if "unknown event" in ln]
+
+
+def test_campaign_shows_stream_rhat(tmp_path, capsys):
+    run_dir = tmp_path / "psr"
+    run_dir.mkdir()
+    (run_dir / "events.jsonl").write_text("\n".join([
+        json.dumps({"t": 1.0, "type": "run_start", "run_id": "r1",
+                    "campaign": "c1", "sampler": "ptmcmc"}),
+        json.dumps({"t": 1.1, "type": "run_lineage", "run_id": "r1",
+                    "campaign": "c1", "parent": None,
+                    "reason": "fresh"}),
+        json.dumps({"t": 2.0, "type": "heartbeat", "step": 100,
+                    "nsamp": 200, "rhat_stream": 1.234,
+                    "ess_stream": 55.0}),
+        json.dumps({"t": 3.0, "type": "run_end", "status": "ok"}),
+    ]) + "\n")
+    campaign_cli = _load_tool("campaign")
+    assert campaign_cli.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "~1.234" in out
+    rpt = json.load(open(tmp_path / "campaign_report.json"))
+    assert rpt["runs"][0]["rhat_stream"] == 1.234
+
+
+def _mixing_fixture(**overrides):
+    arm = {"exact": {"rhat": 1.01, "ess": 1300.0},
+           "stream": {"rhat": 1.012, "ess": 1100.0},
+           "rhat_abs_diff": 0.002, "ess_ratio": 0.85,
+           "ess_per_step": 0.33,
+           "dispatches": {"diag_on": 16, "diag_off": 16},
+           "host_syncs": {"diag_on": 16, "diag_off": 16},
+           "added_dispatches": 0, "added_host_syncs": 0,
+           "chains_bit_equal": True}
+    arm.update(overrides)
+    return arm
+
+
+def test_sentinel_mixing_gate(tmp_path):
+    sentinel = _load_tool("sentinel")
+    committed = {"banana": {"ess_per_step": 0.24},
+                 "bimodal": {"ess_per_step": 0.33}}
+    (tmp_path / "MIXING.json").write_text(json.dumps(committed))
+
+    def write(banana, bimodal):
+        (tmp_path / "BENCH_MIXING.json").write_text(json.dumps(
+            {"metric": "mixing_stream_ab", "banana": banana,
+             "bimodal": bimodal}))
+
+    write(_mixing_fixture(), _mixing_fixture())
+    g = sentinel.gate_mixing(str(tmp_path))
+    assert g["status"] == "pass", g
+    # a single added host sync is a hard fail — the zero-overhead
+    # contract is the plane's whole reason to exist
+    write(_mixing_fixture(added_host_syncs=1), _mixing_fixture())
+    assert sentinel.gate_mixing(str(tmp_path))["status"] == "fail"
+    # streaming drifting away from host-exact fails
+    write(_mixing_fixture(), _mixing_fixture(rhat_abs_diff=0.2))
+    assert sentinel.gate_mixing(str(tmp_path))["status"] == "fail"
+    # mixing-quality regression vs the committed target fails
+    write(_mixing_fixture(ess_per_step=0.05), _mixing_fixture())
+    assert sentinel.gate_mixing(str(tmp_path))["status"] == "fail"
+    # perturbed chains fail
+    write(_mixing_fixture(), _mixing_fixture(chains_bit_equal=False))
+    assert sentinel.gate_mixing(str(tmp_path))["status"] == "fail"
+    # no record at all is a warning, not a silent pass
+    os.remove(tmp_path / "BENCH_MIXING.json")
+    assert sentinel.gate_mixing(str(tmp_path))["status"] == "warn"
+
+
+def test_sentinel_passes_on_committed_history():
+    sentinel = _load_tool("sentinel")
+    g = sentinel.gate_mixing(str(REPO_ROOT))
+    assert g["status"] == "pass", g

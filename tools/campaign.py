@@ -119,6 +119,11 @@ def fold_campaign(root, now=None, stale_s=300.0):
             "evals_total": term["evals_total"],
             "rhat": term["rhat"],
             "ess": term["ess"],
+            # device diagnostics plane: streaming figures arrive at
+            # block cadence, so a live fleet view usually has these
+            # even when the throttled exact fold hasn't fired yet
+            "rhat_stream": term["rhat_stream"],
+            "ess_stream": term["ess_stream"],
             "faults": counts["fault"],
             "retries": counts["retry"],
             "demotions": counts["demotion"],
@@ -209,8 +214,14 @@ def render(report, out=sys.stdout):
                 if r.get("progress") is not None else "-")
         rate = (f"{r['evals_per_s']:.0f}"
                 if r.get("evals_per_s") is not None else "-")
-        rhat = (f"{r['rhat']:.3f}" if r.get("rhat") is not None
-                else "-")
+        # exact fold wins; the streaming figure (marked ~) fills the
+        # throttle gap so a live fleet is never blind on mixing
+        if r.get("rhat") is not None:
+            rhat = f"{r['rhat']:.3f}"
+        elif r.get("rhat_stream") is not None:
+            rhat = f"~{r['rhat_stream']:.3f}"
+        else:
+            rhat = "-"
         flags = ("!" if r.get("anomaly") else "") \
             + ("v" if r.get("demoted") else "")
         reasons = ">".join({"fresh": "F", "resume": "R",

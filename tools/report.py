@@ -54,12 +54,41 @@ import sys
 #: the typed-event vocabulary (docs/observability.md;
 #: ``fault``/``retry``/``demotion`` from the resilience layer,
 #: docs/resilience.md; ``run_lineage``/``metrics_export`` from the
-#: campaign-observability layer). ``--check`` flags anything else as
+#: campaign-observability layer; ``mixing`` from the device
+#: diagnostics plane — the per-rung/per-family attribution matrices
+#: too wide for a heartbeat). ``--check`` flags anything else as
 #: unknown.
 KNOWN_EVENT_TYPES = frozenset({
     "run_start", "run_end", "compile", "heartbeat", "checkpoint",
     "span", "cost_analysis", "anomaly", "fault", "retry", "demotion",
-    "run_lineage", "metrics_export",
+    "run_lineage", "metrics_export", "mixing",
+})
+
+#: the heartbeat field vocabulary — every field any sampler/driver
+#: emits (docs/observability.md). ``--check`` flags unknown fields so
+#: a typo'd or undocumented heartbeat key cannot silently ship.
+KNOWN_HEARTBEAT_FIELDS = frozenset({
+    # identity / progress
+    "phase", "step", "nsamp", "iteration", "round", "steps",
+    # shared throughput + block-boundary accounting
+    "accept", "swap", "ladder", "evals_per_s", "evals_total",
+    "cache_hit_rate", "host_sync_wall_s", "block_bubble_s",
+    "max_lnl", "wall_s", "bubble_s", "host_sync_s",
+    # convergence (throttled-exact and streaming)
+    "rhat", "ess", "rhat_stream", "ess_stream", "diag_mode",
+    # mixing plane (device diagnostics)
+    "accept_rung", "swap_rung", "fam_accept",
+    # memory / routing provenance
+    "rss_bytes", "hbm_in_use_bytes", "hbm_peak_bytes", "pallas_path",
+    # HMC
+    "eps", "divergences", "warmup", "energy_err_mean",
+    "energy_err_std", "energy_err_max", "eps_min", "eps_max",
+    # nested
+    "lnz", "dlogz", "scale", "insertion_ks", "converged",
+    "scale_min", "scale_max", "budget_exhaust_frac",
+    "first_accept_frac",
+    # VI / CEM drivers
+    "elbo", "best_lnpost", "is_ess",
 })
 
 
@@ -118,7 +147,8 @@ def fold_segments(events, stream=None):
                            "anomaly": 0, "checkpoint": 0,
                            "heartbeat": 0},
                 "step": None, "nsamp": None, "evals_per_s": None,
-                "evals_total": None, "rhat": None, "ess": None}
+                "evals_total": None, "rhat": None, "ess": None,
+                "rhat_stream": None, "ess_stream": None}
 
     for ev in events:
         t = ev.get("type")
@@ -144,7 +174,7 @@ def fold_segments(events, stream=None):
             c = cur["counts"]
             c["heartbeat"] += 1
             for k in ("step", "nsamp", "evals_per_s", "evals_total",
-                      "rhat", "ess"):
+                      "rhat", "ess", "rhat_stream", "ess_stream"):
                 if ev.get(k) is not None:
                     cur[k] = ev[k]
             # nested heartbeats carry 'iteration', never 'step' — the
@@ -239,6 +269,9 @@ def build_report(events, dropped=0):
     bubble_s, host_sync_s, bubble_blocks = 0.0, 0.0, 0
     pallas_path = None
     insertion_ks = []
+    stream_traj = []
+    accept_rung = swap_rung = fam_accept = None
+    energy_err_max = None
     for hb in heartbeats:
         t_rel = round(hb["t"] - t0, 2) if t0 is not None else None
         if hb.get("evals_per_s") is not None:
@@ -268,6 +301,24 @@ def build_report(events, dropped=0):
         # per committed block): posterior correctness, measured
         if hb.get("insertion_ks") is not None:
             insertion_ks.append(float(hb["insertion_ks"]))
+        # device diagnostics plane: streaming R-hat/ESS trajectory at
+        # block cadence plus the latest per-rung mixing figures
+        if hb.get("rhat_stream") is not None \
+                or hb.get("ess_stream") is not None:
+            stream_traj.append(
+                {"t_s": t_rel,
+                 "step": hb.get("step", hb.get("iteration")),
+                 "rhat_stream": hb.get("rhat_stream"),
+                 "ess_stream": hb.get("ess_stream")})
+        if hb.get("accept_rung") is not None:
+            accept_rung = hb["accept_rung"]
+        if hb.get("swap_rung") is not None:
+            swap_rung = hb["swap_rung"]
+        if hb.get("fam_accept") is not None:
+            fam_accept = hb["fam_accept"]
+        if hb.get("energy_err_max") is not None:
+            energy_err_max = max(energy_err_max or 0.0,
+                                 float(hb["energy_err_max"]))
 
     rates = [r["evals_per_s"] for r in rate_timeline
              if r["evals_per_s"] is not None]
@@ -356,6 +407,19 @@ def build_report(events, dropped=0):
                           else None),
         },
         "cache_hit_rate": cache_hit,
+        "mixing": ({
+            "stream_trajectory": stream_traj,
+            "final_rhat_stream": (stream_traj[-1]["rhat_stream"]
+                                  if stream_traj else None),
+            "final_ess_stream": (stream_traj[-1]["ess_stream"]
+                                 if stream_traj else None),
+            "accept_rung": accept_rung,
+            "swap_rung": swap_rung,
+            "fam_accept": fam_accept,
+            "energy_err_max": energy_err_max,
+            "mixing_events": len(by_type.get("mixing", [])),
+        } if (stream_traj or accept_rung is not None
+              or energy_err_max is not None) else None),
         "insertion_rank": ({
             "last_ks": insertion_ks[-1],
             "worst_ks": max(insertion_ks),
@@ -436,6 +500,28 @@ def _human_summary(report, out=sys.stdout):
           f"{len(conv['trajectory'])} checks")
     if report["cache_hit_rate"] is not None:
         p(f"cache_hit_rate: {report['cache_hit_rate']}")
+    mx = report.get("mixing")
+    if mx:
+        bits = []
+        if mx.get("final_rhat_stream") is not None:
+            bits.append(f"stream rhat={mx['final_rhat_stream']}")
+        if mx.get("final_ess_stream") is not None:
+            bits.append(f"stream ess={mx['final_ess_stream']:.0f}")
+        if mx.get("accept_rung") is not None:
+            bits.append("accept/rung=["
+                        + ",".join(f"{a:.2f}"
+                                   for a in mx["accept_rung"]) + "]")
+        if mx.get("swap_rung"):
+            bits.append("swap/edge=["
+                        + ",".join(f"{s:.2f}"
+                                   for s in mx["swap_rung"]) + "]")
+        if mx.get("energy_err_max") is not None:
+            bits.append(f"max |dH|={mx['energy_err_max']}")
+        if bits:
+            p("mixing: " + "  ".join(bits))
+        if mx.get("fam_accept"):
+            p("  family acceptance: " + " ".join(
+                f"{k}={v}" for k, v in mx["fam_accept"].items()))
     ir = report.get("insertion_rank")
     if ir:
         p(f"insertion rank: last KS {ir['last_ks']} "
@@ -573,6 +659,21 @@ def check_stream(path, out=sys.stdout):
         problems += sum(unknown.values())
         p(f"CHECK: unknown event type(s): "
           + ", ".join(f"{t} x{n}" for t, n in sorted(unknown.items())))
+    # heartbeat field vocabulary: a typo'd or undocumented key would
+    # otherwise ship silently and break downstream folds
+    unknown_hb: dict = {}
+    for ev in events:
+        if ev.get("type") != "heartbeat":
+            continue
+        for k in ev:
+            if k not in ("t", "type") \
+                    and k not in KNOWN_HEARTBEAT_FIELDS:
+                unknown_hb[k] = unknown_hb.get(k, 0) + 1
+    if unknown_hb:
+        problems += sum(unknown_hb.values())
+        p("CHECK: unknown heartbeat field(s): "
+          + ", ".join(f"{k} x{n}"
+                      for k, n in sorted(unknown_hb.items())))
     # span open/close pairing: every E must match an open B id; B's
     # without an E at stream end are unclosed (crash mid-span)
     open_ids = {}

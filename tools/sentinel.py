@@ -17,6 +17,10 @@ telemetry stream) into ``TRENDS.json`` and applies threshold gates:
   reduction must hold the committed floor (``--min-dispatch-red``);
 - ``bubble_fraction``   — BENCH_PIPELINE.json's block-boundary
   pipeline must keep its bubble reduction and host-boundary share;
+- ``mixing``            — BENCH_MIXING.json's streaming-vs-host-exact
+  A/B (the device diagnostics plane) must show zero added
+  dispatches/host-syncs, bit-equal chains, streaming R-hat/ESS
+  agreement, and ESS/step holding the committed MIXING.json targets;
 - ``retraces`` / ``nonfinite`` / ``bubble`` (with ``--run <run_dir>``)
   — a fresh run's events.jsonl must show a bounded retrace count per
   traced fn, zero non-finite evals, and a sane bubble fraction;
@@ -270,6 +274,84 @@ def gate_nested(bench_dir, min_reduction, tol):
         insertion_ks_sqrt_n=ir.get("ks_sqrt_n"))
 
 
+def gate_mixing(bench_dir, max_rhat_diff=0.05, ess_ratio_lo=1.0 / 3.0,
+                ess_ratio_hi=3.0, min_ess_frac=0.5):
+    """Mixing-quality gates from BENCH_MIXING.json (the streaming-vs-
+    host-exact A/B of the device diagnostics plane, ``bench.py
+    --mixing``) checked against the committed MIXING.json analytic
+    targets:
+
+    - **zero overhead** — the instrumented arm must add exactly zero
+      dispatches and zero host syncs per run, and its chains must be
+      bit-equal to the bare arm (the diagnostics-plane contract);
+    - **agreement** — streaming split-R-hat within ``max_rhat_diff``
+      of the host-exact value, streaming ESS within the
+      ``[ess_ratio_lo, ess_ratio_hi]`` ratio band (batch means vs
+      Geyer are different estimators; the band catches a broken fold,
+      not estimator variance);
+    - **mixing quality** — each target's measured ESS/step must hold
+      ``min_ess_frac`` of the committed MIXING.json figure (the
+      committed mixing story must not silently regress).
+    """
+    doc = _load_json(os.path.join(bench_dir, "BENCH_MIXING.json"))
+    if not doc:
+        return _gate("mixing", "warn", "no BENCH_MIXING.json record")
+    committed = _load_json(os.path.join(bench_dir, "MIXING.json")) \
+        or {}
+    problems = []
+    detail_ok = []
+    for target in ("banana", "bimodal"):
+        arm = doc.get(target)
+        if not isinstance(arm, dict):
+            problems.append(f"record lacks the {target} arm")
+            continue
+        for field in ("added_dispatches", "added_host_syncs"):
+            v = arm.get(field)
+            if v is None:
+                problems.append(f"{target}: record lacks {field}")
+            elif v != 0:
+                problems.append(
+                    f"{target}: {field}={v} — the diagnostics plane "
+                    "must add ZERO (the in-scan contract broke)")
+        if arm.get("chains_bit_equal") is not True:
+            problems.append(
+                f"{target}: instrumented chains not bit-equal to the "
+                "bare arm (accumulators perturbed the sampling)")
+        rd = arm.get("rhat_abs_diff")
+        if rd is None:
+            problems.append(f"{target}: record lacks rhat_abs_diff")
+        elif rd > max_rhat_diff:
+            problems.append(
+                f"{target}: streaming-vs-exact |drhat|={rd} > "
+                f"{max_rhat_diff}")
+        er = arm.get("ess_ratio")
+        if er is None:
+            problems.append(f"{target}: record lacks ess_ratio")
+        elif not (ess_ratio_lo <= er <= ess_ratio_hi):
+            problems.append(
+                f"{target}: streaming/exact ESS ratio {er} outside "
+                f"[{ess_ratio_lo:.2f}, {ess_ratio_hi:.2f}]")
+        meas = arm.get("ess_per_step")
+        ref = (committed.get(target) or {}).get("ess_per_step")
+        if meas is not None and ref:
+            if meas < min_ess_frac * ref:
+                problems.append(
+                    f"{target}: ess_per_step {meas} < "
+                    f"{min_ess_frac} x committed {ref} "
+                    "(mixing quality regressed)")
+            else:
+                detail_ok.append(f"{target} ess/step {meas} "
+                                 f"(committed {ref})")
+        if rd is not None and er is not None:
+            detail_ok.append(f"{target} |drhat|={rd} ess_ratio={er}")
+    if problems:
+        return _gate("mixing", "fail", "; ".join(problems))
+    return _gate("mixing", "pass",
+                 "streaming agrees with host-exact, zero added "
+                 "dispatches/syncs, chains bit-equal: "
+                 + "; ".join(detail_ok))
+
+
 def gate_staleness(series, stale_days, now=None):
     """The "device leg went stale unnoticed" alarm: the newest
     headline must be a device measurement young enough to trust."""
@@ -403,6 +485,10 @@ def main(argv=None):
                          "(default 10.0, the committed contract)")
     ap.add_argument("--max-host-fraction", type=float, default=0.5,
                     help="host_boundary_fraction cap (default 0.5)")
+    ap.add_argument("--min-mixing-frac", type=float, default=0.5,
+                    help="mixing-quality floor: BENCH_MIXING ess/step "
+                         "vs the committed MIXING.json target "
+                         "(default 0.5)")
     ap.add_argument("--max-retraces", type=int, default=8,
                     help="per-fn retrace cap for --run (default 8)")
     ap.add_argument("--max-bubble", type=float, default=0.6,
@@ -428,6 +514,8 @@ def main(argv=None):
                     opts.max_host_fraction),
         gate_nested(opts.bench_dir, opts.min_nested_dispatch_red,
                     opts.tol),
+        gate_mixing(opts.bench_dir,
+                    min_ess_frac=opts.min_mixing_frac),
         gate_staleness(series, opts.stale_days),
     ]
     if opts.run is not None:
@@ -449,6 +537,7 @@ def main(argv=None):
                 opts.min_nested_dispatch_red,
             "min_bubble_reduction": opts.min_bubble_red,
             "max_host_fraction": opts.max_host_fraction,
+            "min_mixing_frac": opts.min_mixing_frac,
             "max_retraces": opts.max_retraces,
             "max_bubble": opts.max_bubble,
             "stale_days": opts.stale_days,
