@@ -72,6 +72,15 @@ def import_custom_models(py_path: str, class_name: str):
 def main(argv=None):
     import argparse
 
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    # serve subcommand (enterprise_warp_tpu/serve, docs/serving.md):
+    # the multi-tenant batched-dispatch entry point — routed before
+    # the reference option parser so the classic one-shot CLI
+    # contract stays byte-compatible for every existing invocation
+    if argv and argv[0] == "serve":
+        from .serve.cli import serve_main
+        return serve_main(argv[1:])
+
     from .utils.compilecache import enable_compilation_cache
     enable_compilation_cache()
     # the reference option set (config.parse_commandline) extended with the
@@ -147,9 +156,7 @@ def main(argv=None):
         print(f"platform demotion: {d}", file=sys.stderr)
         if d.to_level == "cpu" and \
                 os.environ.get("EWT_DEMOTION_EXEC", "1") != "0":
-            argv_full = list(sys.argv[1:]) if argv is None \
-                else list(argv)
-            env, cmd = _demotion_reexec(argv_full)
+            env, cmd = _demotion_reexec(list(argv))
             os.execve(sys.executable, cmd, env)
         return EXIT_DEMOTED
     return 0

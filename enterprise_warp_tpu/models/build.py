@@ -605,6 +605,27 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
 
     like = PulsarLikelihood(psr, sampled, loglike, gram_mode)
     like.const_grams = bool(const_grams)
+    # build-structure fingerprint (serving-layer executable identity,
+    # see topology_fingerprint): everything theta-independent the
+    # lowering bakes into the program by value but the sampled-param
+    # list cannot see — fixed (Constant-prior) parameter VALUES, the
+    # white/basis block structure, and the build-time route knobs
+    import hashlib as _hl
+    _bfp = _hl.sha256()
+    for nm in sorted(mapping):
+        kind_v = mapping[nm]
+        if kind_v[0] == "const":
+            _bfp.update(f"c:{nm}={kind_v[1]!r};".encode())
+    for kind, mm, refs in wb_static:
+        _bfp.update(f"w:{kind}:{tuple(mm.shape)}:{refs};".encode())
+    for bb in bb_static:
+        _bfp.update(f"b:{bb['psd']}:{bb['ncols']}:{bb['col_slice']}:"
+                    f"{bb['idx_map']}:{bb['dyn']}:{bb['orf']};"
+                    .encode())
+    _bfp.update(f"tm={tm};refine={n_refine};"
+                f"bchol={use_blocked_chol};cg={bool(const_grams)};"
+                f"pair={pair_prog is not None};".encode())
+    like.build_fingerprint = _bfp.hexdigest()[:16]
     # sampler evaluation protocol (samplers/evalproto.py): pure function
     # + the device-array pytree, so every jit can take the arrays as
     # arguments. For sharded builds (arrays may span processes) the
@@ -615,3 +636,82 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     install_protocol(like, loglike_inner, sharded,
                      public=mesh is not None, name="pulsar")
     return like
+
+
+def params_fingerprint(like):
+    """Cheap model-identity string: parameter names + prior reprs.
+    The canonical sampled-parameter identity shared by the nested
+    sampler's checkpoint fingerprint and the serving layer's
+    executable keys — one definition so they cannot drift."""
+    parts = []
+    for p in getattr(like, "params", []):
+        parts.append(f"{p.name}:{type(p.prior).__name__}"
+                     f":{getattr(p.prior, 'lo', '')}"
+                     f":{getattr(p.prior, 'hi', '')}"
+                     f":{getattr(p.prior, 'mu', '')}"
+                     f":{getattr(p.prior, 'sigma', '')}")
+    return "|".join(parts)
+
+
+def topology_fingerprint(like):
+    """Executable-identity digest for the AOT serving cache
+    (``enterprise_warp_tpu/serve``): two likelihoods with equal
+    fingerprints lower to the same XLA program at a given batch
+    bucket, so one compiled executable serves every request against
+    either.
+
+    What joins the digest, and why:
+
+    - the sampled-parameter identity (:func:`params_fingerprint`) and
+      the consts pytree's leaf shapes/dtypes (the ``evalproto``
+      consts-as-arguments contract: arrays that flow in as ARGUMENTS
+      only pin shapes, not values);
+    - the pulsar DATA identity (name, ntoa, residual/toaerr digests):
+      the build closes over structural arrays (Fourier bases, folded
+      constant Grams) that lowering bakes into the program BY VALUE —
+      a rebuilt likelihood of the same pulsar+model reproduces them
+      bit-for-bit (safe to share), a different pulsar does not;
+    - the build/route knobs that change the lowered program:
+      ``gram_mode``, ``const_grams``, and the ``EWT_PALLAS*`` /
+      ``EWT_REFINE`` / ``EWT_BLOCKED_CHOL`` env pins (so a platform
+      demotion that flips ``EWT_PALLAS=0`` naturally keys fresh
+      executables instead of reusing megakernel ones).
+
+    Likelihoods without both a ``psr`` and a ``build_fingerprint``
+    (analytic targets, joint-PTA builds) get a per-instance identity
+    token instead — their baked closure constants cannot be
+    enumerated generically, so sharing executables across instances
+    would be unsound.
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(type(like).__name__.encode())
+    h.update(params_fingerprint(like).encode())
+    h.update(f"gram={getattr(like, 'gram_mode', '')};"
+             f"cg={getattr(like, 'const_grams', '')};".encode())
+    bfp = getattr(like, "build_fingerprint", None)
+    psr = getattr(like, "psr", None)
+    if bfp is not None:
+        h.update(f"build={bfp};".encode())
+    psr_keyed = psr is not None and bfp is not None
+    if psr_keyed:
+        h.update(f"psr={psr.name}:{len(psr)};".encode())
+        h.update(np.ascontiguousarray(
+            np.asarray(psr.residuals, dtype=np.float64)).tobytes())
+        h.update(np.ascontiguousarray(
+            np.asarray(psr.toaerrs, dtype=np.float64)).tobytes())
+    else:
+        h.update(f"instance={id(like)};".encode())
+    import os as _os2
+    for knob in ("EWT_PALLAS", "EWT_PALLAS_MEGA", "EWT_PALLAS_CHOL",
+                 "EWT_REFINE", "EWT_BLOCKED_CHOL", "EWT_PAIR_PROGRAM"):
+        h.update(f"{knob}={_os2.environ.get(knob, '')};".encode())
+    from ..samplers.evalproto import eval_protocol
+    _, _, consts = eval_protocol(like)
+    leaves = jax.tree_util.tree_leaves(consts)
+    for leaf in leaves:
+        h.update(f"{getattr(leaf, 'shape', ())}:"
+                 f"{getattr(leaf, 'dtype', type(leaf).__name__)};"
+                 .encode())
+    return h.hexdigest()[:16]

@@ -42,8 +42,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["host_snapshot", "chain_sharding", "resolve_placement",
-           "place_resident", "HostPipeline"]
+__all__ = ["host_snapshot", "host_pull", "chain_sharding",
+           "resolve_placement", "place_resident", "HostPipeline"]
 
 
 def chain_sharding(mesh, axis="chain"):
@@ -118,6 +118,23 @@ def host_snapshot(tree):
     return {k: (np.array(v) if hasattr(v, "copy_to_host_async")
                 else np.asarray(v))
             for k, v in tree.items()}
+
+
+# ewt: allow-host-sync — the single-leaf sibling of host_snapshot:
+# the sanctioned donation-safe device->host pull for one result array
+# (serving-layer batch harvest), same real-copy contract
+def host_pull(v):
+    """Donation-safe host copy of ONE array leaf — the single-leaf
+    sibling of :func:`host_snapshot`, same contract: async D2H
+    prefetch, then a REAL numpy copy (never a view into a buffer a
+    later donated dispatch may overwrite in place). Used by the
+    serving layer to harvest a dispatched batch's results before the
+    next batch donates its buffers."""
+    prefetch = getattr(v, "copy_to_host_async", None)
+    if prefetch is not None:
+        prefetch()
+        return np.array(v)
+    return np.asarray(v)
 
 
 class HostPipeline:

@@ -1446,15 +1446,14 @@ def _finalize(like, outdir, label, seed, nlive, kbatch, nsteps, it,
 
 
 def _params_fingerprint(like):
-    """Cheap model-identity string: parameter names + prior reprs."""
-    parts = []
-    for p in getattr(like, "params", []):
-        parts.append(f"{p.name}:{type(p.prior).__name__}"
-                     f":{getattr(p.prior, 'lo', '')}"
-                     f":{getattr(p.prior, 'hi', '')}"
-                     f":{getattr(p.prior, 'mu', '')}"
-                     f":{getattr(p.prior, 'sigma', '')}")
-    return "|".join(parts)
+    """Cheap model-identity string: parameter names + prior reprs —
+    the canonical definition now lives in ``models/build.py``
+    (``params_fingerprint``, shared with the serving layer's
+    executable keys); same output string, so existing checkpoints
+    keep resuming."""
+    from ..models.build import params_fingerprint
+
+    return params_fingerprint(like)
 
 
 # ewt: allow-host-sync,precision — host-side evidence reduction over

@@ -1,0 +1,36 @@
+"""Multi-tenant serving layer: AOT executable cache + shape-bucketed
+batched dispatch (ROADMAP item 3 — the "millions of users" entry
+point).
+
+The one-shot CLI pays full trace+compile before the first likelihood
+eval of every request — the dominant latency term for small repeat
+jobs (per-pulsar noise posteriors, CW sky scans). This package
+amortizes both compilation and dispatch:
+
+- :mod:`aot` — ahead-of-time compiled batch-eval executables keyed on
+  ``(model topology fingerprint, batch bucket, backend)``, held
+  in-process and persisted through the XLA compile cache
+  (``utils/compilecache.py``) so a warm replica never traces;
+- :mod:`packer` — the request queue's shape-bucketing packer: many
+  small jobs padded into ONE batched vmap dispatch at a bucket edge,
+  padding rows masked out at harvest (bit-equal to the single-job
+  path — asserted in ``tests/test_serve.py`` and the
+  ``bench.py --serve`` record);
+- :mod:`driver` — :class:`~driver.ServeDriver`: the queue + dispatch
+  loop with donated device-resident batch state, double-buffered
+  result harvest (``samplers/devicestate.py``), per-batch supervision
+  (``resilience/supervisor.py`` — watchdog/retry/demotion apply per
+  batch, not per process), and per-tenant ``events.jsonl`` streams;
+- :mod:`cli` — ``ewt-run serve ...`` / ``python tools/serve.py``.
+
+See ``docs/serving.md``.
+"""
+
+from .aot import (DEFAULT_BUCKETS, AOTExecutableCache, batch_buckets,
+                  bucket_for)
+from .driver import Request, ServeDriver
+from .packer import PackedBatch, pack_requests
+
+__all__ = ["AOTExecutableCache", "DEFAULT_BUCKETS", "batch_buckets",
+           "bucket_for", "ServeDriver", "Request", "PackedBatch",
+           "pack_requests"]

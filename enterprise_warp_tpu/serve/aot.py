@@ -1,0 +1,209 @@
+"""AOT executable cache: compile once per (topology, bucket, backend).
+
+A serving replica answers many small jobs against a handful of model
+topologies. Tracing + XLA-compiling the batched likelihood on the
+first request of each shape is the dominant cold-start latency, so
+this cache lowers the batch evaluation ahead of time
+(``jit(...).lower().compile()``) and keys the compiled executable on
+
+    (topology fingerprint, batch bucket, backend)
+
+- the **topology fingerprint** (``models/build.py:
+  topology_fingerprint``) makes the key stable across rebuilds of the
+  same pulsar+model and across processes, and distinct for anything
+  that changes the lowered program (data, fixed parameters, route
+  knobs — a platform demotion that flips ``EWT_PALLAS=0`` keys fresh
+  executables automatically);
+- the **batch bucket** is the padded walker-batch row count. Each
+  model serves at ONE sticky bucket (its serve width — see
+  ``packer.py`` for why adaptive buckets would break the bit-
+  equality contract); the configured bucket SET is what a replica
+  pre-warms so models can be deployed at any of those widths;
+- the **backend** guards a mid-run platform change.
+
+The lowering goes through jax's persistent compilation cache
+(``utils/compilecache.py``), so a fresh replica that pre-compiles its
+bucket set (``tools/warm_cache.py --serve``) RELOADS executables
+instead of compiling them — the in-process dict amortizes within a
+process, the XLA cache across processes. Per-compile persistent-cache
+verdicts are attributed via ``telemetry.watch_compile``.
+
+The compiled callable takes ``(thetas (B, ndim) f64, consts)`` with
+the theta buffer DONATED (``donate_argnums=(0,)``): batch state is
+device-resident and consumed in place; callers keep the host copy of
+the rows for retry (see ``driver.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+__all__ = ["DEFAULT_BUCKETS", "batch_buckets", "bucket_for",
+           "AOTExecutableCache"]
+
+#: default batch-bucket edges (padded rows per dispatch). Powers of
+#: two: few enough that a replica warms them all in seconds per
+#: topology, dense enough that padding waste stays under 2x.
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def batch_buckets():
+    """The configured bucket edges (``EWT_SERVE_BUCKETS=1,8,64``
+    overrides; always sorted, deduplicated)."""
+    env = os.environ.get("EWT_SERVE_BUCKETS")
+    if env:
+        edges = sorted({int(x) for x in env.split(",") if x.strip()})
+        if edges and all(e > 0 for e in edges):
+            return tuple(edges)
+    return DEFAULT_BUCKETS
+
+
+def bucket_for(n, buckets):
+    """Smallest bucket edge >= ``n``, or None when ``n`` exceeds the
+    largest edge (the packer spills such loads across several
+    capacity-sized dispatches instead)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return None
+
+
+class AOTExecutableCache:
+    """In-process executable cache for batched likelihood evaluation
+    (see module docstring).
+
+    ``executable(like, bucket)`` returns the compiled batch-``bucket``
+    evaluator — compiling (or reloading from the persistent cache) on
+    first use, a dict hit afterwards. ``warm(like)`` pre-compiles the
+    whole configured bucket set.
+    """
+
+    def __init__(self, buckets=None, donate=True):
+        self.buckets = tuple(sorted(buckets or batch_buckets()))
+        self.donate = bool(donate)
+        self._exec: dict = {}           # key -> compiled executable
+        self._fp: dict = {}             # id(like) -> fingerprint memo
+        self.compile_walls: dict = {}   # key -> lower+compile seconds
+        self.cache_verdicts: dict = {}  # key -> persistent cache_hit
+
+    @property
+    def capacity(self) -> int:
+        """Largest bucket: the most rows one dispatch can carry."""
+        return self.buckets[-1]
+
+    def fingerprint(self, like) -> str:
+        """Memoized topology fingerprint of ``like`` (the data digest
+        is hashed once per registered model, not per request). The
+        memo holds a strong reference to ``like`` — an id()-only key
+        could be reused by a NEW object after the old one is freed
+        and silently serve the wrong topology's executable."""
+        slot = self._fp.get(id(like))
+        if slot is not None and slot[0] is like:
+            return slot[1]
+        from ..models.build import topology_fingerprint
+
+        fp = topology_fingerprint(like)
+        self._fp[id(like)] = (like, fp)
+        return fp
+
+    def key(self, like, bucket):
+        import jax
+
+        return (self.fingerprint(like), int(bucket),
+                jax.default_backend())
+
+    def executable(self, like, bucket):
+        """The compiled batch-``bucket`` evaluator for ``like``
+        (compile-on-miss; see class docstring)."""
+        bucket = int(bucket)
+        if bucket <= 0:
+            raise ValueError(f"bucket must be positive, got {bucket}")
+        key = self.key(like, bucket)
+        compiled = self._exec.get(key)
+        from ..utils import telemetry
+
+        if compiled is not None:
+            telemetry.registry().counter("aot_cache",
+                                         outcome="hit").inc()
+            return compiled
+        telemetry.registry().counter("aot_cache", outcome="miss").inc()
+        return self._compile(like, bucket, key)
+
+    def _compile(self, like, bucket, key):
+        import jax
+
+        from ..samplers.evalproto import eval_protocol
+        from ..utils import profiling, telemetry
+        from ..utils.telemetry import traced, watch_compile
+
+        batch_fn, _, consts = eval_protocol(like)
+        label = f"serve.eval_b{bucket}"
+        # the lowered jit still goes through telemetry.traced (the
+        # no-bare-jit contract) — the AOT path compiles via the
+        # explicit .lower().compile() on its underlying jit object,
+        # so the executable is keyed here, not in jit's own cache.
+        # With EWT_TELEMETRY=0 traced() returns the bare jit object
+        # itself (no ._jitted wrapper) — lower on whichever we got.
+        wrapped = traced(batch_fn, name=label,
+                         donate_argnums=(0,) if self.donate else ())
+        jitted = getattr(wrapped, "_jitted", wrapped)
+        spec = jax.ShapeDtypeStruct((bucket, int(like.ndim)),
+                                    np.dtype("float64"))
+        t0 = profiling.monotonic()
+        with watch_compile(label) as verdict, warnings.catch_warnings():
+            # CPU cannot honor the donation (no aliasing support) and
+            # warns per compile; the donation is for the accelerator
+            # path, the warning is expected noise here
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not "
+                                  "usable")
+            compiled = jitted.lower(spec, consts).compile()
+        wall = profiling.monotonic() - t0
+        self._exec[key] = compiled
+        self.compile_walls[key] = wall
+        self.cache_verdicts[key] = verdict["cache_hit"]
+        rec = telemetry.active_recorder()
+        if rec is not None:
+            rec.event("compile", fn=label, wall_s=round(wall, 4),
+                      arg_shapes=[[bucket, int(like.ndim)]],
+                      cache_hit=verdict["cache_hit"], aot=True)
+        return compiled
+
+    def warm(self, like, buckets=None):
+        """Pre-compile the executable set for ``like`` across
+        ``buckets`` (default: every configured edge) — the fresh-
+        replica warm start. Returns ``{bucket: compile_wall_s}``."""
+        walls = {}
+        for b in (buckets or self.buckets):
+            key = self.key(like, b)
+            if key in self._exec:
+                walls[b] = 0.0
+                continue
+            self._compile(like, b, key)
+            walls[b] = self.compile_walls[key]
+        return walls
+
+    def clear(self):
+        """Drop every executable AND fingerprint memo — required
+        after a platform demotion (route knobs changed, so the memoed
+        fingerprints are stale alongside the executables)."""
+        self._exec.clear()
+        self._fp.clear()
+
+    def stats(self):
+        from ..utils.telemetry import registry
+
+        snap = {k: v for k, v in
+                registry().snapshot()["counters"].items()
+                if k.startswith("aot_cache")}
+        return {
+            "executables": len(self._exec),
+            "counters": snap,
+            "compile_walls_s": {str(k): round(v, 4)
+                                for k, v in self.compile_walls.items()},
+            "persistent_cache_verdicts": {
+                str(k): v for k, v in self.cache_verdicts.items()},
+        }

@@ -1,0 +1,160 @@
+# ewt: allow-no-print module — this IS the serve subcommand's
+# user-facing CLI surface (routed from cli.py / tools/serve.py); the
+# summary JSON on stdout is its product, like cli.py's own output
+"""``ewt-run serve`` / ``python tools/serve.py`` — the serve driver
+CLI.
+
+Builds the paramfile's model topologies once, registers them with a
+:class:`~enterprise_warp_tpu.serve.driver.ServeDriver`, optionally
+pre-warms the AOT bucket set, then serves a request trace (a JSON
+file, or a seeded synthetic multi-tenant trace) and prints one
+summary JSON line.
+
+Trace file schema: a JSON list of requests, in arrival order::
+
+    [{"tenant": "t0", "model": "0", "thetas": [[...], ...]}, ...]
+
+``"n_theta": k`` may replace ``"thetas"`` — the driver draws ``k``
+prior samples instead (seeded). ``"model"`` defaults to the first
+registered model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+__all__ = ["serve_main", "build_serve_models", "synthetic_trace"]
+
+
+def build_serve_models(prfile, gram_mode="split"):
+    """``{model_key: likelihood}`` for a paramfile's topologies (the
+    same builds the sampling CLI would run)."""
+    from ..config import Params
+    from ..models.assemble import init_model_likelihoods
+
+    params = Params(prfile, opts=None)
+    likes = init_model_likelihoods(params, gram_mode=gram_mode,
+                                   write_pars=False)
+    return {str(k): v for k, v in likes.items()}, params
+
+
+def synthetic_trace(models, n_requests, tenants=4, max_theta=8,
+                    seed=0):
+    """A seeded bursty multi-tenant request trace: requests arrive in
+    tenant bursts (each tenant submits a run of consecutive jobs, the
+    realistic shape for per-pulsar noise-posterior sweeps), with
+    theta batches drawn from the model prior."""
+    rng = np.random.default_rng(seed)
+    names = sorted(models)
+    trace = []
+    remaining = int(n_requests)
+    while remaining > 0:
+        tenant = f"tenant{rng.integers(tenants)}"
+        burst = int(min(remaining, 1 + rng.integers(6)))
+        for _ in range(burst):
+            model = names[int(rng.integers(len(names)))]
+            like = models[model]
+            n = int(1 + rng.integers(max_theta))
+            trace.append({"tenant": tenant, "model": model,
+                          "thetas": np.asarray(
+                              like.sample_prior(rng, n),
+                              dtype=np.float64)})
+        remaining -= burst
+    return trace
+
+
+def load_trace(path, models, seed=0):
+    """Parse a trace file (see module docstring) into submit specs."""
+    with open(path) as fh:
+        raw = json.load(fh)
+    rng = np.random.default_rng(seed)
+    default_model = sorted(models)[0]
+    out = []
+    for i, r in enumerate(raw):
+        model = str(r.get("model", default_model))
+        if model not in models:
+            raise KeyError(f"trace entry {i} names unregistered "
+                           f"model {model!r}")
+        if "thetas" in r:
+            thetas = np.asarray(r["thetas"], dtype=np.float64)
+        else:
+            thetas = np.asarray(models[model].sample_prior(
+                rng, int(r.get("n_theta", 1))), dtype=np.float64)
+        out.append({"tenant": str(r.get("tenant", "tenant0")),
+                    "model": model, "thetas": thetas})
+    return out
+
+
+def serve_main(argv=None):
+    import argparse
+
+    from ..utils.compilecache import enable_compilation_cache
+    enable_compilation_cache()
+
+    ap = argparse.ArgumentParser(
+        prog="ewt-run serve",
+        description="multi-tenant batched serving of paramfile "
+                    "model topologies (docs/serving.md)")
+    ap.add_argument("-p", "--prfile", required=True,
+                    help="paramfile naming the model topologies")
+    ap.add_argument("-o", "--out", default=None,
+                    help="serve root dir (default: <paramfile "
+                         "output_dir>/serve)")
+    ap.add_argument("--requests", default=None,
+                    help="JSON trace file (default: synthetic trace)")
+    ap.add_argument("--synthetic", type=int, default=32,
+                    help="synthetic trace size when --requests is "
+                         "not given (default 32)")
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--max-theta", type=int, default=8,
+                    help="max prior draws per synthetic job")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--buckets", default=None,
+                    help="comma-separated batch bucket edges "
+                         "(default EWT_SERVE_BUCKETS or 1,2,...,64)")
+    ap.add_argument("--warm", action="store_true",
+                    help="pre-compile the full bucket set per model "
+                         "before serving (fresh-replica warm start)")
+    ap.add_argument("--gram_mode", default="split",
+                    choices=("split", "f32", "f64"))
+    opts = ap.parse_args(argv)
+
+    models, params = build_serve_models(opts.prfile,
+                                        gram_mode=opts.gram_mode)
+    root = opts.out or os.path.join(params.output_dir, "serve")
+    buckets = None
+    if opts.buckets:
+        buckets = tuple(sorted({int(x) for x in
+                                opts.buckets.split(",") if x.strip()}))
+
+    from .driver import ServeDriver
+    with ServeDriver(root, buckets=buckets,
+                     prfile=os.path.abspath(opts.prfile)) as driver:
+        for name, like in models.items():
+            driver.register(name, like)
+        if opts.warm:
+            walls = driver.warm()
+            print(f"# warmed {sum(len(w) for w in walls.values())} "
+                  "executables", file=sys.stderr)
+        if opts.requests:
+            trace = load_trace(opts.requests, models, seed=opts.seed)
+        else:
+            trace = synthetic_trace(models, opts.synthetic,
+                                    tenants=opts.tenants,
+                                    max_theta=opts.max_theta,
+                                    seed=opts.seed)
+        for spec in trace:
+            driver.submit(spec["tenant"], spec["model"],
+                          spec["thetas"])
+        summary = driver.run()
+    summary["root"] = os.path.abspath(root)
+    print(json.dumps(summary))
+    return 0 if summary["dropped_requests"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(serve_main())

@@ -1,0 +1,438 @@
+"""ServeDriver: the multi-tenant request queue + batched dispatch loop.
+
+One driver owns a set of registered models (likelihoods), a FIFO
+request queue, the AOT executable cache, and the per-tenant result
+streams:
+
+- ``submit(tenant, model, thetas)`` enqueues one job (a small theta
+  batch to evaluate) and returns its request id;
+- ``step()`` drains the queue once: groups pending requests by model,
+  packs their rows into batches padded to the model's serve width
+  (``packer.py`` — ONE sticky bucket per model, so a packed job's
+  answer is bit-equal to serving it alone), and dispatches each batch
+  through the AOT executable with a DONATED device-resident theta
+  buffer. The harvest of batch ``k`` (result
+  pull, per-request assembly, tenant events, latency accounting) runs
+  double-buffered behind batch ``k+1``'s dispatch
+  (``samplers/devicestate.py:HostPipeline``), so the device never
+  idles on host bookkeeping;
+- ``run()`` steps until the queue is idle (checking graceful
+  preemption at batch boundaries, like the samplers do).
+
+Supervision is **per batch, not per process**: every dispatch goes
+through a ``resilience.supervisor.BlockSupervisor`` (site
+``serve.dispatch``) — watchdog, bounded retry for transient errors,
+circuit breaker. A ``PlatformDemotion`` to the classic route is
+applied in place (``EWT_PALLAS=0`` + executable cache flush + one
+re-dispatch of the same host rows — the donated device copy is gone,
+the host rows are not); the ``cpu`` rung propagates to the process
+layer, with every in-flight request still queued so nothing is lost.
+
+Results: ``driver.results[rid]`` (host f64 lnl per job row), a typed
+``serve_result`` event on the tenant's ``events.jsonl`` (latency,
+batch provenance), and ``serve_latency_ms`` histograms in the metrics
+registry. Driver heartbeats carry ``queue_depth`` / ``batch_fill`` /
+``requests_done`` — folded by ``tools/report.py`` and the
+``tools/campaign.py`` fleet console.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..resilience.supervisor import (BlockSupervisor, PlatformDemotion,
+                                     apply_demotion,
+                                     preemption_requested)
+from ..samplers.devicestate import (HostPipeline, host_pull,
+                                    place_resident, resolve_placement)
+from ..samplers.evalproto import eval_protocol
+from ..utils import profiling, telemetry
+from ..utils.logging import EvalRateMeter, get_logger
+from .aot import AOTExecutableCache
+from .packer import pack_requests
+
+__all__ = ["Request", "ServeDriver"]
+
+log = get_logger("ewt.serve")
+
+#: result payloads up to this many rows are inlined into the tenant's
+#: ``serve_result`` event; larger jobs get summary stats only (the
+#: caller still has the full array via ``driver.results``)
+_INLINE_LNL_ROWS = 32
+
+
+@dataclass
+class Request:
+    """One queued job: evaluate ``thetas`` (n, ndim) against
+    ``model`` for ``tenant``."""
+
+    rid: str
+    tenant: str
+    model: str
+    thetas: np.ndarray
+    t_submit: float
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def n(self) -> int:
+        return int(self.thetas.shape[0])
+
+
+class ServeDriver:
+    """See module docstring. ``root`` is the serve run directory
+    (driver events.jsonl + ``tenants/<tenant>/`` streams)."""
+
+    def __init__(self, root, buckets=None, pipeline=True,
+                 donate=True, **start_fields):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.cache = AOTExecutableCache(buckets, donate=donate)
+        self.models: dict = {}
+        self.widths: dict = {}
+        self._consts: dict = {}
+        self._placement: dict = {}
+        self.queue: deque = deque()
+        self.results: dict = {}
+        self.failed: dict = {}
+        self._pending: dict = {}    # rid -> [buf, n_filled, Request]
+        self._tenant_rec: dict = {}
+        self._seq = 0
+        self.n_dispatch = 0
+        self.n_sequential_equiv = 0   # dispatches a one-per-request
+        #                               loop would have issued
+        self.requests_seen = 0
+        self.requests_done = 0
+        self.dropped_requests = 0
+        self.pad_rows = 0
+        self.real_rows = 0
+        self._fills: list = []
+        self.request_log: list = []
+        self.pipe = HostPipeline(enabled=pipeline)
+        self.sup = BlockSupervisor("serve.dispatch",
+                                   on_checkpoint=self.pipe.flush)
+        self.meter = EvalRateMeter()
+        self._stack = contextlib.ExitStack()
+        self.rec = self._stack.enter_context(
+            telemetry.run_scope(root, sampler="serve", **start_fields))
+        reg = telemetry.registry()
+        self._g_depth = reg.gauge("serve_queue_depth")
+        self._g_fill = reg.gauge("serve_batch_fill")
+        self._c_req = reg.counter("serve_requests")
+        self._c_disp = reg.counter("serve_dispatches")
+        self._h_latency = reg.histogram("serve_latency_ms")
+
+    # ------------------------- registry ---------------------------- #
+    def register(self, name, like, width=None):
+        """Register a likelihood under ``name``; resolves its eval
+        protocol + device placement once. ``width`` pins the model's
+        serve width (its one dispatch bucket — default
+        ``EWT_SERVE_WIDTH`` or the capacity bucket); it must be one
+        of the cache's configured buckets so a pre-warmed replica
+        actually starts warm."""
+        width = int(width or os.environ.get("EWT_SERVE_WIDTH", 0)
+                    or self.cache.capacity)
+        if width not in self.cache.buckets:
+            raise ValueError(
+                f"serve width {width} is not a configured bucket "
+                f"{self.cache.buckets} — a warmed replica would "
+                "still cold-compile it")
+        _, _, consts = eval_protocol(like)
+        self.models[name] = like
+        self.widths[name] = width
+        self._consts[name] = consts
+        self._placement[name] = resolve_placement(consts)
+        return self.cache.fingerprint(like)
+
+    def warm(self, name=None, buckets=None):
+        """Pre-compile executables for one (or every) registered
+        model — the fresh-replica warm start. Default: each model's
+        own serve width; pass ``buckets`` to warm a wider set (e.g.
+        every configured edge, so the replica can be re-pointed at
+        any width without a cold compile). Returns
+        ``{model: {bucket: compile_wall_s}}``."""
+        names = [name] if name is not None else list(self.models)
+        return {n: self.cache.warm(self.models[n],
+                                   buckets or [self.widths[n]])
+                for n in names}
+
+    # ------------------------- intake ------------------------------ #
+    def submit(self, tenant, model, thetas, rid=None, **meta):
+        """Enqueue one job; returns its request id."""
+        if model not in self.models:
+            raise KeyError(f"model {model!r} is not registered "
+                           f"(have {sorted(self.models)})")
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        ndim = int(self.models[model].ndim)
+        if thetas.shape[1] != ndim:
+            raise ValueError(
+                f"job thetas have {thetas.shape[1]} dims, model "
+                f"{model!r} expects {ndim}")
+        self._seq += 1
+        rid = rid or f"{tenant}-{self._seq:06d}"
+        req = Request(rid=rid, tenant=tenant, model=model,
+                      thetas=thetas, t_submit=profiling.monotonic(),
+                      meta=meta)
+        self.queue.append(req)
+        self._pending[rid] = [np.empty(req.n, dtype=np.float64), 0,
+                              req]
+        self.requests_seen += 1
+        self._c_req.inc()
+        self._g_depth.set(len(self.queue))
+        self._tenant(tenant).event("serve_request", request_id=rid,
+                                   model=model, n_theta=req.n)
+        return rid
+
+    def _tenant(self, tenant):
+        rec = self._tenant_rec.get(tenant)
+        if rec is None:
+            tdir = os.path.join(self.root, "tenants", tenant)
+            rec = telemetry.RunRecorder(tdir)
+            rec.run_start(sampler="serve", tenant=tenant)
+            self._tenant_rec[tenant] = rec
+        return rec
+
+    # ------------------------- serving loop ------------------------ #
+    def step(self):
+        """One drain cycle over the current queue snapshot. Returns
+        the number of batches dispatched."""
+        if not self.queue:
+            return 0
+        snapshot: list = []
+        by_model: dict = {}
+        while self.queue:
+            req = self.queue.popleft()
+            snapshot.append(req)
+            by_model.setdefault(req.model, []).append(req)
+        n_batches = 0
+        fills = []
+        try:
+            for model, reqs in by_model.items():
+                self.n_sequential_equiv += len(reqs)
+                for batch in pack_requests(reqs, self.widths[model]):
+                    out = self._dispatch(model, batch)
+                    n_batches += 1
+                    if out is None:
+                        continue    # batch failed; requests recorded
+                    self.n_dispatch += 1
+                    self._c_disp.inc()
+                    self.real_rows += batch.n_real
+                    self.pad_rows += batch.bucket - batch.n_real
+                    self.meter.add(batch.n_real)
+                    fills.append(batch.fill)
+                    # double buffer: harvesting batch k runs after
+                    # batch k+1 has been dispatched (HostPipeline)
+                    self.pipe.defer(
+                        lambda b=batch, o=out: self._harvest(b, o))
+        except PlatformDemotion:
+            # cpu-rung demotion mid-cycle: the process must re-enter
+            # one level down, and the WHOLE drain cycle's unfinished
+            # work — the failed batch, undispatched batches, other
+            # models' popped requests — must survive the boundary
+            self._requeue_unfinished(snapshot)
+            raise
+        self._fills.extend(fills)
+        self._g_depth.set(len(self.queue))
+        if fills:
+            self._g_fill.set(sum(fills) / len(fills))
+        self.rec.heartbeat(
+            phase="serve", step=self.requests_done,
+            nsamp=self.requests_seen, queue_depth=len(self.queue),
+            batch_fill=(round(sum(fills) / len(fills), 4)
+                        if fills else None),
+            dispatches=self.n_dispatch,
+            requests_done=self.requests_done,
+            evals_per_s=round(self.meter.rate(), 1),
+            evals_total=self.meter.total)
+        return n_batches
+
+    def run(self):
+        """Step until the queue is idle (or a graceful preemption is
+        requested), then flush the harvest pipeline. Returns a
+        summary dict."""
+        while self.queue and not preemption_requested():
+            self.step()
+        self.pipe.flush()
+        self._g_depth.set(len(self.queue))
+        # the in-loop heartbeats fire before their cycle's harvest has
+        # committed; one post-flush beat carries the settled figures
+        self.rec.heartbeat(
+            phase="serve", step=self.requests_done,
+            nsamp=self.requests_seen, queue_depth=len(self.queue),
+            dispatches=self.n_dispatch,
+            requests_done=self.requests_done,
+            evals_per_s=round(self.meter.rate(), 1),
+            evals_total=self.meter.total)
+        return self.summary()
+
+    # ------------------------- dispatch ---------------------------- #
+    def _dispatch(self, model, batch):
+        """Dispatch one packed batch; returns the device result array
+        or None after recording a failure. A classic-route demotion is
+        applied in place (cache flush + one re-dispatch of the same
+        host rows); a cpu-rung demotion re-raises with the batch's
+        requests requeued."""
+        like = self.models[model]
+        consts = self._consts[model]
+        placement = self._placement[model]
+        for attempt in (0, 1):
+            compiled = self.cache.executable(like, batch.bucket)
+
+            def thunk():
+                # donated upload INSIDE the supervised thunk: a REAL
+                # device copy of the host rows (devicestate
+                # contract). The supervisor's transient-error retry
+                # re-invokes the whole thunk, so every attempt gets a
+                # fresh buffer — a retry of an already-donated upload
+                # would dereference a deleted buffer on accelerators
+                return compiled(place_resident(batch.rows, placement),
+                                consts)
+
+            try:
+                return self.sup.call(thunk)
+            except PlatformDemotion as d:
+                telemetry.registry().counter(
+                    "serve_demotion", to=str(d.to_level)).inc()
+                if attempt == 0 and apply_demotion(d):
+                    # classic rung: recompile everything below the
+                    # flipped route hatch and retry THIS batch
+                    log.warning("serve batch demoted to classic "
+                                "route; recompiling executables")
+                    self.cache.clear()
+                    continue
+                # cpu rung (or a second demotion): step() requeues
+                # the whole drain cycle's unfinished requests before
+                # the exception crosses the process boundary
+                raise
+            except Exception as exc:   # noqa: BLE001 — per-batch fail
+                self._fail(batch, exc)
+                return None
+        return None
+
+    def _requeue_unfinished(self, snapshot):
+        """Put a demoted drain cycle's unfinished requests back at
+        the FRONT of the queue, in their original order. The
+        in-flight harvest is committed FIRST (its rows are valid and
+        its completions remove requests from ``_pending``); whatever
+        is still pending after that gets its fill counter reset — a
+        requeued request is re-packed from row 0, so a stale partial
+        fill would overshoot ``req.n`` and the request would never
+        finish."""
+        self.pipe.flush()
+        unfinished = [r for r in snapshot if r.rid in self._pending]
+        for req in unfinished:
+            self._pending[req.rid][1] = 0
+        self.queue.extendleft(reversed(unfinished))
+        self._g_depth.set(len(self.queue))
+
+    def _fail(self, batch, exc):
+        log.error("serve batch against %s failed: %r", batch.model,
+                  exc)
+        telemetry.registry().counter("serve_batch_error").inc()
+        seen = set()
+        for req, _, _, _ in batch.segments:
+            if req.rid in seen or req.rid in self.failed:
+                continue
+            seen.add(req.rid)
+            self.failed[req.rid] = f"{type(exc).__name__}: {exc}"
+            self._pending.pop(req.rid, None)
+            self.dropped_requests += 1
+            self._tenant(req.tenant).event(
+                "serve_result", request_id=req.rid, model=req.model,
+                error=self.failed[req.rid])
+
+    # ------------------------- harvest ----------------------------- #
+    def _harvest(self, batch, out):
+        lnl = host_pull(out)
+        for req, req_start, batch_start, n in batch.segments:
+            slot = self._pending.get(req.rid)
+            if slot is None:
+                continue            # request already failed elsewhere
+            buf, filled, _ = slot
+            buf[req_start:req_start + n] = \
+                lnl[batch_start:batch_start + n]
+            slot[1] = filled + n
+            if slot[1] == req.n:
+                self._finish(req, buf, batch)
+
+    def _finish(self, req, lnl, batch):
+        del self._pending[req.rid]
+        self.results[req.rid] = lnl
+        self.requests_done += 1
+        latency_ms = (profiling.monotonic() - req.t_submit) * 1e3
+        self._h_latency.observe(latency_ms)
+        ev = dict(request_id=req.rid, model=req.model, n_theta=req.n,
+                  latency_ms=round(latency_ms, 3),
+                  bucket=batch.bucket,
+                  batch_fill=round(batch.fill, 4),
+                  lnl_max=float(np.max(lnl)))
+        if req.n <= _INLINE_LNL_ROWS:
+            ev["lnl"] = [float(v) for v in lnl]
+        self._tenant(req.tenant).event("serve_result", **ev)
+        self.request_log.append(
+            {"rid": req.rid, "tenant": req.tenant, "model": req.model,
+             "n": req.n, "latency_ms": round(latency_ms, 3),
+             "bucket": batch.bucket, "fill": round(batch.fill, 4)})
+
+    # ------------------------- teardown ---------------------------- #
+    def summary(self):
+        lat = [r["latency_ms"] for r in self.request_log]
+        lat_sorted = sorted(lat)
+
+        def q(p):
+            if not lat_sorted:
+                return None
+            return lat_sorted[min(int(p * len(lat_sorted)),
+                                  len(lat_sorted) - 1)]
+
+        return {
+            "requests_seen": self.requests_seen,
+            "requests_done": self.requests_done,
+            "dropped_requests": self.dropped_requests,
+            "queue_depth": len(self.queue),
+            "dispatches": self.n_dispatch,
+            "sequential_dispatch_equiv": self.n_sequential_equiv,
+            "dispatch_reduction": (
+                round(self.n_sequential_equiv
+                      / max(self.n_dispatch, 1), 2)
+                if self.n_dispatch else None),
+            "mean_batch_fill": (round(sum(self._fills)
+                                      / len(self._fills), 4)
+                                if self._fills else None),
+            "real_rows": self.real_rows,
+            "pad_rows": self.pad_rows,
+            "latency_ms": {"p50": q(0.50), "p90": q(0.90),
+                           "p99": q(0.99),
+                           "max": lat_sorted[-1] if lat_sorted
+                           else None},
+            "evals_per_s": round(self.meter.rate(), 1),
+            "aot": self.cache.stats(),
+        }
+
+    def close(self):
+        """Flush the pipeline, close every tenant stream, and leave
+        the driver's run scope."""
+        self.pipe.flush()
+        final = self.summary()
+        for rec in self._tenant_rec.values():
+            rec.run_end(status="ok")
+            rec.close()
+        self._tenant_rec.clear()
+        self.rec.event("serve_summary", **{
+            k: final[k] for k in ("requests_seen", "requests_done",
+                                  "dropped_requests", "dispatches",
+                                  "dispatch_reduction",
+                                  "mean_batch_fill")})
+        self._stack.close()
+        return final
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,116 @@
+"""Shape-bucketing request packer: many small jobs, one dispatch.
+
+A serving queue holds many small theta batches (a per-pulsar noise
+posterior draw, one CW sky-scan grid chunk) against the same model.
+Dispatching each on its own pays one device round trip per request;
+the packer concatenates their rows IN ARRIVAL ORDER into batches
+padded up to the AOT cache's bucket edges, so N requests become
+ceil(total_rows / capacity) dispatches.
+
+Contracts:
+
+- **fixed serve width**: every batch for a model pads to that
+  model's ONE configured bucket (its serve width). XLA fusion is
+  batch-shape-dependent — the same theta evaluated at batch 1 vs
+  batch 16 can differ at kernel tolerance (measured: ulps generally,
+  up to ~1e-6 through the batched pair-program Gram at ill-
+  conditioned prior corners) — so a queue-depth-adaptive bucket
+  would make a tenant's answer depend on who else was queued. At a
+  FIXED width, a row's result is bit-independent of co-batched
+  content (measured exactly 0), which is what makes the next
+  contract provable;
+- **padding is masked, never mixed in**: padding rows replicate the
+  last real row (always a valid, finite theta — the executable must
+  not see garbage), and the harvest slices out exactly the real
+  rows. Each real row's result is bit-equal to serving that job
+  alone (asserted across fill levels, one-job, and spill cases in
+  ``tests/test_serve.py``; recorded by ``bench.py --serve``);
+- **spill**: a load larger than one width splits across several
+  width-sized batches; a request may span batches, and its result
+  assembles from per-batch segments (``PackedBatch.segments``);
+- **FIFO**: rows are packed in submission order, so earlier requests
+  complete no later than with sequential dispatch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["PackedBatch", "pack_requests"]
+
+
+@dataclass
+class PackedBatch:
+    """One padded dispatch: ``rows`` is the (bucket, ndim) host
+    array (``bucket`` = the model's serve width); ``segments`` maps
+    its real rows back to requests as
+    ``(request, req_row_start, batch_row_start, n_rows)``.
+    ``n_jobs`` counts the requests this batch carries rows for."""
+
+    model: str
+    bucket: int
+    rows: np.ndarray
+    n_real: int
+    segments: list = field(default_factory=list)
+
+    @property
+    def fill(self) -> float:
+        """Real-row fraction of the dispatched batch (1.0 = no
+        padding waste)."""
+        return self.n_real / self.bucket if self.bucket else 0.0
+
+    @property
+    def n_jobs(self) -> int:
+        return len({id(req) for req, _, _, _ in self.segments})
+
+
+def pack_requests(requests, width):
+    """Pack same-model ``requests`` (objects with ``.thetas`` of
+    shape (n, ndim) and ``.model``) into :class:`PackedBatch` es of
+    exactly ``width`` padded rows each. Returns the batch list; every
+    input row appears in exactly one batch, in FIFO order."""
+    if not requests:
+        return []
+    width = int(width)
+    model = requests[0].model
+    ndim = requests[0].thetas.shape[1]
+    batches = []
+    seg_rows: list = []      # accumulating (request, req_start, n)
+    acc = 0
+
+    def emit(n_real):
+        rows = np.empty((width, ndim), dtype=np.float64)
+        out = PackedBatch(model=model, bucket=width, rows=rows,
+                          n_real=n_real)
+        cursor = 0
+        for req, start, n in seg_rows:
+            rows[cursor:cursor + n] = req.thetas[start:start + n]
+            out.segments.append((req, start, cursor, n))
+            cursor += n
+        if width > n_real:
+            # valid-theta padding: replicate the last real row
+            rows[n_real:] = rows[n_real - 1]
+        batches.append(out)
+        seg_rows.clear()
+
+    for req in requests:
+        if req.model != model:
+            raise ValueError(
+                f"pack_requests got mixed models ({req.model!r} vs "
+                f"{model!r}) — group by model first")
+        n = int(req.thetas.shape[0])
+        start = 0
+        while n > 0:
+            take = min(n, width - acc)
+            seg_rows.append((req, start, take))
+            acc += take
+            start += take
+            n -= take
+            if acc == width:
+                emit(acc)
+                acc = 0
+    if acc:
+        emit(acc)
+    return batches

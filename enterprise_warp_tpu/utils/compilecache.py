@@ -8,13 +8,51 @@ executable across processes (verified working through the remote-compile
 backend: ~30x faster reload), so steady-state operation of a deployed
 installation compiles each program once per machine.
 
+The serving layer (``enterprise_warp_tpu/serve``) leans on this twice:
+its AOT executables (`jit(...).lower().compile()`) are keyed in-process
+per (model topology, shape bucket, backend), and the SAME lowering goes
+through this persistent cache, so a fresh replica that warms the bucket
+set (``tools/warm_cache.py --serve``) reloads every executable instead
+of compiling it.
+
 Opt-out with ``EWT_NO_COMPILE_CACHE=1``; relocate with
-``EWT_COMPILE_CACHE=<dir>`` (default ``~/.cache/ewt_xla``).
+``EWT_COMPILE_CACHE=<dir>`` (default ``~/.cache/ewt_xla_<platform>``).
+
+Two arming paths:
+
+- :func:`enable_compilation_cache` — the post-import path
+  (``jax.config.update``): works even when something (sitecustomize)
+  imported jax before us. Used by ``cli.py`` and ``bench.py``.
+- :func:`arm_env` — the import-free path for ``tools/_bootstrap.py``:
+  sets the ``JAX_COMPILATION_CACHE_DIR``/``JAX_PERSISTENT_CACHE_*``
+  environment variables so the cache is armed if-and-when jax is
+  imported, without this call importing jax itself (the jax-free
+  tools — lint, report, sentinel, campaign — must stay jax-free).
+  When jax is ALREADY in ``sys.modules`` it falls through to the
+  config-update path, because jax reads those env vars only once at
+  import.
 """
 
 from __future__ import annotations
 
 import os
+import sys
+
+
+def _resolve_dir(cache_dir=None):
+    """The cache directory the knobs select (no side effects)."""
+    if cache_dir is not None:
+        return cache_dir
+    # scope by the platform hint so CPU-forced measurement
+    # subprocesses never load AOT entries compiled under the device
+    # terminal's target flags (observed: XLA:CPU machine-feature
+    # mismatch warnings threatening SIGILL)
+    plat = (os.environ.get("JAX_PLATFORMS")
+            or os.environ.get("EWT_PLATFORM") or "default")
+    return os.environ.get(
+        "EWT_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     f"ewt_xla_{plat.replace(',', '_')}"))
 
 
 def enable_compilation_cache(cache_dir=None):
@@ -23,17 +61,7 @@ def enable_compilation_cache(cache_dir=None):
     multiple times and before/after backend initialization."""
     if os.environ.get("EWT_NO_COMPILE_CACHE"):
         return None
-    if cache_dir is None:
-        # scope by the platform hint so CPU-forced measurement
-        # subprocesses never load AOT entries compiled under the device
-        # terminal's target flags (observed: XLA:CPU machine-feature
-        # mismatch warnings threatening SIGILL)
-        plat = (os.environ.get("JAX_PLATFORMS")
-                or os.environ.get("EWT_PLATFORM") or "default")
-        cache_dir = os.environ.get(
-            "EWT_COMPILE_CACHE",
-            os.path.join(os.path.expanduser("~"), ".cache",
-                         f"ewt_xla_{plat.replace(',', '_')}"))
+    cache_dir = _resolve_dir(cache_dir)
     try:
         import jax
         jax.config.update("jax_compilation_cache_dir", cache_dir)
@@ -47,3 +75,38 @@ def enable_compilation_cache(cache_dir=None):
     except Exception:   # noqa: BLE001 — older jax / readonly FS
         return None
     return cache_dir
+
+
+def arm_env(cache_dir=None):
+    """Arm the persistent cache WITHOUT importing jax (see module
+    docstring). Returns the directory armed, or None when disabled.
+    User-set ``JAX_COMPILATION_CACHE_DIR``/``JAX_PERSISTENT_CACHE_*``
+    values win (``setdefault``)."""
+    if os.environ.get("EWT_NO_COMPILE_CACHE"):
+        return None
+    if "jax" in sys.modules:
+        # env vars were read at jax import; only config.update works now
+        return enable_compilation_cache(cache_dir)
+    cache_dir = _resolve_dir(cache_dir)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES",
+                          "-1")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.2")
+    return os.environ["JAX_COMPILATION_CACHE_DIR"]
+
+
+def cache_dir_in_use():
+    """The compile-cache directory this process is actually using
+    (bench/serve provenance), or None when the cache is off. Prefers
+    the live jax config over the env var — the two can diverge when
+    something called ``jax.config.update`` directly."""
+    if os.environ.get("EWT_NO_COMPILE_CACHE"):
+        return None
+    if "jax" in sys.modules:
+        try:
+            import jax
+            return jax.config.jax_compilation_cache_dir or None
+        except Exception:   # noqa: BLE001 — config entry renamed
+            pass
+    return os.environ.get("JAX_COMPILATION_CACHE_DIR") or None

@@ -56,7 +56,7 @@ __all__ = ["enabled", "registry", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "traced", "RunRecorder", "run_scope",
            "active_recorder", "dispatch_stats", "pallas_path_summary",
            "cost_analysis_enabled", "set_flight_hook", "last_lineage",
-           "LINEAGE_REASONS"]
+           "LINEAGE_REASONS", "compile_cache_stats", "watch_compile"]
 
 
 def enabled() -> bool:
@@ -317,6 +317,88 @@ def harvest_cost_analysis(jitted, label, args, kwargs):
         return None
 
 
+# ------------------------------------------------------------------ #
+#  persistent compile-cache effectiveness                              #
+# ------------------------------------------------------------------ #
+# jax's persistent compilation cache emits monitoring events on every
+# backend-compile request (cache_hits when the executable was reloaded
+# from disk, cache_misses when XLA really compiled). A process-wide
+# listener attributes them to the traced fn in flight, so a warm
+# reload (a compile event with near-zero wall) is distinguishable from
+# a genuine compile: ``compile_cache_hit/miss{fn=}`` counters in the
+# registry, plus a ``cache_hit`` bool on each ``compile`` event
+# (None when the persistent cache is disabled or jax predates the
+# monitoring API). tools/report.py folds these into its compile
+# section; the serve bench reads them for its cold/warm provenance.
+
+_CACHE_WATCH: list = []          # stack of in-flight traced labels
+_CACHE_VERDICT: dict = {}        # label -> "hit" | "miss" (last event)
+_CACHE_LISTENER = [False]
+
+
+def _arm_cache_listener():
+    """Register the jax.monitoring listener once per process. Never
+    raises — compile-cache telemetry is observability, not control
+    flow."""
+    if _CACHE_LISTENER[0]:
+        return
+    _CACHE_LISTENER[0] = True
+    try:
+        from jax import monitoring as _jmon
+
+        def _on_event(event, **kw):
+            if event == "/jax/compilation_cache/cache_hits":
+                kind = "hit"
+            elif event == "/jax/compilation_cache/cache_misses":
+                kind = "miss"
+            else:
+                return
+            label = _CACHE_WATCH[-1] if _CACHE_WATCH else "untraced"
+            _REGISTRY.counter(f"compile_cache_{kind}",
+                              fn=label).inc()
+            _CACHE_VERDICT[label] = kind
+
+        _jmon.register_event_listener(_on_event)
+    except Exception:   # noqa: BLE001 — older jax without monitoring
+        pass
+
+
+@contextlib.contextmanager
+def watch_compile(label):
+    """Attribute persistent-compile-cache monitoring events fired
+    inside the block to ``label`` (an explicit lowering path — the
+    serving layer's AOT ``.lower().compile()`` — rather than a
+    traced() call). Yields a dict that carries ``cache_hit``
+    (True/False/None) after the block exits."""
+    _arm_cache_listener()
+    _CACHE_VERDICT.pop(label, None)
+    _CACHE_WATCH.append(label)
+    box = {"cache_hit": None}
+    try:
+        yield box
+    finally:
+        _CACHE_WATCH.pop()
+        v = _CACHE_VERDICT.pop(label, None)
+        box["cache_hit"] = None if v is None else (v == "hit")
+
+
+def compile_cache_stats():
+    """Compact view of the ``compile_cache_hit/miss{fn=}`` counters:
+    ``{"hits": N, "misses": M, "per_fn": {fn: {"hit": n, "miss": m}}}``
+    — all zeros when the persistent cache never fired (disabled, or
+    nothing compiled yet)."""
+    snap = _REGISTRY.snapshot()["counters"]
+    out = {"hits": 0, "misses": 0, "per_fn": {}}
+    for key, count in snap.items():
+        for kind, total in (("hit", "hits"), ("miss", "misses")):
+            prefix = f"compile_cache_{kind}{{fn="
+            if key.startswith(prefix):
+                fn = key[len(prefix):-1]
+                out[total] += count
+                out["per_fn"].setdefault(fn, {})[kind] = count
+    return out
+
+
 def traced(fn, *, name: str | None = None, cost: bool | None = None,
            **jit_kwargs):
     """``jax.jit`` with compile/retrace telemetry.
@@ -355,6 +437,7 @@ def traced(fn, *, name: str | None = None, cost: bool | None = None,
     jitted = jax.jit(_inner, **jit_kwargs)
     if not enabled():
         return jitted
+    _arm_cache_listener()
 
     @functools.wraps(fn)
     def call(*args, **kwargs):
@@ -362,17 +445,28 @@ def traced(fn, *, name: str | None = None, cost: bool | None = None,
             return jitted(*args, **kwargs)
         tracing[0] = False
         t0 = time.perf_counter()
-        out = jitted(*args, **kwargs)
+        _CACHE_VERDICT.pop(label, None)
+        _CACHE_WATCH.append(label)
+        try:
+            out = jitted(*args, **kwargs)
+        finally:
+            _CACHE_WATCH.pop()
         # under jax.disable_jit() the Python body runs EVERY call —
         # that is eager debugging, not a retrace; counting it would
         # flood the stream with bogus compile events
         if tracing[0] and not jax.config.jax_disable_jit:
             wall = time.perf_counter() - t0
             _REGISTRY.counter("retraces", fn=label).inc()
+            # persistent-cache verdict for THIS (re)trace: the
+            # monitoring listener saw a hit/miss while this call was
+            # in flight (None = persistent cache not in play)
+            verdict = _CACHE_VERDICT.pop(label, None)
             rec = active_recorder()
             if rec is not None:
                 rec.event("compile", fn=label, wall_s=round(wall, 4),
-                          arg_shapes=_arg_shapes(args))
+                          arg_shapes=_arg_shapes(args),
+                          cache_hit=(None if verdict is None
+                                     else verdict == "hit"))
             if cost if cost is not None else cost_analysis_enabled():
                 harvest_cost_analysis(jitted, label, args, kwargs)
         return out

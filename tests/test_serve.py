@@ -1,0 +1,588 @@
+"""Serving-layer tests: AOT executable cache, shape-bucketed packing,
+the ServeDriver, bit-equality of packed vs single-job results, the
+compile-cache telemetry, and the report/campaign/sentinel folds
+(docs/serving.md)."""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.utils import telemetry
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"ewt_tool_{name}", str(REPO_ROOT / "tools" / f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def small_like():
+    """A small (96-TOA) sampled-white pulsar likelihood — cheap to
+    compile at several buckets, real enough to exercise the whole
+    build fingerprint."""
+    from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                            build_pulsar_likelihood)
+    from enterprise_warp_tpu.sim.noise import make_fake_pulsar
+
+    psr = make_fake_pulsar(name="A", ntoa=96, backends=("X", "Y"),
+                           freqs_mhz=(1400.0,), seed=3)
+    psr.residuals = psr.toaerrs * np.random.default_rng(
+        3).standard_normal(96)
+    m = StandardModels(psr=psr)
+    tl = TermList(psr, [m.efac("by_backend"),
+                        m.spin_noise("powerlaw_5_nfreqs")])
+    return build_pulsar_likelihood(psr, tl)
+
+
+def _jobs(like, sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(f"t{i % 3}",
+             np.asarray(like.sample_prior(rng, n), dtype=np.float64))
+            for i, n in enumerate(sizes)]
+
+
+# ------------------------------------------------------------------ #
+#  buckets + packer                                                   #
+# ------------------------------------------------------------------ #
+
+class TestBuckets:
+    def test_bucket_for(self):
+        from enterprise_warp_tpu.serve import bucket_for
+        assert bucket_for(1, (1, 4, 16)) == 1
+        assert bucket_for(3, (1, 4, 16)) == 4
+        assert bucket_for(16, (1, 4, 16)) == 16
+        assert bucket_for(17, (1, 4, 16)) is None
+
+    def test_env_override(self, monkeypatch):
+        from enterprise_warp_tpu.serve import batch_buckets
+        monkeypatch.setenv("EWT_SERVE_BUCKETS", "8,2,8")
+        assert batch_buckets() == (2, 8)
+        monkeypatch.delenv("EWT_SERVE_BUCKETS")
+        from enterprise_warp_tpu.serve import DEFAULT_BUCKETS
+        assert batch_buckets() == DEFAULT_BUCKETS
+
+
+class _FakeReq:
+    def __init__(self, rid, thetas, model="m"):
+        self.rid = rid
+        self.model = model
+        self.thetas = np.asarray(thetas, dtype=np.float64)
+
+
+class TestPacker:
+    def test_pack_pads_to_width(self):
+        from enterprise_warp_tpu.serve import pack_requests
+        reqs = [_FakeReq("a", np.ones((3, 2))),
+                _FakeReq("b", 2 * np.ones((2, 2)))]
+        batches = pack_requests(reqs, 8)
+        assert len(batches) == 1
+        b = batches[0]
+        assert b.bucket == 8 and b.n_real == 5 and b.n_jobs == 2
+        assert b.fill == 5 / 8
+        # padding replicates the LAST real row (a valid theta)
+        assert np.array_equal(b.rows[5:], np.tile(b.rows[4:5], (3, 1)))
+
+    def test_spill_and_fifo(self):
+        from enterprise_warp_tpu.serve import pack_requests
+        reqs = [_FakeReq("a", np.arange(10).reshape(5, 2)),
+                _FakeReq("b", np.arange(12).reshape(6, 2) + 100.0)]
+        batches = pack_requests(reqs, 4)
+        assert [b.n_real for b in batches] == [4, 4, 3]
+        # request 'a' spans batches 0 and 1; rows reassemble exactly
+        got = np.empty((5, 2))
+        for b in batches:
+            for req, rs, bs, n in b.segments:
+                if req.rid == "a":
+                    got[rs:rs + n] = b.rows[bs:bs + n]
+        assert np.array_equal(got, reqs[0].thetas)
+
+    def test_mixed_models_rejected(self):
+        from enterprise_warp_tpu.serve import pack_requests
+        with pytest.raises(ValueError, match="mixed models"):
+            pack_requests([_FakeReq("a", np.ones((1, 2)), "m1"),
+                           _FakeReq("b", np.ones((1, 2)), "m2")], 4)
+
+
+# ------------------------------------------------------------------ #
+#  fingerprints                                                       #
+# ------------------------------------------------------------------ #
+
+class TestFingerprints:
+    def test_rebuild_shares_and_data_differs(self, small_like):
+        from enterprise_warp_tpu.models import (StandardModels,
+                                                TermList,
+                                                build_pulsar_likelihood)
+        from enterprise_warp_tpu.models.build import \
+            topology_fingerprint
+        from enterprise_warp_tpu.sim.noise import make_fake_pulsar
+
+        psr = small_like.psr
+        m = StandardModels(psr=psr)
+        tl = TermList(psr, [m.efac("by_backend"),
+                            m.spin_noise("powerlaw_5_nfreqs")])
+        rebuilt = build_pulsar_likelihood(psr, tl)
+        assert topology_fingerprint(rebuilt) == \
+            topology_fingerprint(small_like)
+        other = make_fake_pulsar(name="B", ntoa=96,
+                                 backends=("X", "Y"),
+                                 freqs_mhz=(1400.0,), seed=9)
+        other.residuals = other.toaerrs * np.random.default_rng(
+            9).standard_normal(96)
+        m2 = StandardModels(psr=other)
+        tl2 = TermList(other, [m2.efac("by_backend"),
+                               m2.spin_noise("powerlaw_5_nfreqs")])
+        assert topology_fingerprint(
+            build_pulsar_likelihood(other, tl2)) != \
+            topology_fingerprint(small_like)
+
+    def test_route_knob_changes_key(self, small_like, monkeypatch):
+        from enterprise_warp_tpu.models.build import \
+            topology_fingerprint
+        base = topology_fingerprint(small_like)
+        # flip to a value genuinely different from the ambient one (an
+        # earlier demotion test may have left EWT_PALLAS=0 behind)
+        flipped = "1" if os.environ.get("EWT_PALLAS") == "0" else "0"
+        monkeypatch.setenv("EWT_PALLAS", flipped)
+        assert topology_fingerprint(small_like) != base
+
+    def test_params_fingerprint_shared_with_nested(self, small_like):
+        from enterprise_warp_tpu.models.build import params_fingerprint
+        from enterprise_warp_tpu.samplers.nested import \
+            _params_fingerprint
+        assert _params_fingerprint(small_like) == \
+            params_fingerprint(small_like)
+
+    def test_instance_keyed_without_build(self):
+        from enterprise_warp_tpu.models.build import \
+            topology_fingerprint
+        from tests.test_samplers import GaussianLike
+        a = GaussianLike([0.0], [1.0])
+        b = GaussianLike([0.0], [1.0])
+        # identical params but un-enumerable closures: never shared
+        assert topology_fingerprint(a) != topology_fingerprint(b)
+        assert topology_fingerprint(a) == topology_fingerprint(a)
+
+
+# ------------------------------------------------------------------ #
+#  AOT cache                                                          #
+# ------------------------------------------------------------------ #
+
+class TestAOTCache:
+    def test_hit_miss_and_warm(self, small_like):
+        from enterprise_warp_tpu.serve import AOTExecutableCache
+        cache = AOTExecutableCache((1, 4))
+        snap0 = telemetry.registry().snapshot()["counters"]
+        h0 = snap0.get("aot_cache{outcome=hit}", 0)
+        m0 = snap0.get("aot_cache{outcome=miss}", 0)
+        e1 = cache.executable(small_like, 4)
+        e2 = cache.executable(small_like, 4)
+        assert e1 is e2
+        snap = telemetry.registry().snapshot()["counters"]
+        assert snap["aot_cache{outcome=miss}"] == m0 + 1
+        assert snap["aot_cache{outcome=hit}"] == h0 + 1
+        walls = cache.warm(small_like)
+        assert set(walls) == {1, 4}
+        assert walls[4] == 0.0          # already compiled
+        assert walls[1] > 0.0
+        assert len(cache._exec) == 2
+        cache.clear()
+        assert not cache._exec and not cache._fp
+
+    def test_invalid_bucket(self, small_like):
+        from enterprise_warp_tpu.serve import AOTExecutableCache
+        with pytest.raises(ValueError, match="positive"):
+            AOTExecutableCache((1, 4)).executable(small_like, 0)
+
+
+# ------------------------------------------------------------------ #
+#  driver: correctness, bit-equality, events                          #
+# ------------------------------------------------------------------ #
+
+def _drive(root, like, jobs, width=8, buckets=(1, 2, 4, 8)):
+    from enterprise_warp_tpu.serve import ServeDriver
+    with ServeDriver(str(root), buckets=buckets) as drv:
+        drv.register("m0", like, width=width)
+        rids = [drv.submit(t, "m0", th) for t, th in jobs]
+        summary = drv.run()
+    return drv, rids, summary
+
+
+class TestServeDriver:
+    def test_packed_bit_equal_to_single_job_path(self, small_like,
+                                                 tmp_path):
+        # one-job, multi-row, and over-capacity-spill cases packed
+        # together across bucket-fill levels
+        jobs = _jobs(small_like, [1, 2, 3, 4, 1, 19])
+        drv, rids, summary = _drive(tmp_path / "pack", small_like,
+                                    jobs)
+        assert summary["dropped_requests"] == 0
+        assert summary["requests_done"] == len(jobs)
+        # every job served ALONE (the single-job path: same width)
+        for k, (tenant, th) in enumerate(jobs):
+            d2, r2, _ = _drive(tmp_path / f"alone{k}", small_like,
+                               [(tenant, th)])
+            assert np.array_equal(d2.results[r2[0]],
+                                  drv.results[rids[k]]), \
+                f"job {k}: packed result differs from single-job path"
+        # and correct vs the direct eval (kernel tolerance, not bits:
+        # XLA fusion is batch-shape-dependent — docs/serving.md)
+        for k, (tenant, th) in enumerate(jobs):
+            ref = np.asarray(small_like.loglike_batch(th))
+            assert np.allclose(drv.results[rids[k]], ref,
+                               rtol=1e-6, atol=1e-6)
+
+    def test_dispatch_amortization(self, small_like, tmp_path):
+        jobs = _jobs(small_like, [1] * 16)      # 16 one-row jobs
+        _, _, summary = _drive(tmp_path / "amort", small_like, jobs)
+        assert summary["dispatches"] == 2       # 16 rows / width 8
+        assert summary["sequential_dispatch_equiv"] == 16
+        assert summary["dispatch_reduction"] == 8.0
+        assert summary["mean_batch_fill"] == 1.0
+
+    def test_streams_and_heartbeats(self, small_like, tmp_path):
+        report = _load_tool("report")
+        jobs = _jobs(small_like, [2, 1, 3])
+        drv, rids, _ = _drive(tmp_path / "ev", small_like, jobs)
+        root = tmp_path / "ev"
+        events, dropped = report.load_events(
+            str(root / "events.jsonl"))
+        assert dropped == 0
+        hb = [e for e in events if e["type"] == "heartbeat"]
+        assert hb and hb[-1]["queue_depth"] == 0
+        assert hb[-1]["requests_done"] == 3
+        assert any(e.get("batch_fill") is not None for e in hb)
+        assert any(e["type"] == "serve_summary" for e in events)
+        # driver + tenant streams are schema-clean (--check)
+        import io
+        for stream in [root / "events.jsonl"] + sorted(
+                (root / "tenants").glob("*/events.jsonl")):
+            problems = report.check_stream(str(stream),
+                                           out=io.StringIO())
+            assert problems == 0, stream
+        # tenant stream folds into a serve section
+        t0 = [s for s in (root / "tenants").iterdir()][0]
+        evs, _ = report.load_events(str(t0 / "events.jsonl"))
+        rep = report.build_report(evs)
+        assert rep["serve"] is not None
+        assert rep["serve"]["errors"] == 0
+        assert rep["serve"]["latency_ms"]["p50"] is not None
+
+    def test_demotion_retries_batch_in_place(self, small_like,
+                                             tmp_path, monkeypatch):
+        from enterprise_warp_tpu.resilience.supervisor import \
+            PlatformDemotion
+        from enterprise_warp_tpu.serve import ServeDriver
+        monkeypatch.setenv("EWT_PALLAS", "1")   # restore after test
+        with ServeDriver(str(tmp_path / "dem"),
+                         buckets=(1, 2, 4, 8)) as drv:
+            drv.register("m0", small_like, width=8)
+            real_call = drv.sup.call
+            state = {"raised": False}
+
+            def flaky_call(thunk, **kw):
+                if not state["raised"]:
+                    state["raised"] = True
+                    raise PlatformDemotion("mega", "classic",
+                                           "serve.dispatch")
+                return real_call(thunk, **kw)
+
+            monkeypatch.setattr(drv.sup, "call", flaky_call)
+            jobs = _jobs(small_like, [2, 3])
+            rids = [drv.submit(t, "m0", th) for t, th in jobs]
+            summary = drv.run()
+        assert state["raised"]
+        assert os.environ.get("EWT_PALLAS") == "0"  # applied rung
+        assert summary["dropped_requests"] == 0
+        for rid, (t, th) in zip(rids, jobs):
+            assert np.allclose(
+                drv.results[rid],
+                np.asarray(small_like.loglike_batch(th)),
+                rtol=1e-6, atol=1e-6)
+
+    def test_cpu_rung_demotion_requeues_and_resumes(self, small_like,
+                                                    tmp_path,
+                                                    monkeypatch):
+        """A cpu-rung demotion re-raises with every in-flight request
+        requeued — including a SPILLED request whose earlier batch
+        already harvested some rows (its fill counter must reset or
+        the resume would never finish it)."""
+        from enterprise_warp_tpu.resilience.supervisor import \
+            PlatformDemotion
+        from enterprise_warp_tpu.serve import ServeDriver
+        jobs = _jobs(small_like, [3, 19, 2])    # job 1 spills batches
+        with ServeDriver(str(tmp_path / "cpu_dem"),
+                         buckets=(1, 2, 4, 8)) as drv:
+            drv.register("m0", small_like, width=8)
+            rids = [drv.submit(t, "m0", th) for t, th in jobs]
+            real_call = drv.sup.call
+            state = {"n": 0}
+
+            def flaky_call(thunk, **kw):
+                state["n"] += 1
+                if state["n"] == 2:     # second batch of the drain
+                    raise PlatformDemotion("classic", None,
+                                           "serve.dispatch")
+                return real_call(thunk, **kw)
+
+            monkeypatch.setattr(drv.sup, "call", flaky_call)
+            with pytest.raises(PlatformDemotion):
+                drv.run()
+            assert len(drv.queue) > 0           # requeued, not lost
+            # post-demotion re-entry: restore the supervisor and
+            # drain the requeued work in the same driver
+            monkeypatch.setattr(drv.sup, "call", real_call)
+            summary = drv.run()
+        assert summary["dropped_requests"] == 0
+        assert summary["requests_done"] == len(jobs)
+        for rid, (t, th) in zip(rids, jobs):
+            assert np.allclose(
+                drv.results[rid],
+                np.asarray(small_like.loglike_batch(th)),
+                rtol=1e-6, atol=1e-6)
+
+    def test_serve_with_telemetry_disabled(self, small_like,
+                                           tmp_path, monkeypatch):
+        """EWT_TELEMETRY=0 must not break the serving layer (the AOT
+        path lowers whatever traced() returns — with telemetry off
+        that is the bare jit object)."""
+        monkeypatch.setenv("EWT_TELEMETRY", "0")
+        jobs = _jobs(small_like, [2, 1])
+        drv, rids, summary = _drive(tmp_path / "notel", small_like,
+                                    jobs)
+        assert summary["dropped_requests"] == 0
+        assert summary["requests_done"] == 2
+        assert not (tmp_path / "notel" / "events.jsonl").exists()
+
+    def test_unregistered_model_and_bad_shape(self, small_like,
+                                              tmp_path):
+        from enterprise_warp_tpu.serve import ServeDriver
+        with ServeDriver(str(tmp_path / "bad"),
+                         buckets=(1, 8)) as drv:
+            drv.register("m0", small_like)
+            with pytest.raises(KeyError, match="not registered"):
+                drv.submit("t", "nope", np.ones((1, small_like.ndim)))
+            with pytest.raises(ValueError, match="dims"):
+                drv.submit("t", "m0", np.ones((1, 2)))
+            with pytest.raises(ValueError, match="configured bucket"):
+                drv.register("m1", small_like, width=3)
+
+
+# ------------------------------------------------------------------ #
+#  compile-cache telemetry                                            #
+# ------------------------------------------------------------------ #
+
+class TestCompileCacheTelemetry:
+    def test_verdicts_attributed_per_fn(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        prev = jax.config.jax_compilation_cache_dir
+        prev_t = jax.config.jax_persistent_cache_min_compile_time_secs
+        prev_s = jax.config.jax_persistent_cache_min_entry_size_bytes
+        jax.config.update("jax_compilation_cache_dir",
+                          str(tmp_path / "xla"))
+        # the tiny probe compiles in ms: drop the persistence
+        # thresholds or the write (whose event IS the miss signal)
+        # never happens
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        # jax memoizes is-the-cache-enabled at the FIRST compile of
+        # the process; earlier tests compiled with no cache dir, so
+        # the fresh dir needs an explicit reset to take effect
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+        try:
+            telemetry._arm_cache_listener()
+
+            # a FRESH function object per lowering (same name, same
+            # program) — the warm-replica shape: the in-memory
+            # executable memo misses, the persistent cache hits
+            def mk():
+                def probe(x):
+                    return jnp.sin(x) * 2.0 + jnp.cos(x)
+                return probe
+
+            with telemetry.watch_compile("serve_test_fn") as v1:
+                jax.jit(mk()).lower(
+                    jax.ShapeDtypeStruct((33,), np.float64)).compile()
+            with telemetry.watch_compile("serve_test_fn") as v2:
+                jax.jit(mk()).lower(
+                    jax.ShapeDtypeStruct((33,), np.float64)).compile()
+            assert v1["cache_hit"] is False
+            assert v2["cache_hit"] is True
+            snap = telemetry.registry().snapshot()["counters"]
+            assert snap[
+                "compile_cache_miss{fn=serve_test_fn}"] >= 1
+            assert snap["compile_cache_hit{fn=serve_test_fn}"] >= 1
+            stats = telemetry.compile_cache_stats()
+            assert stats["per_fn"]["serve_test_fn"]["hit"] >= 1
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prev_t)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", prev_s)
+            _cc.reset_cache()
+
+    def test_compile_event_carries_cache_hit(self, tmp_path):
+        import jax.numpy as jnp
+
+        rec = telemetry.RunRecorder(str(tmp_path / "run"))
+        telemetry._ACTIVE.append(rec)
+        try:
+            fn = telemetry.traced(lambda x: jnp.sum(x * 3.0),
+                                  name="cachehit_probe")
+            fn(jnp.arange(7.0))
+        finally:
+            telemetry._ACTIVE.remove(rec)
+            rec.close()
+        events = [json.loads(ln) for ln in
+                  (tmp_path / "run" / "events.jsonl")
+                  .read_text().splitlines()]
+        comp = [e for e in events if e["type"] == "compile"
+                and e["fn"] == "cachehit_probe"]
+        assert comp and "cache_hit" in comp[0]
+
+
+# ------------------------------------------------------------------ #
+#  report compile fold + campaign + sentinel gates                    #
+# ------------------------------------------------------------------ #
+
+class TestFoldsAndGates:
+    def test_report_compile_cache_fold(self):
+        report = _load_tool("report")
+        t0 = 1000.0
+        events = [
+            {"t": t0, "type": "run_start", "run_id": "r1"},
+            {"t": t0 + 1, "type": "compile", "fn": "a",
+             "wall_s": 2.0, "cache_hit": False},
+            {"t": t0 + 2, "type": "compile", "fn": "a",
+             "wall_s": 0.05, "cache_hit": True},
+            {"t": t0 + 3, "type": "compile", "fn": "b",
+             "wall_s": 1.0},
+            {"t": t0 + 4, "type": "run_end", "status": "ok"},
+        ]
+        rep = report.build_report(events)
+        assert rep["compiles"]["cache_hits"] == 1
+        assert rep["compiles"]["cache_misses"] == 1
+        assert rep["compiles"]["per_fn"]["a"]["cache_hits"] == 1
+        assert "cache_hits" not in rep["compiles"]["per_fn"]["b"]
+
+    def test_campaign_folds_serve_heartbeats(self, tmp_path):
+        campaign = _load_tool("campaign")
+        run = tmp_path / "serve_run"
+        os.makedirs(run)
+        t0 = 1000.0
+        with open(run / "events.jsonl", "w") as fh:
+            for ev in [
+                {"t": t0, "type": "run_start", "run_id": "s1",
+                 "campaign": "c1", "sampler": "serve"},
+                {"t": t0 + 0.1, "type": "run_lineage", "run_id": "s1",
+                 "campaign": "c1", "parent": None, "reason": "fresh"},
+                {"t": t0 + 1, "type": "heartbeat", "phase": "serve",
+                 "step": 5, "nsamp": 10, "queue_depth": 3,
+                 "batch_fill": 0.75, "requests_done": 5,
+                 "dispatches": 2, "evals_per_s": 100.0},
+                {"t": t0 + 2, "type": "run_end", "status": "ok"},
+            ]:
+                fh.write(json.dumps(ev) + "\n")
+        rep = campaign.fold_campaign(str(tmp_path), now=t0 + 3)
+        (row,) = rep["runs"]
+        assert row["sampler"] == "serve"
+        assert row["queue_depth"] == 3
+        assert row["batch_fill"] == 0.75
+        assert row["requests_done"] == 5
+        assert row["progress"] == 0.5
+
+    def _serve_record(self):
+        return {
+            "metric": "serve_multi_tenant",
+            "warm_speedup": 120.0,
+            "dispatch_reduction": 9.0,
+            "padded_bit_equal": True,
+            "trace": {"dropped_requests": 0,
+                      "latency_ms": {"p50": 15.0, "p99": 30.0}},
+        }
+
+    def test_sentinel_serve_gate(self, tmp_path):
+        sentinel = _load_tool("sentinel")
+        bd = tmp_path / "bench"
+        os.makedirs(bd)
+        # missing record -> warn, never a silent pass
+        assert sentinel.gate_serve(str(bd))["status"] == "warn"
+        with open(bd / "BENCH_SERVE.json", "w") as fh:
+            json.dump(self._serve_record(), fh)
+        assert sentinel.gate_serve(str(bd))["status"] == "pass"
+        for mutate, frag in [
+            (lambda d: d.update(warm_speedup=3.0), "warm_speedup"),
+            (lambda d: d.update(dispatch_reduction=2.0),
+             "dispatch_reduction"),
+            (lambda d: d.update(padded_bit_equal=False),
+             "bit-equal"),
+            (lambda d: d["trace"].update(dropped_requests=2),
+             "dropped"),
+            (lambda d: d["trace"]["latency_ms"].update(p50=5000.0),
+             "p50"),
+        ]:
+            doc = self._serve_record()
+            mutate(doc)
+            with open(bd / "BENCH_SERVE.json", "w") as fh:
+                json.dump(doc, fh)
+            g = sentinel.gate_serve(str(bd))
+            assert g["status"] == "fail", frag
+            assert frag in g["detail"]
+
+    def test_sentinel_committed_history_passes(self):
+        """The committed BENCH_SERVE.json must satisfy its own gate
+        (the acceptance contract of this layer)."""
+        sentinel = _load_tool("sentinel")
+        g = sentinel.gate_serve(str(REPO_ROOT))
+        assert g["status"] == "pass", g["detail"]
+
+
+# ------------------------------------------------------------------ #
+#  CLI e2e (self-contained synthetic dataset)                         #
+# ------------------------------------------------------------------ #
+
+def test_serve_cli_end_to_end(tmp_path, monkeypatch, capsys):
+    from enterprise_warp_tpu.io.writers import save_pulsar_pair
+    from enterprise_warp_tpu.sim import inject_white, make_fake_pulsar
+
+    psr = make_fake_pulsar(ntoa=64, backends=("RX",), toaerr_us=1.0,
+                           seed=200)
+    inject_white(psr, efac={"RX": 1.2},
+                 rng=np.random.default_rng(201))
+    save_pulsar_pair(psr, str(tmp_path / "data"))
+    (tmp_path / "nm.json").write_text(
+        json.dumps({"universal": {"efac": "by_backend"}}))
+    prfile = tmp_path / "serve.dat"
+    prfile.write_text(
+        "paramfile_label: servetest\ndatadir: data/\nout: out/\n"
+        "array_analysis: False\nsampler: ptmcmcsampler\nnsamp: 10\n"
+        "{0}\nnoise_model_file: nm.json\n")
+    monkeypatch.chdir(tmp_path)
+
+    from enterprise_warp_tpu import cli
+    rc = cli.main(["serve", "-p", str(prfile), "--synthetic", "9",
+                   "--tenants", "2", "--buckets", "1,4", "--warm",
+                   "--max-theta", "2", "--seed", "5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["requests_done"] == 9
+    assert summary["dropped_requests"] == 0
+    assert summary["dispatches"] < 9
+    root = pathlib.Path(summary["root"])
+    assert (root / "events.jsonl").exists()
+    assert list((root / "tenants").glob("*/events.jsonl"))

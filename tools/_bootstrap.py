@@ -10,7 +10,12 @@ tools need before importing jax (previously duplicated per tool):
   ``EWT_PLATFORM=cpu``) strips PJRT plugin site dirs from ``sys.path``
   so a dead accelerator tunnel cannot hang jax backend discovery;
 - puts the repo root on ``sys.path`` so ``enterprise_warp_tpu`` and
-  ``__graft_entry__`` import from the checkout.
+  ``__graft_entry__`` import from the checkout;
+- arms the persistent XLA compile cache through the env-only path
+  (``utils/compilecache.py:arm_env``, loaded by file path so this
+  module stays jax-import-free) — tools that never import jax are
+  untouched, tools that do stop re-paying compiles across
+  invocations. ``EWT_NO_COMPILE_CACHE=1`` opts out.
 
 Usage (top of a tool, before any jax import)::
 
@@ -37,13 +42,32 @@ def load_pathguard():
     return mod
 
 
+def arm_compile_cache():
+    """Arm the persistent XLA compile cache via the env-only path (no
+    jax import from here — see module docstring). Returns the cache
+    dir or None. Never raises: a tool must run even when the cache
+    module is missing or the FS is readonly."""
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_ewt_compilecache",
+            os.path.join(REPO, "enterprise_warp_tpu", "utils",
+                         "compilecache.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.arm_env()
+    except Exception:   # noqa: BLE001 — cache arming is best-effort
+        return None
+
+
 def ensure_repo_path():
-    """Apply the guard (CPU-only invocations) and put the repo root on
-    ``sys.path``. Returns the repo root."""
+    """Apply the guard (CPU-only invocations), arm the compile cache
+    (env-only — jax-free tools stay jax-free), and put the repo root
+    on ``sys.path``. Returns the repo root."""
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") \
             or os.environ.get("EWT_PLATFORM") == "cpu":
         sys.path[:] = load_pathguard().strip_plugin_site(sys.path) \
             or [""]
     if REPO not in sys.path:
         sys.path.insert(0, REPO)
+    arm_compile_cache()
     return REPO

@@ -29,6 +29,12 @@ Status vocabulary (terminal session of each run_dir):
 - ``dead``      — no ``run_end`` and no recent events: killed or
   crashed, awaiting a resume.
 
+Serve-mode run_dirs (``sampler: serve`` — the multi-tenant serving
+layer, docs/serving.md) fold like any other run: their heartbeats
+carry ``queue_depth``/``batch_fill``/``requests_done``, progress is
+requests served over requests seen, and the console's mixing column
+shows queue pressure (``q<depth>/<fill>``) instead of R-hat.
+
 The lineage graph is the campaign's integrity check: ``connected`` is
 true iff every non-``fresh`` session's parent run is present among the
 discovered streams — an orphan means a run_dir's history is
@@ -124,6 +130,13 @@ def fold_campaign(root, now=None, stale_s=300.0):
             # even when the throttled exact fold hasn't fired yet
             "rhat_stream": term["rhat_stream"],
             "ess_stream": term["ess_stream"],
+            # serving layer (sampler == "serve"): queue pressure and
+            # packing efficiency from the driver's heartbeats — a
+            # serve run's "progress" is requests_done/requests_seen
+            # (the driver maps them onto step/nsamp)
+            "queue_depth": term["queue_depth"],
+            "batch_fill": term["batch_fill"],
+            "requests_done": term["requests_done"],
             "faults": counts["fault"],
             "retries": counts["retry"],
             "demotions": counts["demotion"],
@@ -220,6 +233,12 @@ def render(report, out=sys.stdout):
             rhat = f"{r['rhat']:.3f}"
         elif r.get("rhat_stream") is not None:
             rhat = f"~{r['rhat_stream']:.3f}"
+        elif r.get("queue_depth") is not None:
+            # serve-mode run_dir: the mixing column carries queue
+            # pressure instead (q<depth>/<fill>)
+            fill = r.get("batch_fill")
+            rhat = f"q{r['queue_depth']}" + (
+                f"/{fill:.2f}" if fill is not None else "")
         else:
             rhat = "-"
         flags = ("!" if r.get("anomaly") else "") \

@@ -62,6 +62,9 @@ KNOWN_EVENT_TYPES = frozenset({
     "run_start", "run_end", "compile", "heartbeat", "checkpoint",
     "span", "cost_analysis", "anomaly", "fault", "retry", "demotion",
     "run_lineage", "metrics_export", "mixing",
+    # serving layer (enterprise_warp_tpu/serve, docs/serving.md):
+    # per-tenant request/result stream + the driver's final roll-up
+    "serve_request", "serve_result", "serve_summary",
 })
 
 #: the heartbeat field vocabulary — every field any sampler/driver
@@ -87,6 +90,8 @@ KNOWN_HEARTBEAT_FIELDS = frozenset({
     "lnz", "dlogz", "scale", "insertion_ks", "converged",
     "scale_min", "scale_max", "budget_exhaust_frac",
     "first_accept_frac",
+    # serving layer (queue pressure + packing efficiency)
+    "queue_depth", "batch_fill", "dispatches", "requests_done",
     # VI / CEM drivers
     "elbo", "best_lnpost", "is_ess",
 })
@@ -148,7 +153,9 @@ def fold_segments(events, stream=None):
                            "heartbeat": 0},
                 "step": None, "nsamp": None, "evals_per_s": None,
                 "evals_total": None, "rhat": None, "ess": None,
-                "rhat_stream": None, "ess_stream": None}
+                "rhat_stream": None, "ess_stream": None,
+                "queue_depth": None, "batch_fill": None,
+                "requests_done": None}
 
     for ev in events:
         t = ev.get("type")
@@ -174,7 +181,8 @@ def fold_segments(events, stream=None):
             c = cur["counts"]
             c["heartbeat"] += 1
             for k in ("step", "nsamp", "evals_per_s", "evals_total",
-                      "rhat", "ess", "rhat_stream", "ess_stream"):
+                      "rhat", "ess", "rhat_stream", "ess_stream",
+                      "queue_depth", "batch_fill", "requests_done"):
                 if ev.get(k) is not None:
                     cur[k] = ev[k]
             # nested heartbeats carry 'iteration', never 'step' — the
@@ -255,13 +263,23 @@ def build_report(events, dropped=0):
                                    and t_last is not None) else None
 
     # ---- compile phase: per-fn breakdown ---------------------------- #
+    # cache_hit (when present) is the persistent compile-cache verdict
+    # the traced()/AOT layers attribute per (re)trace: a hit is a
+    # near-zero-wall executable reload, a miss a real XLA compile
     per_fn = {}
+    cache_hits = cache_misses = 0
     for ev in compiles:
         d = per_fn.setdefault(ev.get("fn", "?"),
                               {"count": 0, "wall_s": 0.0})
         d["count"] += 1
         d["wall_s"] = round(d["wall_s"] + float(ev.get("wall_s", 0.0)),
                             4)
+        hit = ev.get("cache_hit")
+        if hit is True:
+            cache_hits += 1
+            d["cache_hits"] = d.get("cache_hits", 0) + 1
+        elif hit is False:
+            cache_misses += 1
     compile_wall = round(sum(d["wall_s"] for d in per_fn.values()), 3)
 
     # ---- heartbeat folds: eval-rate timeline + convergence ---------- #
@@ -392,7 +410,10 @@ def build_report(events, dropped=0):
                 if bubble_blocks and total_wall is not None else None),
         },
         "compiles": {"total": sum(d["count"] for d in per_fn.values()),
+                     "cache_hits": cache_hits,
+                     "cache_misses": cache_misses,
                      "per_fn": per_fn},
+        "serve": _fold_serve(by_type),
         "eval_rate": {
             "timeline": rate_timeline,
             "peak_evals_per_s": max(rates) if rates else None,
@@ -442,6 +463,41 @@ def build_report(events, dropped=0):
     return report
 
 
+def _fold_serve(by_type):
+    """Serving-layer fold: per-request ``serve_result`` events (a
+    tenant stream, or a driver stream's roll-up) into request counts
+    and a latency profile. None when the stream carries no serve
+    traffic."""
+    results = by_type.get("serve_result", [])
+    requests = by_type.get("serve_request", [])
+    summaries = by_type.get("serve_summary", [])
+    if not (results or requests or summaries):
+        return None
+    lats = sorted(float(ev["latency_ms"]) for ev in results
+                  if ev.get("latency_ms") is not None)
+
+    def q(p):
+        if not lats:
+            return None
+        return lats[min(int(p * len(lats)), len(lats) - 1)]
+
+    out = {
+        "requests": len(requests),
+        "results": len(results),
+        "errors": sum(1 for ev in results if ev.get("error")),
+        "latency_ms": {"p50": q(0.5), "p90": q(0.9), "p99": q(0.99),
+                       "max": lats[-1] if lats else None},
+    }
+    if summaries:
+        s = summaries[-1]
+        out["driver_summary"] = {
+            k: s.get(k) for k in ("requests_seen", "requests_done",
+                                  "dropped_requests", "dispatches",
+                                  "dispatch_reduction",
+                                  "mean_batch_fill")}
+    return out
+
+
 def load_postmortem(run_dir):
     """The anomaly forensics dump (``<run_dir>/anomaly/anomaly.json``,
     written by ``utils/flightrec.py``) or None."""
@@ -484,7 +540,11 @@ def _human_summary(report, out=sys.stdout):
           f"({w['bubble_fraction']} of sample wall; host blocked on "
           f"sync {w['host_sync_s']}s)")
     c = report["compiles"]
-    p(f"compiles: {c['total']}")
+    cache_note = ""
+    if c.get("cache_hits") or c.get("cache_misses"):
+        cache_note = (f" ({c['cache_hits']} persistent-cache "
+                      f"hit(s), {c['cache_misses']} miss(es))")
+    p(f"compiles: {c['total']}{cache_note}")
     for fn, d in sorted(c["per_fn"].items(),
                         key=lambda kv: -kv[1]["wall_s"]):
         p(f"  {fn:32s} x{d['count']}  {d['wall_s']}s")
@@ -522,6 +582,20 @@ def _human_summary(report, out=sys.stdout):
         if mx.get("fam_accept"):
             p("  family acceptance: " + " ".join(
                 f"{k}={v}" for k, v in mx["fam_accept"].items()))
+    sv = report.get("serve")
+    if sv:
+        lat = sv.get("latency_ms") or {}
+        line = (f"serve: {sv['results']} result(s), "
+                f"{sv['errors']} error(s)")
+        if lat.get("p50") is not None:
+            line += (f", latency p50 {lat['p50']}ms / "
+                     f"p99 {lat['p99']}ms")
+        ds = sv.get("driver_summary")
+        if ds and ds.get("dispatch_reduction") is not None:
+            line += (f"; {ds['dispatches']} dispatch(es), "
+                     f"{ds['dispatch_reduction']}x vs sequential, "
+                     f"fill {ds['mean_batch_fill']}")
+        p(line)
     ir = report.get("insertion_rank")
     if ir:
         p(f"insertion rank: last KS {ir['last_ks']} "

@@ -21,6 +21,10 @@ telemetry stream) into ``TRENDS.json`` and applies threshold gates:
   A/B (the device diagnostics plane) must show zero added
   dispatches/host-syncs, bit-equal chains, streaming R-hat/ESS
   agreement, and ESS/step holding the committed MIXING.json targets;
+- ``serve``             — BENCH_SERVE.json's multi-tenant serving leg
+  must keep its cold/warm first-result amortization, its batched
+  dispatch reduction, a warm p50 latency ceiling, zero dropped
+  requests, and packed-vs-single-job bit-equality;
 - ``retraces`` / ``nonfinite`` / ``bubble`` (with ``--run <run_dir>``)
   — a fresh run's events.jsonl must show a bounded retrace count per
   traced fn, zero non-finite evals, and a sane bubble fraction;
@@ -352,6 +356,73 @@ def gate_mixing(bench_dir, max_rhat_diff=0.05, ess_ratio_lo=1.0 / 3.0,
                  + "; ".join(detail_ok))
 
 
+def gate_serve(bench_dir, min_warm_speedup=10.0, min_dispatch_red=8.0,
+               max_warm_p50_ms=250.0):
+    """Serving-layer gates from BENCH_SERVE.json (``bench.py
+    --serve``; docs/serving.md):
+
+    - **warm amortization** — a warm repeat request's first-result
+      latency must stay >= ``min_warm_speedup`` x lower than the cold
+      trace+compile path (the AOT cache's whole reason to exist);
+    - **warm latency ceiling** — the batched trace's p50 request
+      latency must hold ``max_warm_p50_ms`` (CPU-honest ceiling; a
+      10x regression here means the packer or dispatch path grew a
+      stall);
+    - **dispatch amortization** — batched dispatch count <=
+      1/``min_dispatch_red`` of sequential, with the mean jobs-per-
+      batch backing it (a reduction earned by dropping requests
+      would fail the next check);
+    - **zero dropped requests** and **bit-equality** of packed
+      results vs the single-job path (the fixed-serve-width
+      contract).
+    """
+    doc = _load_json(os.path.join(bench_dir, "BENCH_SERVE.json"))
+    if not doc:
+        return _gate("serve", "warn", "no BENCH_SERVE.json record")
+    problems = []
+    ws = doc.get("warm_speedup")
+    if ws is None:
+        problems.append("record lacks warm_speedup")
+    elif ws < min_warm_speedup:
+        problems.append(f"warm_speedup {ws}x < floor "
+                        f"{min_warm_speedup}x (AOT cache is not "
+                        "amortizing the compile)")
+    trace = doc.get("trace") or {}
+    p50 = (trace.get("latency_ms") or {}).get("p50")
+    if p50 is None:
+        problems.append("record lacks trace.latency_ms.p50")
+    elif p50 > max_warm_p50_ms:
+        problems.append(f"warm p50 request latency {p50} ms > "
+                        f"ceiling {max_warm_p50_ms} ms")
+    red = doc.get("dispatch_reduction")
+    if red is None:
+        problems.append("record lacks dispatch_reduction")
+    elif red < min_dispatch_red:
+        problems.append(f"dispatch_reduction {red}x < floor "
+                        f"{min_dispatch_red}x")
+    dropped = trace.get("dropped_requests")
+    if dropped is None:
+        problems.append("record lacks trace.dropped_requests")
+    elif dropped != 0:
+        problems.append(f"{dropped} dropped request(s) — the queue "
+                        "must lose nothing")
+    if doc.get("padded_bit_equal") is not True:
+        problems.append("packed results not bit-equal to the "
+                        "single-job path (padding/masking contract "
+                        "broke)")
+    if problems:
+        return _gate("serve", "fail", "; ".join(problems),
+                     warm_speedup=ws, dispatch_reduction=red,
+                     p50_ms=p50)
+    return _gate(
+        "serve", "pass",
+        f"warm_speedup {ws}x (floor {min_warm_speedup}x), "
+        f"dispatch_reduction {red}x (floor {min_dispatch_red}x), "
+        f"p50 {p50} ms (ceiling {max_warm_p50_ms}), zero dropped, "
+        "packed bit-equal", warm_speedup=ws, dispatch_reduction=red,
+        p50_ms=p50)
+
+
 def gate_staleness(series, stale_days, now=None):
     """The "device leg went stale unnoticed" alarm: the newest
     headline must be a device measurement young enough to trust."""
@@ -489,6 +560,19 @@ def main(argv=None):
                     help="mixing-quality floor: BENCH_MIXING ess/step "
                          "vs the committed MIXING.json target "
                          "(default 0.5)")
+    ap.add_argument("--min-serve-warm-speedup", type=float,
+                    default=10.0,
+                    help="serve cold/warm first-result amortization "
+                         "floor (default 10.0, the committed "
+                         "contract)")
+    ap.add_argument("--min-serve-dispatch-red", type=float,
+                    default=8.0,
+                    help="serve batched-vs-sequential dispatch "
+                         "reduction floor (default 8.0)")
+    ap.add_argument("--max-serve-warm-p50-ms", type=float,
+                    default=250.0,
+                    help="serve warm p50 request-latency ceiling in "
+                         "ms (default 250, CPU-honest)")
     ap.add_argument("--max-retraces", type=int, default=8,
                     help="per-fn retrace cap for --run (default 8)")
     ap.add_argument("--max-bubble", type=float, default=0.6,
@@ -516,6 +600,10 @@ def main(argv=None):
                     opts.tol),
         gate_mixing(opts.bench_dir,
                     min_ess_frac=opts.min_mixing_frac),
+        gate_serve(opts.bench_dir,
+                   min_warm_speedup=opts.min_serve_warm_speedup,
+                   min_dispatch_red=opts.min_serve_dispatch_red,
+                   max_warm_p50_ms=opts.max_serve_warm_p50_ms),
         gate_staleness(series, opts.stale_days),
     ]
     if opts.run is not None:
@@ -538,6 +626,9 @@ def main(argv=None):
             "min_bubble_reduction": opts.min_bubble_red,
             "max_host_fraction": opts.max_host_fraction,
             "min_mixing_frac": opts.min_mixing_frac,
+            "min_serve_warm_speedup": opts.min_serve_warm_speedup,
+            "min_serve_dispatch_red": opts.min_serve_dispatch_red,
+            "max_serve_warm_p50_ms": opts.max_serve_warm_p50_ms,
             "max_retraces": opts.max_retraces,
             "max_bubble": opts.max_bubble,
             "stale_days": opts.stale_days,

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Standalone launcher for the multi-tenant serve driver — the
+``tools/`` twin of ``ewt-run serve`` (``enterprise_warp_tpu/serve/
+cli.py``; see ``docs/serving.md``).
+
+Usage::
+
+    python tools/serve.py -p <paramfile> [--warm] [--requests trace.json]
+    python tools/serve.py -p <paramfile> --synthetic 64 --tenants 8
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import ensure_repo_path  # noqa: E402
+
+ensure_repo_path()
+
+from enterprise_warp_tpu.serve.cli import serve_main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(serve_main())

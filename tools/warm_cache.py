@@ -9,6 +9,12 @@ the SAME builds and sampler shapes as the legs against a throwaway
 output directory so the measured runs reload every program from the
 cache (the leg records ``compile_cache_warm`` so the artifact states
 which regime was measured).
+
+Serve mode (``--serve <paramfile> [--buckets 1,8,64]``): pre-compile
+the SERVING executable set instead — every (model topology, batch
+bucket) pair of the paramfile's models, through the same persistent
+cache, so a fresh serve replica (``ewt-run serve``, docs/serving.md)
+starts warm: its AOT lowerings reload instead of compiling.
 """
 
 import os
@@ -24,6 +30,33 @@ from enterprise_warp_tpu.utils.compilecache import \
 enable_compilation_cache()
 
 from tools.north_star import LEGS, build_problem  # noqa: E402
+
+
+def serve_warm(prfile, buckets=None):
+    """Pre-compile the serve executable set for ``prfile``'s model
+    topologies across the configured batch buckets. Returns
+    ``{model: {bucket: compile_wall_s}}`` (a near-zero wall on a
+    second invocation = the persistent cache did its job)."""
+    from enterprise_warp_tpu.serve.aot import AOTExecutableCache
+    from enterprise_warp_tpu.serve.cli import build_serve_models
+
+    models, _ = build_serve_models(prfile)
+    cache = AOTExecutableCache(buckets)
+    out = {}
+    for name in sorted(models):
+        like = models[name]
+        out[name] = cache.warm(like)     # the full bucket set
+        for b in cache.buckets:
+            key = cache.key(like, b)
+            reload_hit = cache.cache_verdicts.get(key)
+            print(f"  model {name} bucket {b:4d}: "
+                  f"{out[name][b]:.2f}s"
+                  + (" (persistent-cache reload)" if reload_hit
+                     else ""))
+    total = sum(sum(w.values()) for w in out.values())
+    print(f"serve cache warmed: {len(models)} model(s) x "
+          f"{len(cache.buckets)} bucket(s) in {total:.1f}s")
+    return out
 
 
 def main():
@@ -92,4 +125,14 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--serve" in sys.argv:
+        idx = sys.argv.index("--serve")
+        prfile = sys.argv[idx + 1]
+        buckets = None
+        if "--buckets" in sys.argv:
+            raw = sys.argv[sys.argv.index("--buckets") + 1]
+            buckets = tuple(sorted({int(x) for x in raw.split(",")
+                                    if x.strip()}))
+        serve_warm(prfile, buckets)
+    else:
+        main()
