@@ -141,6 +141,11 @@ class Params:
             "SCAMweight:": ["SCAMweight", int],
             "tm:": ["tm", str],
             "fref:": ["fref", float],
+            # serving-layer admission config (docs/serving.md):
+            # whitespace-separated key=value tokens, parsed by
+            # serve.admission.parse_serve_config — e.g.
+            # ``serve: max_queue=64 tenant_quota=8 weight.gold=4``
+            "serve:": ["serve", str],
         }
         self.label_attr_map.update(
             self.noise_model_obj().get_label_attr_map())

@@ -14,6 +14,7 @@ and tests.
 from __future__ import annotations
 
 import copy
+import hashlib
 import json
 import math
 import os
@@ -62,6 +63,207 @@ def durable_replace(tmp: str, path: str):
         os.close(fd)
     os.replace(tmp, path)
     fsync_dir(path)
+
+
+# ------------------------------------------------------------------ #
+#  checkpoint integrity generations (docs/resilience.md)              #
+# ------------------------------------------------------------------ #
+#
+# ``durable_replace`` guarantees the checkpoint file is COMPLETE, but a
+# complete file can still be WRONG: silent media corruption, a torn
+# filesystem journal replay, an operator cp from a bad copy. A resume
+# that np.load()s such a file either crashes (lucky) or silently
+# continues from garbage state (not lucky). The generation layer closes
+# this: every checkpoint write lands with a sha256 sidecar
+# (``state.npz.sha256``), the previous generation is rotated to
+# ``state.prev.npz`` (plus its own sidecar) instead of being clobbered,
+# and :func:`resolve_checkpoint` verifies the digest at restore time —
+# a corrupted-but-complete checkpoint falls back one generation with a
+# ``ckpt_corrupt`` event instead of dying.
+
+def sidecar_path(path: str) -> str:
+    """The digest sidecar of a checkpoint file."""
+    return path + ".sha256"
+
+
+def prev_generation(path: str) -> str:
+    """The last-good generation of ``path``:
+    ``state.npz`` -> ``state.prev.npz``."""
+    root, ext = os.path.splitext(path)
+    return root + ".prev" + ext
+
+
+def sha256_file(path: str) -> str:
+    """Streaming sha256 of a file's content (hex)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def checkpoint_replace(tmp: str, path: str) -> str:
+    """:func:`durable_replace` plus integrity generations: rotate the
+    current ``path`` (and its sidecar) to :func:`prev_generation`,
+    install ``tmp`` as the new ``path``, and write its sha256 sidecar.
+    Returns the digest.
+
+    Ordering is chosen so that every crash window leaves a RESTORABLE
+    state for :func:`resolve_checkpoint`:
+
+    1. sidecar rotation first, then data — a crash in between leaves
+       ``path`` (still the old, good data) without a sidecar, which
+       restores as an unverified-but-accepted generation;
+    2. the new data lands via :func:`durable_replace` BEFORE its
+       sidecar is written — a crash in between again leaves a
+       sidecar-less (accepted) generation, never a mismatching pair;
+    3. a crash between the rotation and the new data's rename leaves
+       no ``path`` at all, and restore falls back to the verified
+       ``prev`` generation.
+    """
+    digest = sha256_file(tmp)
+    prev = prev_generation(path)
+    if os.path.exists(path):
+        if os.path.exists(sidecar_path(path)):
+            os.replace(sidecar_path(path), sidecar_path(prev))
+        else:
+            # a legacy (pre-sidecar) generation rotates without one; a
+            # stale prev sidecar must not shadow it as "corrupt"
+            try:
+                os.remove(sidecar_path(prev))
+            except FileNotFoundError:
+                pass
+        os.replace(path, prev)
+    durable_replace(tmp, path)
+    side_tmp = sidecar_path(path) + ".tmp"
+    with open(side_tmp, "w") as fh:
+        fh.write(digest + "\n")
+    durable_replace(side_tmp, sidecar_path(path))
+    return digest
+
+
+def verify_checkpoint(path: str):
+    """Digest verdict for one generation: True (sidecar matches),
+    False (mismatch — the file is corrupt), None (no sidecar — a
+    legacy or mid-rotation generation, accepted unverified)."""
+    sp = sidecar_path(path)
+    if not os.path.exists(sp):
+        return None
+    with open(sp) as fh:
+        want = fh.read().split()
+    if not want:
+        return None
+    return sha256_file(path) == want[0]
+
+
+def checkpoint_exists(path: str) -> bool:
+    """Any generation of ``path`` present on disk (the cheap resume-
+    detection predicate; :func:`resolve_checkpoint` does the digest
+    work)."""
+    return os.path.exists(path) or os.path.exists(prev_generation(path))
+
+
+def remove_checkpoint(path: str):
+    """Remove every generation of ``path`` plus sidecars (run
+    complete: the next run must start fresh)."""
+    for p in (path, sidecar_path(path), prev_generation(path),
+              sidecar_path(prev_generation(path))):
+        try:
+            os.remove(p)
+        except FileNotFoundError:
+            pass
+
+
+#: resolve_checkpoint memo: abspath -> (stat signature of all four
+#: generation files, resolved path). One logical resume often
+#: resolves the same checkpoint twice (the convergence driver reads
+#: the step counter, then the sampler's ``_sample_impl`` loads the
+#: state) — without the memo that is two full-file sha256 passes and,
+#: on a corrupt archive, DOUBLED ``ckpt_corrupt`` telemetry for one
+#: corruption. Any write/rotation/corruption changes an mtime/size in
+#: the signature and invalidates the entry.
+_RESOLVE_MEMO: dict = {}
+
+
+def _generation_stat_sig(path: str):
+    sig = []
+    for p in (path, sidecar_path(path), prev_generation(path),
+              sidecar_path(prev_generation(path))):
+        try:
+            st = os.stat(p)
+            sig.append((st.st_mtime_ns, st.st_size))
+        except OSError:
+            sig.append(None)
+    return tuple(sig)
+
+
+def resolve_checkpoint(path: str, what: str = "checkpoint"):
+    """Digest-verified checkpoint resolution with last-good fallback.
+
+    Tries ``path`` then :func:`prev_generation`; each candidate is
+    accepted when its sidecar digest matches (or when it has no
+    sidecar — the legacy/mid-rotation case). A mismatch emits a typed
+    ``ckpt_corrupt`` event + ``ckpt_verify{outcome=corrupt}`` counter
+    and falls through to the previous generation. Returns the usable
+    path, or None when no restorable generation exists. Repeat calls
+    against unchanged files return the memoized verdict without
+    re-hashing or re-emitting telemetry.
+
+    Fault-injection site ``ckpt.verify`` (resilience harness): kind
+    ``torn`` physically truncates ``path`` on disk before
+    verification — the deterministic bit-rot vector the chaos storm
+    and the digest-rotation tests use. The site fires on every call
+    (a mutation invalidates the memo, so an injected corruption is
+    always re-verified).
+    """
+    from ..resilience import faults
+    spec = faults.fire("ckpt.verify", write=True, path=path)
+    if spec is not None and spec.kind == "torn" \
+            and os.path.exists(path):
+        with open(path, "rb") as fh:
+            data = fh.read()
+        with open(path, "wb") as fh:
+            fh.write(faults.torn_bytes(spec, data))
+    key = os.path.abspath(path)
+    sig = _generation_stat_sig(key)
+    memo = _RESOLVE_MEMO.get(key)
+    if memo is not None and memo[0] == sig:
+        return memo[1]
+    from ..utils import telemetry
+    from ..utils.logging import get_logger
+    log = get_logger("ewt.ckpt")
+    for generation, cand in enumerate((path, prev_generation(path))):
+        if not os.path.exists(cand):
+            continue
+        verdict = verify_checkpoint(cand)
+        if verdict is False:
+            telemetry.registry().counter("ckpt_verify",
+                                         outcome="corrupt").inc()
+            log.error("%s %s failed digest verification%s", what,
+                      cand, " — falling back one generation"
+                      if generation == 0 else "")
+            from ..utils.flightrec import flight_recorder
+            flight_recorder().record("ckpt_corrupt", path=cand,
+                                     generation=generation, what=what)
+            rec = telemetry.active_recorder()
+            if rec is not None:
+                rec.event("ckpt_corrupt", path=cand,
+                          generation=generation, what=what)
+                # forensic record: must survive a later crash
+                rec.flush()
+            continue
+        outcome = "ok" if verdict else "unverified"
+        telemetry.registry().counter("ckpt_verify",
+                                     outcome=outcome).inc()
+        if generation:
+            telemetry.registry().counter("ckpt_verify",
+                                         outcome="fallback").inc()
+            log.warning("%s restored from previous generation %s "
+                        "(digest %s)", what, cand, outcome)
+        _RESOLVE_MEMO[key] = (sig, cand)
+        return cand
+    _RESOLVE_MEMO[key] = (sig, None)
+    return None
 
 
 def atomic_write_json(path: str, obj, indent: int = 1, sort_keys=False,

@@ -6,8 +6,15 @@ run under injected faults, on schedule, in CI. This module provides the
 schedule. Named injection sites are threaded through the stack (sampler
 block dispatch, the Pallas probes, checkpoint serialization, the
 events.jsonl flush, chain-file appends, the CLI per-pulsar model-build
-loop); a *fault plan* — ``EWT_FAULT_PLAN=<json>`` or a programmatic
-:class:`FaultPlan` — decides which site occurrence misbehaves and how.
+loop, the serving plane — ``serve.admit`` at request admission,
+``serve.dispatch`` inside the supervised batch thunk, ``serve.harvest``
+at result harvest (``nonfinite`` poisons the harvested batch — the
+quarantine-bisection vector), ``serve.quarantine`` at the quarantine
+decision — and ``ckpt.verify`` at digest-verified checkpoint restore,
+where ``torn`` physically corrupts the archive on disk so restore must
+fall back one generation); a *fault plan* — ``EWT_FAULT_PLAN=<json>``
+or a programmatic :class:`FaultPlan` — decides which site occurrence
+misbehaves and how.
 
 Plan schema (see ``docs/resilience.md``)::
 

@@ -324,17 +324,26 @@ class EnterpriseWarpResult:
         chain file interleaves walkers per step, and diagnostics need
         the (nchains, nsteps) split. Falls back to 1 (split-halves
         R-hat still applies)."""
+        from ..io.writers import prev_generation
+        # generation-aware but hash-free: np.load only reads the
+        # accessed zip members, so try the current generation first
+        # and fall back to state.prev.npz only when it is unreadable
+        # or foreign — a full sha256 per pulsar dir just to infer
+        # nchains would make large-campaign post-processing pay for
+        # integrity the samplers already verified at resume
         path = os.path.join(self.outdir_all, psr_dir, "state.npz")
-        if os.path.exists(path):
+        for cand in (path, prev_generation(path)):
+            if not os.path.exists(cand):
+                continue
             try:
-                z = np.load(path)
+                z = np.load(cand)
                 if "ladder" in z.files:           # PT sampler
                     return int(z["x"].shape[0]) // max(
                         len(z["ladder"]), 1)
                 if "z" in z.files:                # HMC sampler
                     return int(z["z"].shape[0])
             except Exception:
-                pass
+                continue
         return 1
 
     def _print_diagnostics(self, psr_dir, chain, pars):

@@ -212,14 +212,19 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
     blocks = []
     steps = 0
     if resume:
+        from ..io.writers import checkpoint_replace, resolve_checkpoint
         chain_path = os.path.join(sampler.outdir, "chain_1.txt")
-        if os.path.exists(sampler._ckpt_path) and \
-                os.path.exists(chain_path):
+        # digest-verified resolution: a corrupted state.npz falls back
+        # to the state.prev.npz generation (io/writers.py); the rewind
+        # below then measures against THAT generation's step counter
+        ckpt = resolve_checkpoint(sampler._ckpt_path,
+                                  what="pt checkpoint")
+        if ckpt is not None and os.path.exists(chain_path):
             raw, dropped = _robust_loadtxt(chain_path)
             # truncate to the checkpointed step: a kill between the chain
             # append and the (atomic) state save leaves extra chain rows
             # the resumed sampler will regenerate
-            ckpt_step = int(np.load(sampler._ckpt_path)["step"])
+            ckpt_step = int(np.load(ckpt)["step"])
             nsteps = min(raw.shape[0] // sampler.nchains, ckpt_step)
             if nsteps > 0:
                 if nsteps < ckpt_step:
@@ -235,7 +240,7 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
                     _log.info("resume: chain file holds %d complete "
                               "steps < checkpoint step %d; rewinding "
                               "checkpoint counter", nsteps, ckpt_step)
-                    z = dict(np.load(sampler._ckpt_path))
+                    z = dict(np.load(ckpt))
                     z["step"] = nsteps
                     # the streaming-diagnostics ledger (diag_* keys,
                     # utils/devicemetrics.py) covers ckpt_step steps;
@@ -270,7 +275,11 @@ def sample_to_convergence(sampler, target_ess=1000.0, rhat_max=1.01,
                                 del z[k]
                     tmp = sampler._ckpt_path + ".tmp.npz"
                     np.savez(tmp, **z)
-                    os.replace(tmp, sampler._ckpt_path)
+                    # checkpoint_replace, not a bare rename: the
+                    # rewound archive needs a FRESH digest sidecar or
+                    # the very next resolve would flag the repair
+                    # itself as corruption and fall back a generation
+                    checkpoint_replace(tmp, sampler._ckpt_path)
                 truncated = nsteps * sampler.nchains < raw.shape[0]
                 raw = raw[:nsteps * sampler.nchains]
                 # repair the on-disk chain to exactly the rows we keep:

@@ -39,7 +39,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..io.writers import durable_replace
+from ..io.writers import (checkpoint_exists, checkpoint_replace,
+                          resolve_checkpoint)
 from ..native import write_table
 from .transform import make_logp_z
 from ..parallel.distributed import is_primary as _is_primary
@@ -230,12 +231,14 @@ class HMCSampler:
                  mass=st.mass, step=st.step, accepted=st.accepted,
                  divergences=st.divergences, mu=st.mu,
                  da_iter=st.da_iter, ngrad=st.ngrad, **diag)
-        durable_replace(tmp, self._ckpt_path)
+        # integrity generation: sha256 sidecar + state.prev.npz
+        # rotation (io/writers.py, docs/resilience.md)
+        checkpoint_replace(tmp, self._ckpt_path)
         # kill-after-durable-checkpoint injection boundary (resilience)
         faults.fire("hmc.ckpt", path=self._ckpt_path, step=int(st.step))
 
-    def _load_state(self):
-        z = np.load(self._ckpt_path)
+    def _load_state(self, path=None):
+        z = np.load(path or self._ckpt_path)
         if self.diag_ledger is not None and "diag_counts" in z.files:
             self.diag_ledger = devicemetrics.MomentLedger.from_state(
                 self.W, self.ndim,
@@ -439,8 +442,11 @@ class HMCSampler:
                      rec):
         diag_t = [0.0]
         chain_path0 = os.path.join(self.outdir, "chain_1.txt")
-        if resume and os.path.exists(self._ckpt_path):
-            st = self._load_state()
+        ckpt = resolve_checkpoint(self._ckpt_path,
+                                  what="hmc checkpoint") \
+            if resume else None
+        if ckpt is not None:
+            st = self._load_state(ckpt)
             if verbose:
                 _log.info("resuming from step %d", st.step)
             # a kill between the chain append and the (atomic) state
@@ -740,7 +746,7 @@ def run_hmc(like, outdir, nsamp, params=None, resume=True, seed=0,
             opts["device_state"] = bool(int(skw["device_state"]))
     opts.update(kw)
     if advi_init and "mass0" not in opts and \
-            not (resume and os.path.exists(
+            not (resume and checkpoint_exists(
                 os.path.join(outdir, "state.npz"))):
         from .vi import fit_advi
         fit = fit_advi(like, steps=1500, mc=16, seed=seed,

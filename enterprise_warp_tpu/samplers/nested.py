@@ -49,7 +49,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..io.writers import atomic_write_json, durable_replace
+from ..io.writers import (atomic_write_json, checkpoint_replace,
+                          remove_checkpoint, resolve_checkpoint)
 from ..resilience import faults
 from ..resilience.supervisor import (BlockSupervisor, PlatformDemotion,
                                      apply_demotion,
@@ -692,8 +693,13 @@ def _run_nested_periter(like, outdir=None, nlive=500, dlogz=0.1,
     want = dict(nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
                 nsteps=nsteps, params_fp=_params_fingerprint(like))
     z = None
-    if resume and ckpt_path is not None and os.path.exists(ckpt_path):
-        z = _ckpt_load_compatible(ckpt_path, want)
+    if resume and ckpt_path is not None:
+        # digest-verified resolution with last-good generation
+        # fallback (io/writers.py, docs/resilience.md)
+        resolved = resolve_checkpoint(ckpt_path,
+                                      what="nested checkpoint")
+        if resolved is not None:
+            z = _ckpt_load_compatible(resolved, want)
     if z is not None and "block_iters" in z \
             and int(z["block_iters"]) != 0:
         # geometry incompatibility is TWO-way: a blocked-path
@@ -750,7 +756,7 @@ def _run_nested_periter(like, outdir=None, nlive=500, dlogz=0.1,
                        else np.zeros(0)),
             nlive=nlive, kbatch=kbatch, seed=seed, ndim=nd,
             nsteps=nsteps, params_fp=_params_fingerprint(like))
-        durable_replace(tmp, ckpt_path)
+        checkpoint_replace(tmp, ckpt_path)
         # kill-after-durable-checkpoint injection boundary (resilience)
         faults.fire("nested.ckpt", path=ckpt_path, iteration=int(it))
 
@@ -864,9 +870,10 @@ def _run_nested_periter(like, outdir=None, nlive=500, dlogz=0.1,
                       evals_per_s=round(meter.rate(), 1),
                       evals_total=int(meter.total))
 
-    if converged and ckpt_path is not None and is_primary() \
-            and os.path.exists(ckpt_path):
-        os.remove(ckpt_path)       # run complete; next run starts fresh
+    if converged and ckpt_path is not None and is_primary():
+        # run complete; next run starts fresh (all generations +
+        # digest sidecars)
+        remove_checkpoint(ckpt_path)
     elif not converged:
         _write_ckpt()              # max_iter hit: keep state resumable
 
@@ -930,8 +937,11 @@ def _run_nested_blocked(like, outdir, nlive, dlogz, nsteps, kbatch,
                 slide=int(slide_effective(like, slide_moves)),
                 params_fp=_params_fingerprint(like))
     z = None
-    if resume and ckpt_path is not None and os.path.exists(ckpt_path):
-        z = _ckpt_load_compatible(ckpt_path, want)
+    if resume and ckpt_path is not None:
+        resolved = resolve_checkpoint(ckpt_path,
+                                      what="nested checkpoint")
+        if resolved is not None:
+            z = _ckpt_load_compatible(resolved, want)
     ks_blocks = []
     ckpt_dispatch = ckpt_sync = 0
     if z is not None:
@@ -1027,7 +1037,7 @@ def _run_nested_blocked(like, outdir, nlive, dlogz, nsteps, kbatch,
             nsteps=nsteps, block_iters=block_iters, kernel=kernel,
             slide=int(slide_effective(like, slide_moves)),
             params_fp=_params_fingerprint(like))
-        durable_replace(tmp, ckpt_path)
+        checkpoint_replace(tmp, ckpt_path)
         # kill-after-durable-checkpoint injection boundary (resilience)
         faults.fire("nested.ckpt", path=ckpt_path, iteration=it_now)
 
@@ -1286,9 +1296,10 @@ def _run_nested_blocked(like, outdir, nlive, dlogz, nsteps, kbatch,
                       evals_per_s=round(meter.rate(), 1),
                       evals_total=int(meter.total))
 
-    if converged and ckpt_path is not None and is_primary() \
-            and os.path.exists(ckpt_path):
-        os.remove(ckpt_path)       # run complete; next run starts fresh
+    if converged and ckpt_path is not None and is_primary():
+        # run complete; next run starts fresh (all generations +
+        # digest sidecars)
+        remove_checkpoint(ckpt_path)
     elif not converged and it > last_ckpt_it:
         state = dict(u=np.asarray(u), lnl=np.asarray(lnl),
                      key=np.asarray(rng_key), scale=scale, ln_x=ln_x,

@@ -38,7 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..io.writers import atomic_write_json, durable_replace
+from ..io.writers import (atomic_write_json, checkpoint_exists,
+                          checkpoint_replace, resolve_checkpoint)
 from ..native import write_table
 from ..parallel.distributed import is_primary as _is_primary
 from ..resilience import faults
@@ -375,7 +376,10 @@ class PTSampler:
             return
         tmp = self._ckpt_path + ".tmp.npz"
         np.savez(tmp, **payload)
-        durable_replace(tmp, self._ckpt_path)
+        # integrity generation: sha256 sidecar + state.prev.npz
+        # rotation, so a corrupted-but-complete checkpoint restores
+        # from the last good generation (io/writers.py)
+        checkpoint_replace(tmp, self._ckpt_path)
         # injection site pt.ckpt fires AFTER the durable replace: a
         # ``kill`` here is the clean checkpoint-boundary crash the
         # resume-equivalence contract is tested against
@@ -384,8 +388,8 @@ class PTSampler:
 
     # ewt: allow-host-sync — checkpoint resume: np.load hands back
     # host arrays; the pull happens once, before sampling restarts
-    def _load_state(self):
-        z = np.load(self._ckpt_path)
+    def _load_state(self, path=None):
+        z = np.load(path or self._ckpt_path)
         # per-rung counters + adapted ladder; checkpoints from before the
         # ladder adaptation hold scalar counters -> reset those
         sacc = np.atleast_1d(np.asarray(z["swaps_accepted"], dtype=float))
@@ -1230,7 +1234,7 @@ class PTSampler:
         Intended for single-rung ensembles (``ntemps == 1``); with a
         PT ladder the ladder itself already provides the bridge.
         """
-        if os.path.exists(self._ckpt_path):
+        if checkpoint_exists(self._ckpt_path):
             return None
         if schedule is None:
             schedule = (64.0, 32.0, 16.0, 8.0, 4.0, 2.0)
@@ -1436,8 +1440,13 @@ class PTSampler:
     def _sample_impl(self, nsamp, resume, verbose, thin, block_size,
                      collect, rec):
         diag_t = [0.0]
-        if resume and os.path.exists(self._ckpt_path):
-            st = self._load_state()
+        # digest-verified resolution: a corrupted state.npz falls back
+        # to state.prev.npz with a ckpt_corrupt event (io/writers.py)
+        ckpt = resolve_checkpoint(self._ckpt_path,
+                                  what="pt checkpoint") \
+            if resume else None
+        if ckpt is not None:
+            st = self._load_state(ckpt)
             if verbose:
                 _log.info("resuming from step %d", st.step)
             # a kill between a block's chain append and its checkpoint
@@ -1837,7 +1846,7 @@ def run_ptmcmc(like, outdir, nsamp, params=None, resume=True, seed=0,
         if skw.get("Tmax") is not None:
             opts["tmax"] = float(skw["Tmax"])
         if getattr(params, "advi_init", skw.get("advi_init", False)) \
-                and not (resume and os.path.exists(
+                and not (resume and checkpoint_exists(
                     os.path.join(outdir, "state.npz"))):
             # warm-start walkers from a quick variational fit — cuts
             # burn-in; the chain itself is unchanged MCMC. Skipped on

@@ -21,16 +21,24 @@ amortizes both compilation and dispatch:
   result harvest (``samplers/devicestate.py``), per-batch supervision
   (``resilience/supervisor.py`` — watchdog/retry/demotion apply per
   batch, not per process), and per-tenant ``events.jsonl`` streams;
+- :mod:`admission` — the front-door guards: typed
+  :class:`~admission.Rejection` at submit (shape/dtype/finite/prior-
+  support validation, bounded queue, per-tenant quotas) and weighted
+  tenant fair-share drain ordering;
 - :mod:`cli` — ``ewt-run serve ...`` / ``python tools/serve.py``.
 
 See ``docs/serving.md``.
 """
 
+from .admission import (Rejection, UnknownModel, fair_share_order,
+                        parse_serve_config, validate_thetas)
 from .aot import (DEFAULT_BUCKETS, AOTExecutableCache, batch_buckets,
                   bucket_for)
 from .driver import Request, ServeDriver
-from .packer import PackedBatch, pack_requests
+from .packer import PackedBatch, pack_requests, split_batch
 
 __all__ = ["AOTExecutableCache", "DEFAULT_BUCKETS", "batch_buckets",
            "bucket_for", "ServeDriver", "Request", "PackedBatch",
-           "pack_requests"]
+           "pack_requests", "split_batch", "Rejection",
+           "UnknownModel", "validate_thetas", "fair_share_order",
+           "parse_serve_config"]

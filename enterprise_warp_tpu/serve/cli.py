@@ -16,7 +16,17 @@ Trace file schema: a JSON list of requests, in arrival order::
 
 ``"n_theta": k`` may replace ``"thetas"`` — the driver draws ``k``
 prior samples instead (seeded). ``"model"`` defaults to the first
-registered model.
+registered model. Optional per-entry fields: ``"rid"`` (a stable
+request id — the chaos driver uses it to compare legs) and
+``"deadline_ms"`` (shed at pack time when exceeded).
+
+Adversity contract (docs/serving.md): a trace entry the admission
+layer rejects (malformed thetas, queue full, over quota) is COUNTED
+and skipped, never fatal — the summary line carries the shed
+accounting. A cpu-rung platform demotion checkpoints the unfinished
+queue (``<root>/state.npz`` integrity generations) and exits 75
+(EX_TEMPFAIL); an external supervisor restarts with ``--resume`` to
+drain the restored queue.
 """
 
 from __future__ import annotations
@@ -84,8 +94,13 @@ def load_trace(path, models, seed=0):
         else:
             thetas = np.asarray(models[model].sample_prior(
                 rng, int(r.get("n_theta", 1))), dtype=np.float64)
-        out.append({"tenant": str(r.get("tenant", "tenant0")),
-                    "model": model, "thetas": thetas})
+        spec = {"tenant": str(r.get("tenant", "tenant0")),
+                "model": model, "thetas": thetas}
+        if r.get("rid") is not None:
+            spec["rid"] = str(r["rid"])
+        if r.get("deadline_ms") is not None:
+            spec["deadline_ms"] = float(r["deadline_ms"])
+        out.append(spec)
     return out
 
 
@@ -106,6 +121,11 @@ def serve_main(argv=None):
                          "output_dir>/serve)")
     ap.add_argument("--requests", default=None,
                     help="JSON trace file (default: synthetic trace)")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore the unfinished request queue from "
+                         "the serve root's checkpoint instead of "
+                         "submitting a trace (restart after a "
+                         "demotion/preemption exit)")
     ap.add_argument("--synthetic", type=int, default=32,
                     help="synthetic trace size when --requests is "
                          "not given (default 32)")
@@ -131,29 +151,64 @@ def serve_main(argv=None):
         buckets = tuple(sorted({int(x) for x in
                                 opts.buckets.split(",") if x.strip()}))
 
+    from ..resilience.supervisor import EXIT_DEMOTED, PlatformDemotion
+    from .admission import Rejection, parse_serve_config
     from .driver import ServeDriver
-    with ServeDriver(root, buckets=buckets,
-                     prfile=os.path.abspath(opts.prfile)) as driver:
-        for name, like in models.items():
-            driver.register(name, like)
-        if opts.warm:
-            walls = driver.warm()
-            print(f"# warmed {sum(len(w) for w in walls.values())} "
-                  "executables", file=sys.stderr)
-        if opts.requests:
-            trace = load_trace(opts.requests, models, seed=opts.seed)
-        else:
-            trace = synthetic_trace(models, opts.synthetic,
-                                    tenants=opts.tenants,
-                                    max_theta=opts.max_theta,
-                                    seed=opts.seed)
-        for spec in trace:
-            driver.submit(spec["tenant"], spec["model"],
-                          spec["thetas"])
-        summary = driver.run()
+    serve_cfg = parse_serve_config(getattr(params, "serve", None))
+    try:
+        with ServeDriver(root, buckets=buckets,
+                         prfile=os.path.abspath(opts.prfile),
+                         **serve_cfg) as driver:
+            for name, like in models.items():
+                driver.register(name, like)
+            if opts.warm:
+                walls = driver.warm()
+                print(f"# warmed "
+                      f"{sum(len(w) for w in walls.values())} "
+                      "executables", file=sys.stderr)
+            if opts.resume:
+                n = driver.restore()
+                print(f"# restored {n} unfinished request(s)",
+                      file=sys.stderr)
+            else:
+                if opts.requests:
+                    trace = load_trace(opts.requests, models,
+                                       seed=opts.seed)
+                else:
+                    trace = synthetic_trace(models, opts.synthetic,
+                                            tenants=opts.tenants,
+                                            max_theta=opts.max_theta,
+                                            seed=opts.seed)
+                for spec in trace:
+                    try:
+                        driver.submit(spec["tenant"], spec["model"],
+                                      spec["thetas"],
+                                      rid=spec.get("rid"),
+                                      deadline_ms=spec.get(
+                                          "deadline_ms"))
+                    except Rejection as rej:
+                        # typed admission rejection: counted by the
+                        # driver (serve_rejected event + summary
+                        # accounting), the trace keeps flowing
+                        print(f"# rejected {rej.rid} "
+                              f"({rej.reason})", file=sys.stderr)
+            summary = driver.run()
+    except PlatformDemotion as d:
+        # the driver requeued + checkpointed the unfinished work
+        # before this crossed the process boundary; hand the restart
+        # to the external supervisor (EX_TEMPFAIL contract)
+        print(json.dumps({"demoted": str(d.to_level or "restart"),
+                          "root": os.path.abspath(root),
+                          "resume": "ewt-run serve --resume"}))
+        return EXIT_DEMOTED
     summary["root"] = os.path.abspath(root)
     print(json.dumps(summary))
-    return 0 if summary["dropped_requests"] == 0 else 1
+    # a poison quarantine exiting 0 is the contract (the poison
+    # failed alone, by design); an INFRA failure — dropped requests,
+    # or quarantines caused by dispatch errors — must not
+    return 0 if (summary["dropped_requests"] == 0
+                 and summary["dispatch_error_quarantines"] == 0) \
+        else 1
 
 
 if __name__ == "__main__":

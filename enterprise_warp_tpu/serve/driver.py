@@ -5,8 +5,19 @@ request queue, the AOT executable cache, and the per-tenant result
 streams:
 
 - ``submit(tenant, model, thetas)`` enqueues one job (a small theta
-  batch to evaluate) and returns its request id;
-- ``step()`` drains the queue once: groups pending requests by model,
+  batch to evaluate) and returns its request id. Admission is guarded
+  (``admission.py``): thetas are coerced + validated ONCE (shape,
+  dtype, finiteness, prior support), the queue is bounded
+  (``max_queue`` / ``EWT_SERVE_MAX_QUEUE``), and per-tenant in-flight
+  quotas (``tenant_quota``) apply backpressure — a failed admission
+  raises a typed :class:`~.admission.Rejection`, recorded as a
+  ``serve_rejected`` event, never a mid-drain traceback;
+- requests may carry a ``deadline_ms``; expired jobs are shed at pack
+  time (``serve_expired`` event) before ever costing a dispatch;
+- ``step()`` drains the queue once: sheds expired requests, orders
+  the snapshot by weighted tenant fair-share (safe to reorder — at a
+  fixed serve width a row's result is bit-independent of co-batched
+  content), groups pending requests by model,
   packs their rows into batches padded to the model's serve width
   (``packer.py`` — ONE sticky bucket per model, so a packed job's
   answer is bit-equal to serving it alone), and dispatches each batch
@@ -26,7 +37,21 @@ circuit breaker. A ``PlatformDemotion`` to the classic route is
 applied in place (``EWT_PALLAS=0`` + executable cache flush + one
 re-dispatch of the same host rows — the donated device copy is gone,
 the host rows are not); the ``cpu`` rung propagates to the process
-layer, with every in-flight request still queued so nothing is lost.
+layer, with every in-flight request requeued AND checkpointed
+(``state.npz`` integrity generations, ``io/writers.py``) so a process
+restart resumes the queue with ``restore()``.
+
+**Poison quarantine** (docs/serving.md): every harvested batch is
+``isfinite``-checked per row. Nonfinite rows attribute back to their
+requests through the pack segments; when the whole batch is
+contaminated (a batch-level NaN bleed — attribution ambiguous), the
+driver bisect-redispatches halves at the SAME bucket until the poison
+rows are isolated. The poisoned request alone is quarantined (typed
+``serve_quarantined`` event + flight-recorder forensics +
+``serve_quarantined{tenant=}`` counter); its co-tenants finish with
+results bit-equal to a clean run — zero co-tenant casualties. A
+whole-batch dispatch *exception* (after the supervisor's retries)
+takes the same bisection path instead of failing every passenger.
 
 Results: ``driver.results[rid]`` (host f64 lnl per job row), a typed
 ``serve_result`` event on the tenant's ``events.jsonl`` (latency,
@@ -45,6 +70,9 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..io.writers import (checkpoint_replace, remove_checkpoint,
+                          resolve_checkpoint)
+from ..resilience import faults
 from ..resilience.supervisor import (BlockSupervisor, PlatformDemotion,
                                      apply_demotion,
                                      preemption_requested)
@@ -53,8 +81,10 @@ from ..samplers.devicestate import (HostPipeline, host_pull,
 from ..samplers.evalproto import eval_protocol
 from ..utils import profiling, telemetry
 from ..utils.logging import EvalRateMeter, get_logger
+from .admission import (Rejection, UnknownModel, fair_share_order,
+                        prior_bounds, validate_thetas)
 from .aot import AOTExecutableCache
-from .packer import pack_requests
+from .packer import pack_requests, split_batch
 
 __all__ = ["Request", "ServeDriver"]
 
@@ -69,7 +99,10 @@ _INLINE_LNL_ROWS = 32
 @dataclass
 class Request:
     """One queued job: evaluate ``thetas`` (n, ndim) against
-    ``model`` for ``tenant``."""
+    ``model`` for ``tenant``. ``deadline`` is an absolute
+    ``profiling.monotonic()`` instant (None = no deadline);
+    ``deadline_ms`` keeps the requested relative budget for latency
+    reporting."""
 
     rid: str
     tenant: str
@@ -77,6 +110,8 @@ class Request:
     thetas: np.ndarray
     t_submit: float
     meta: dict = field(default_factory=dict)
+    deadline: float | None = None
+    deadline_ms: float | None = None
 
     @property
     def n(self) -> int:
@@ -88,7 +123,9 @@ class ServeDriver:
     (driver events.jsonl + ``tenants/<tenant>/`` streams)."""
 
     def __init__(self, root, buckets=None, pipeline=True,
-                 donate=True, **start_fields):
+                 donate=True, max_queue=None, tenant_quota=None,
+                 tenant_weights=None, default_deadline_ms=None,
+                 **start_fields):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.cache = AOTExecutableCache(buckets, donate=donate)
@@ -96,17 +133,48 @@ class ServeDriver:
         self.widths: dict = {}
         self._consts: dict = {}
         self._placement: dict = {}
+        self._bounds: dict = {}     # model -> (lo, hi) prior box
         self.queue: deque = deque()
         self.results: dict = {}
-        self.failed: dict = {}
+        self.rejected: dict = {}    # rid -> admission reason
+        self.expired: dict = {}     # rid -> waited_ms at shed time
+        self.quarantined: dict = {} # rid -> quarantine reason
+        # quarantines whose reason is a dispatch failure rather than a
+        # nonfinite result: the INFRA failure class. The CLI exit code
+        # treats these like drops (a poison theta exiting 0 is the
+        # contract; a broken executable exiting 0 would be a lie).
+        self.dispatch_error_quarantines = 0
+        # True once this session wrote or consumed the queue
+        # checkpoint — gates its removal after a full drain
+        self._ckpt_touched = False
+        # set by _requeue_unfinished so run()'s demotion handler does
+        # not pay a second savez+fsync+rotation for identical content
+        # on the exact exit path racing a process restart
+        self._demotion_checkpointed = False
         self._pending: dict = {}    # rid -> [buf, n_filled, Request]
+        self._inflight: dict = {}   # tenant -> unfinished requests
         self._tenant_rec: dict = {}
         self._seq = 0
+        # admission knobs (ctor > env > unbounded); 0 = unbounded
+        self.max_queue = int(
+            max_queue if max_queue is not None
+            else os.environ.get("EWT_SERVE_MAX_QUEUE", 0) or 0)
+        self.tenant_quota = int(
+            tenant_quota if tenant_quota is not None
+            else os.environ.get("EWT_SERVE_TENANT_QUOTA", 0) or 0)
+        self.tenant_weights = dict(tenant_weights or {})
+        self.default_deadline_ms = default_deadline_ms
         self.n_dispatch = 0
         self.n_sequential_equiv = 0   # dispatches a one-per-request
         #                               loop would have issued
-        self.requests_seen = 0
+        self.bisect_dispatches = 0
+        self.requests_submitted = 0   # every submit() call
+        self.requests_seen = 0        # accepted (+ restored)
         self.requests_done = 0
+        self.rejected_requests = 0
+        self.expired_requests = 0
+        self.quarantined_requests = 0
+        self.restored_requests = 0
         self.dropped_requests = 0
         self.pad_rows = 0
         self.real_rows = 0
@@ -146,6 +214,9 @@ class ServeDriver:
         self.widths[name] = width
         self._consts[name] = consts
         self._placement[name] = resolve_placement(consts)
+        # prior support box, resolved once per model: admission-time
+        # theta validation is host numpy against these bounds
+        self._bounds[name] = prior_bounds(like)
         return self.cache.fingerprint(like)
 
     def warm(self, name=None, buckets=None):
@@ -161,31 +232,90 @@ class ServeDriver:
                 for n in names}
 
     # ------------------------- intake ------------------------------ #
-    def submit(self, tenant, model, thetas, rid=None, **meta):
-        """Enqueue one job; returns its request id."""
-        if model not in self.models:
-            raise KeyError(f"model {model!r} is not registered "
-                           f"(have {sorted(self.models)})")
-        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
-        ndim = int(self.models[model].ndim)
-        if thetas.shape[1] != ndim:
-            raise ValueError(
-                f"job thetas have {thetas.shape[1]} dims, model "
-                f"{model!r} expects {ndim}")
+    def submit(self, tenant, model, thetas, rid=None,
+               deadline_ms=None, **meta):
+        """Enqueue one job; returns its request id.
+
+        Admission control (docs/serving.md): thetas are coerced and
+        validated ONCE here (shape, dtype, finiteness, prior
+        support), the queue bound and the tenant's in-flight quota
+        are enforced, and any failure raises a typed
+        :class:`~.admission.Rejection` after recording a
+        ``serve_rejected`` event — a malformed job can never reach
+        the packed dispatch path."""
         self._seq += 1
         rid = rid or f"{tenant}-{self._seq:06d}"
+        # injection site serve.admit BEFORE the accounting bump: an
+        # injected error must leave the shed-accounting identity
+        # untouched (the request entered no bucket)
+        faults.fire("serve.admit", rid=rid, tenant=str(tenant),
+                    model=str(model))
+        self.requests_submitted += 1
+        try:
+            like = self.models.get(model)
+            if like is None:
+                raise UnknownModel(
+                    f"model {model!r} is not registered "
+                    f"(have {sorted(self.models)})")
+            thetas = validate_thetas(thetas, int(like.ndim), model,
+                                     self._bounds.get(model))
+            if self.max_queue and len(self.queue) >= self.max_queue:
+                raise Rejection(
+                    "queue_full",
+                    f"queue is full ({len(self.queue)}/"
+                    f"{self.max_queue}) — backpressure, retry later")
+            if self.tenant_quota and self._inflight.get(
+                    tenant, 0) >= self.tenant_quota:
+                raise Rejection(
+                    "tenant_quota",
+                    f"tenant {tenant!r} already has "
+                    f"{self._inflight[tenant]} request(s) in flight "
+                    f"(quota {self.tenant_quota})")
+        except Rejection as rej:
+            rej.rid = rid
+            self._reject(rid, tenant, model, rej)
+            raise
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        t_submit = profiling.monotonic()
         req = Request(rid=rid, tenant=tenant, model=model,
-                      thetas=thetas, t_submit=profiling.monotonic(),
-                      meta=meta)
+                      thetas=thetas, t_submit=t_submit, meta=meta,
+                      deadline=(None if deadline_ms is None
+                                else t_submit + float(deadline_ms)
+                                / 1e3),
+                      deadline_ms=(None if deadline_ms is None
+                                   else float(deadline_ms)))
         self.queue.append(req)
         self._pending[rid] = [np.empty(req.n, dtype=np.float64), 0,
                               req]
+        self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
         self.requests_seen += 1
         self._c_req.inc()
         self._g_depth.set(len(self.queue))
         self._tenant(tenant).event("serve_request", request_id=rid,
-                                   model=model, n_theta=req.n)
+                                   model=model, n_theta=req.n,
+                                   deadline_ms=req.deadline_ms)
         return rid
+
+    def _reject(self, rid, tenant, model, rej):
+        """Record one typed admission rejection (the request never
+        entered the queue)."""
+        self.rejected[rid] = rej.reason
+        self.rejected_requests += 1
+        telemetry.registry().counter("serve_rejected",
+                                     reason=rej.reason).inc()
+        log.warning("rejected %s (%s): %s", rid, rej.reason,
+                    rej.detail)
+        self._tenant(tenant).event(
+            "serve_rejected", request_id=rid, model=str(model),
+            reason=rej.reason, detail=rej.detail)
+
+    def _dec_inflight(self, tenant):
+        n = self._inflight.get(tenant, 0) - 1
+        if n <= 0:
+            self._inflight.pop(tenant, None)
+        else:
+            self._inflight[tenant] = n
 
     def _tenant(self, tenant):
         rec = self._tenant_rec.get(tenant)
@@ -202,11 +332,22 @@ class ServeDriver:
         the number of batches dispatched."""
         if not self.queue:
             return 0
+        now = profiling.monotonic()
         snapshot: list = []
         by_model: dict = {}
         while self.queue:
             req = self.queue.popleft()
+            # deadline honored at pack time: an expired job is shed
+            # BEFORE costing a dispatch slot
+            if req.deadline is not None and now >= req.deadline:
+                self._expire(req, now)
+                continue
             snapshot.append(req)
+        # weighted tenant fair-share drain order (admission.py): safe
+        # to reorder — at a fixed serve width a row's result is
+        # bit-independent of co-batched content
+        snapshot = fair_share_order(snapshot, self.tenant_weights)
+        for req in snapshot:
             by_model.setdefault(req.model, []).append(req)
         n_batches = 0
         fills = []
@@ -246,17 +387,60 @@ class ServeDriver:
                         if fills else None),
             dispatches=self.n_dispatch,
             requests_done=self.requests_done,
+            requests_rejected=self.rejected_requests,
+            requests_expired=self.expired_requests,
+            requests_quarantined=self.quarantined_requests,
             evals_per_s=round(self.meter.rate(), 1),
             evals_total=self.meter.total)
         return n_batches
+
+    def _expire(self, req, now):
+        """Shed one deadline-expired request at pack time."""
+        waited_ms = (now - req.t_submit) * 1e3
+        self._pending.pop(req.rid, None)
+        self._dec_inflight(req.tenant)
+        self.expired[req.rid] = round(waited_ms, 3)
+        self.expired_requests += 1
+        telemetry.registry().counter("serve_expired",
+                                     tenant=str(req.tenant)).inc()
+        self._tenant(req.tenant).event(
+            "serve_expired", request_id=req.rid, model=req.model,
+            n_theta=req.n, deadline_ms=req.deadline_ms,
+            waited_ms=round(waited_ms, 3))
 
     def run(self):
         """Step until the queue is idle (or a graceful preemption is
         requested), then flush the harvest pipeline. Returns a
         summary dict."""
-        while self.queue and not preemption_requested():
-            self.step()
-        self.pipe.flush()
+        self._demotion_checkpointed = False
+        try:
+            while self.queue and not preemption_requested():
+                self.step()
+            self.pipe.flush()
+        except PlatformDemotion:
+            # a cpu-rung demotion can also surface from a bisect
+            # re-dispatch inside a DEFERRED harvest (the final
+            # flush), outside step()'s requeue handler — the
+            # unfinished work must still be persisted before the
+            # exception crosses the process boundary (step()'s
+            # handler already checkpointed its own demotions)
+            if not self._demotion_checkpointed:
+                self.checkpoint()
+            raise
+        if self.queue or self._pending:
+            # graceful preemption left unfinished work: persist it
+            # (integrity generations) so a restarted replica resumes
+            # the queue with restore()
+            self.checkpoint()
+        elif self._ckpt_touched:
+            # remove only a checkpoint this session wrote or
+            # consumed — a fresh session draining its own trace must
+            # not wipe another session's unconsumed queue
+            remove_checkpoint(self._ckpt_path)
+        elif os.path.exists(self._ckpt_path):
+            log.warning("unconsumed queue checkpoint at %s — was "
+                        "this replica meant to run with --resume?",
+                        self._ckpt_path)
         self._g_depth.set(len(self.queue))
         # the in-loop heartbeats fire before their cycle's harvest has
         # committed; one post-flush beat carries the settled figures
@@ -265,6 +449,9 @@ class ServeDriver:
             nsamp=self.requests_seen, queue_depth=len(self.queue),
             dispatches=self.n_dispatch,
             requests_done=self.requests_done,
+            requests_rejected=self.rejected_requests,
+            requests_expired=self.expired_requests,
+            requests_quarantined=self.quarantined_requests,
             evals_per_s=round(self.meter.rate(), 1),
             evals_total=self.meter.total)
         return self.summary()
@@ -283,6 +470,11 @@ class ServeDriver:
             compiled = self.cache.executable(like, batch.bucket)
 
             def thunk():
+                # injection site serve.dispatch (resilience harness):
+                # error = the supervisor's retry path, hang = the
+                # watchdog/breaker/demotion path
+                faults.fire("serve.dispatch", model=str(model),
+                            bucket=batch.bucket)
                 # donated upload INSIDE the supervised thunk: a REAL
                 # device copy of the host rows (devicestate
                 # contract). The supervisor's transient-error retry
@@ -309,8 +501,10 @@ class ServeDriver:
                 # the exception crosses the process boundary
                 raise
             except Exception as exc:   # noqa: BLE001 — per-batch fail
-                self._fail(batch, exc)
-                return None
+                # a non-demotion batch failure is POISON-SUSPECT:
+                # isolate the offending request by bisection instead
+                # of failing every passenger (docs/serving.md)
+                return self._bisect_failed(model, batch, exc)
         return None
 
     def _requeue_unfinished(self, snapshot):
@@ -328,30 +522,119 @@ class ServeDriver:
             self._pending[req.rid][1] = 0
         self.queue.extendleft(reversed(unfinished))
         self._g_depth.set(len(self.queue))
+        # the process is about to re-enter one platform rung down:
+        # persist the rebuilt queue (integrity generations) so the
+        # restarted replica resumes it with restore()
+        self.checkpoint()
+        self._demotion_checkpointed = True
 
-    def _fail(self, batch, exc):
-        log.error("serve batch against %s failed: %r", batch.model,
-                  exc)
+    def _bisect_failed(self, model, batch, exc):
+        """A whole-batch dispatch failure (past the supervisor's
+        retries): bisect-redispatch to isolate the poison request
+        instead of failing every passenger. Always returns None (the
+        batch's requests are handled here, not by the caller)."""
         telemetry.registry().counter("serve_batch_error").inc()
-        seen = set()
-        for req, _, _, _ in batch.segments:
-            if req.rid in seen or req.rid in self.failed:
+        log.warning("batch against %s failed: %r — isolating",
+                    model, exc)
+        self._bisect_or_quarantine(
+            model, batch,
+            f"dispatch_error: {type(exc).__name__}: {exc}")
+        return None
+
+    def _compact_live(self, batch):
+        """Rebuild ``batch`` with ONLY still-pending requests' rows
+        (same bucket, padding replicated as usual). A re-dispatched
+        half must not carry an already-quarantined request's physical
+        rows — the poison theta would re-contaminate and frame its
+        innocent co-passengers. Returns None when nothing is live."""
+        from .packer import PackedBatch
+        rows = np.empty_like(batch.rows)
+        sub = PackedBatch(model=batch.model, bucket=batch.bucket,
+                          rows=rows, n_real=0)
+        cursor = 0
+        for req, req_start, batch_start, n in batch.segments:
+            if req.rid not in self._pending:
                 continue
-            seen.add(req.rid)
-            self.failed[req.rid] = f"{type(exc).__name__}: {exc}"
-            self._pending.pop(req.rid, None)
-            self.dropped_requests += 1
-            self._tenant(req.tenant).event(
-                "serve_result", request_id=req.rid, model=req.model,
-                error=self.failed[req.rid])
+            rows[cursor:cursor + n] = \
+                batch.rows[batch_start:batch_start + n]
+            sub.segments.append((req, req_start, cursor, n))
+            cursor += n
+        if cursor == 0:
+            return None
+        sub.n_real = cursor
+        if cursor < batch.bucket:
+            rows[cursor:] = rows[cursor - 1]
+        return sub
+
+    def _bisect_or_quarantine(self, model, batch, reason):
+        """``batch`` is poison-suspect as a whole (dispatch exception,
+        or fully non-finite harvest). Compact to the live requests,
+        then: a single live request (or single row) fails ALONE —
+        quarantined; otherwise bisect-redispatch the halves at the
+        same bucket, recursing through the normal harvest path until
+        the poison isolates."""
+        sub = self._compact_live(batch)
+        if sub is None:
+            return
+        live = {}
+        for req, _, _, _ in sub.segments:
+            live.setdefault(req.rid, req)
+        if sub.n_real < batch.n_real:
+            # stale rows rode along (requests quarantined or finished
+            # through another batch) — possibly the poison itself. A
+            # compacted re-dispatch judges the survivors on THEIR OWN
+            # rows before anyone is condemned; if it is still
+            # contaminated, the recursion re-enters here with nothing
+            # left to compact away.
+            out = self._dispatch(model, sub)
+            if out is not None:
+                self.n_dispatch += 1
+                self.bisect_dispatches += 1
+                self._harvest(sub, out)
+            return
+        if sub.n_real < 2 or len(live) < 2:
+            for req in live.values():
+                self._quarantine(req, reason, batch)
+            return
+        log.warning("bisecting a %d-request poison-suspect batch "
+                    "against %s (%s)", len(live), model, reason)
+        telemetry.registry().counter("serve_bisect",
+                                     model=str(model)).inc()
+        for half in split_batch(sub):
+            out = self._dispatch(model, half)
+            if out is not None:
+                self.n_dispatch += 1
+                self.bisect_dispatches += 1
+                self._harvest(half, out)
 
     # ------------------------- harvest ----------------------------- #
     def _harvest(self, batch, out):
         lnl = host_pull(out)
-        for req, req_start, batch_start, n in batch.segments:
+        # injection site serve.harvest: kind ``nonfinite`` poisons
+        # the harvested batch (whole-batch contamination — the
+        # quarantine-bisection vector; a ``where`` filter against the
+        # rid list scopes it to batches carrying a chosen request)
+        spec = faults.fire(
+            "serve.harvest", model=str(batch.model),
+            rids=",".join(sorted({req.rid for req, _, _, _
+                                  in batch.segments})))
+        if spec is not None and spec.kind == "nonfinite":
+            lnl = np.array(lnl, copy=True)
+            lnl[:batch.n_real] = np.nan
+        finite = np.isfinite(np.asarray(lnl[:batch.n_real]))
+        if not finite.all():
+            self._isolate(batch, lnl, finite)
+            return
+        self._apply_rows(batch, lnl, batch.segments)
+
+    def _apply_rows(self, batch, lnl, segments):
+        """Copy harvested rows into the owning requests' result
+        buffers (skipping requests already failed/quarantined
+        elsewhere), finishing any request whose buffer completes."""
+        for req, req_start, batch_start, n in segments:
             slot = self._pending.get(req.rid)
             if slot is None:
-                continue            # request already failed elsewhere
+                continue
             buf, filled, _ = slot
             buf[req_start:req_start + n] = \
                 lnl[batch_start:batch_start + n]
@@ -359,8 +642,83 @@ class ServeDriver:
             if slot[1] == req.n:
                 self._finish(req, buf, batch)
 
+    def _isolate(self, batch, lnl, finite):
+        """Post-harvest poison attribution (docs/serving.md): map the
+        nonfinite rows back to requests through the pack segments.
+
+        - Partial contamination attributes directly: the poisoned
+          request(s) are quarantined, everyone whose rows are finite
+          finishes from THIS dispatch (bit-equal rows).
+        - A fully-contaminated multi-request batch is ambiguous (a
+          batch-level NaN bleed can shadow the true source):
+          bisect-redispatch halves at the same bucket until the
+          poison isolates. Clean halves return rows bit-equal to a
+          clean run (fixed-width contract), so co-tenants see zero
+          casualties."""
+        live: list = []
+        live_reqs: dict = {}
+        bad_by_req: dict = {}
+        for seg in batch.segments:
+            req, _, batch_start, n = seg
+            if req.rid not in self._pending:
+                continue
+            live.append(seg)
+            live_reqs.setdefault(req.rid, req)
+            seg_bad = bool((~finite[batch_start:batch_start + n])
+                           .any())
+            bad_by_req[req.rid] = bad_by_req.get(req.rid,
+                                                 False) or seg_bad
+        if not live:
+            return
+        if not finite.any():
+            # fully contaminated: attribution is ambiguous (a batch-
+            # level NaN bleed can shadow the true source) — compact
+            # to the live requests and bisect-redispatch
+            self._bisect_or_quarantine(batch.model, batch,
+                                       "nonfinite_result")
+            return
+        for rid, req in live_reqs.items():
+            if bad_by_req[rid]:
+                self._quarantine(req, "nonfinite_result", batch)
+        # the survivors finish from THIS dispatch (bit-equal rows);
+        # _apply_rows skips the just-quarantined slots
+        self._apply_rows(batch, lnl, live)
+
+    def _quarantine(self, req, reason, batch=None):
+        """Fail exactly ONE poisoned request: typed event, flight-
+        recorder forensics, ``serve_quarantined{tenant=}`` counter.
+        Co-tenants are untouched — the zero-casualty contract."""
+        faults.fire("serve.quarantine", rid=req.rid,
+                    tenant=str(req.tenant))
+        slot = self._pending.pop(req.rid, None)
+        if slot is None:
+            return
+        self._dec_inflight(req.tenant)
+        self.quarantined[req.rid] = reason
+        self.quarantined_requests += 1
+        if reason.startswith("dispatch_error"):
+            self.dispatch_error_quarantines += 1
+        telemetry.registry().counter("serve_quarantined",
+                                     tenant=str(req.tenant)).inc()
+        log.error("quarantined request %s (%s): %s", req.rid,
+                  req.tenant, reason)
+        from ..utils.flightrec import flight_recorder
+        # forensics: the offending theta head, non-finite-safe (the
+        # ring's dump encoder preserves NaN/Inf as strings)
+        theta_head = [[float(v) if np.isfinite(v) else str(v)
+                       for v in row] for row in req.thetas[:4]]
+        flight_recorder().record(
+            "serve_quarantined", rid=req.rid, tenant=req.tenant,
+            model=str(req.model), reason=reason,
+            theta_head=theta_head)
+        self._tenant(req.tenant).event(
+            "serve_quarantined", request_id=req.rid,
+            model=str(req.model), n_theta=req.n, reason=reason,
+            bucket=(batch.bucket if batch is not None else None))
+
     def _finish(self, req, lnl, batch):
         del self._pending[req.rid]
+        self._dec_inflight(req.tenant)
         self.results[req.rid] = lnl
         self.requests_done += 1
         latency_ms = (profiling.monotonic() - req.t_submit) * 1e3
@@ -370,6 +728,12 @@ class ServeDriver:
                   bucket=batch.bucket,
                   batch_fill=round(batch.fill, 4),
                   lnl_max=float(np.max(lnl)))
+        if req.deadline_ms is not None:
+            # deadline accounting: the requested budget and whether
+            # the result beat it (a completion can still miss — the
+            # shed only happens at pack time)
+            ev["deadline_ms"] = req.deadline_ms
+            ev["deadline_met"] = bool(latency_ms <= req.deadline_ms)
         if req.n <= _INLINE_LNL_ROWS:
             ev["lnl"] = [float(v) for v in lnl]
         self._tenant(req.tenant).event("serve_result", **ev)
@@ -377,6 +741,115 @@ class ServeDriver:
             {"rid": req.rid, "tenant": req.tenant, "model": req.model,
              "n": req.n, "latency_ms": round(latency_ms, 3),
              "bucket": batch.bucket, "fill": round(batch.fill, 4)})
+
+    # ------------------------- queue checkpoint -------------------- #
+    @property
+    def _ckpt_path(self):
+        return os.path.join(self.root, "state.npz")
+
+    def checkpoint(self):
+        """Persist every unfinished request (queued + mid-drain) to
+        ``<root>/state.npz`` with integrity generations
+        (``io/writers.py:checkpoint_replace``): sha256 sidecar +
+        last-good ``state.prev.npz`` rotation. Deadlines are stored
+        as REMAINING budget so a restore re-arms them relative to the
+        restore instant. Model names must be strings (the CLI's
+        registry contract)."""
+        self._ckpt_touched = True
+        reqs = [slot[2] for slot in self._pending.values()]
+        if not reqs:
+            remove_checkpoint(self._ckpt_path)
+            return None
+        now = profiling.monotonic()
+        rem = np.array([np.nan if r.deadline is None
+                        else max((r.deadline - now) * 1e3, 0.0)
+                        for r in reqs])
+        tmp = self._ckpt_path + ".tmp.npz"
+        np.savez(
+            tmp,
+            flat=np.concatenate([r.thetas.ravel() for r in reqs]),
+            shapes=np.array([[r.n, r.thetas.shape[1]] for r in reqs],
+                            dtype=np.int64),
+            rids=np.array([r.rid for r in reqs]),
+            tenants=np.array([str(r.tenant) for r in reqs]),
+            models=np.array([str(r.model) for r in reqs]),
+            deadline_rem_ms=rem, seq=self._seq)
+        checkpoint_replace(tmp, self._ckpt_path)
+        self.rec.event("checkpoint", phase="serve_queue",
+                       n=len(reqs))
+        return self._ckpt_path
+
+    def restore(self):
+        """Restore unfinished requests from the queue checkpoint
+        (digest-verified, last-good generation fallback). Call AFTER
+        registering the models. Returns the number restored (0 when
+        no restorable checkpoint exists). Restored requests keep
+        their rids (no new ``serve_request`` events — they were
+        announced by the session that accepted them); a request whose
+        model is no longer registered is recorded as rejected."""
+        self._ckpt_touched = True
+        path = resolve_checkpoint(self._ckpt_path,
+                                  what="serve queue checkpoint")
+        if path is None:
+            return 0
+        n = 0
+        now = profiling.monotonic()
+        with np.load(path) as z:
+            self._seq = max(self._seq, int(z["seq"]))
+            flat, shapes = z["flat"], z["shapes"]
+            rem = z["deadline_rem_ms"]
+            offset = 0
+            for i, rid in enumerate(str(x) for x in z["rids"]):
+                rows, ndim = int(shapes[i][0]), int(shapes[i][1])
+                thetas = flat[offset:offset + rows * ndim] \
+                    .reshape(rows, ndim).copy()
+                offset += rows * ndim
+                tenant = str(z["tenants"][i])
+                model = str(z["models"][i])
+                try:
+                    like = self.models.get(model)
+                    if like is None:
+                        raise UnknownModel(
+                            f"checkpointed request {rid} names model "
+                            f"{model!r}, no longer registered", rid)
+                    # re-validate against the CURRENT registration: a
+                    # geometry change between sessions must surface as
+                    # a typed restore-time rejection, not the
+                    # mid-drain shape crash admission exists to stop
+                    thetas = validate_thetas(
+                        thetas, int(like.ndim), model,
+                        self._bounds.get(model))
+                except Rejection as rej:
+                    rej.rid = rid
+                    # counted on the submitted side too, so the
+                    # accounting identity (accepted == submitted -
+                    # rejected + restored) stays balanced for a
+                    # rejection that never went through submit()
+                    self.requests_submitted += 1
+                    self._reject(rid, tenant, model, rej)
+                    continue
+                rem_ms = float(rem[i])
+                req = Request(
+                    rid=rid, tenant=tenant, model=model,
+                    thetas=thetas, t_submit=now,
+                    deadline=(None if np.isnan(rem_ms)
+                              else now + max(rem_ms, 0.0) / 1e3),
+                    deadline_ms=(None if np.isnan(rem_ms)
+                                 else rem_ms))
+                self.queue.append(req)
+                self._pending[rid] = [np.empty(req.n,
+                                               dtype=np.float64), 0,
+                                      req]
+                self._inflight[tenant] = \
+                    self._inflight.get(tenant, 0) + 1
+                n += 1
+        self.requests_seen += n
+        self.restored_requests += n
+        self._g_depth.set(len(self.queue))
+        self.rec.event("checkpoint", phase="serve_restore", n=n)
+        log.info("restored %d unfinished request(s) from %s", n,
+                 path)
+        return n
 
     # ------------------------- teardown ---------------------------- #
     def summary(self):
@@ -389,10 +862,41 @@ class ServeDriver:
             return lat_sorted[min(int(p * len(lat_sorted)),
                                   len(lat_sorted) - 1)]
 
+        unfinished = len(self._pending)
+        accounting = {
+            "submitted": self.requests_submitted,
+            "restored": self.restored_requests,
+            "accepted": self.requests_seen,
+            "done": self.requests_done,
+            "rejected": self.rejected_requests,
+            "expired": self.expired_requests,
+            "quarantined": self.quarantined_requests,
+            "failed": self.dropped_requests,
+            "unfinished": unfinished,
+        }
+        # shed accounting must balance: every request ends in exactly
+        # one bucket (the sentinel's serve gate holds the chaos storm
+        # to this invariant)
+        accounting["balanced"] = bool(
+            self.requests_seen == self.requests_done
+            + self.expired_requests + self.quarantined_requests
+            + self.dropped_requests + unfinished
+            and self.requests_seen == self.requests_submitted
+            - self.rejected_requests + self.restored_requests)
         return {
             "requests_seen": self.requests_seen,
             "requests_done": self.requests_done,
             "dropped_requests": self.dropped_requests,
+            "rejected_requests": self.rejected_requests,
+            "expired_requests": self.expired_requests,
+            "quarantined_requests": self.quarantined_requests,
+            "dispatch_error_quarantines":
+                self.dispatch_error_quarantines,
+            "restored_requests": self.restored_requests,
+            "bisect_dispatches": self.bisect_dispatches,
+            "accounting": accounting,
+            "max_queue": self.max_queue or None,
+            "tenant_quota": self.tenant_quota or None,
             "queue_depth": len(self.queue),
             "dispatches": self.n_dispatch,
             "sequential_dispatch_equiv": self.n_sequential_equiv,
@@ -424,7 +928,12 @@ class ServeDriver:
         self._tenant_rec.clear()
         self.rec.event("serve_summary", **{
             k: final[k] for k in ("requests_seen", "requests_done",
-                                  "dropped_requests", "dispatches",
+                                  "dropped_requests",
+                                  "rejected_requests",
+                                  "expired_requests",
+                                  "quarantined_requests",
+                                  "dispatch_error_quarantines",
+                                  "bisect_dispatches", "dispatches",
                                   "dispatch_reduction",
                                   "mean_batch_fill")})
         self._stack.close()

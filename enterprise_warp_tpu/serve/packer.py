@@ -38,7 +38,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["PackedBatch", "pack_requests"]
+__all__ = ["PackedBatch", "pack_requests", "split_batch"]
 
 
 @dataclass
@@ -114,3 +114,39 @@ def pack_requests(requests, width):
     if acc:
         emit(acc)
     return batches
+
+
+def split_batch(batch: PackedBatch):
+    """Split a batch's real rows at the midpoint into two batches at
+    the SAME bucket width — the quarantine bisection step
+    (``driver.py``; docs/serving.md).
+
+    The halves keep the original bucket so the fixed-serve-width
+    contract holds: a clean row re-dispatched inside a half returns a
+    result bit-equal to the original dispatch (row results at one
+    width are bit-independent of co-batched content), which is what
+    lets the driver finish a poisoned batch's innocent co-tenants with
+    zero casualties. Segments spanning the cut are divided; padding
+    replicates each half's last real row as usual."""
+    if batch.n_real < 2:
+        raise ValueError("cannot bisect a batch with fewer than 2 "
+                         "real rows")
+    cut = batch.n_real // 2
+    halves = []
+    for row_lo, row_hi in ((0, cut), (cut, batch.n_real)):
+        n_real = row_hi - row_lo
+        rows = np.empty((batch.bucket, batch.rows.shape[1]),
+                        dtype=batch.rows.dtype)
+        rows[:n_real] = batch.rows[row_lo:row_hi]
+        rows[n_real:] = rows[n_real - 1]
+        half = PackedBatch(model=batch.model, bucket=batch.bucket,
+                           rows=rows, n_real=n_real)
+        for req, req_start, batch_start, n in batch.segments:
+            lo = max(batch_start, row_lo)
+            hi = min(batch_start + n, row_hi)
+            if lo < hi:
+                half.segments.append(
+                    (req, req_start + (lo - batch_start),
+                     lo - row_lo, hi - lo))
+        halves.append(half)
+    return halves

@@ -21,8 +21,16 @@ This module makes all three first-class, off the hot path:
   stream (``<run_dir>/events.jsonl``; atomic appends, periodic flush)
   with typed events: ``run_start`` (config hash, jax/backend versions,
   devices), ``compile``, ``heartbeat`` (step, acceptance, evals/s,
-  cache_hit_rate, worst R-hat/ESS), ``checkpoint``, ``run_end``.
-  ``tools/report.py`` folds the stream into ``run_report.json``.
+  cache_hit_rate, worst R-hat/ESS), ``checkpoint``, ``run_end``;
+  the resilience layer adds ``fault``/``retry``/``demotion`` and
+  ``ckpt_corrupt`` (a checkpoint generation failed digest
+  verification at restore — ``io/writers.py:resolve_checkpoint``),
+  the serving plane adds ``serve_request``/``serve_result``/
+  ``serve_rejected``/``serve_expired``/``serve_quarantined``/
+  ``serve_summary`` (docs/serving.md). The authoritative vocabulary
+  lives in ``tools/report.py:KNOWN_EVENT_TYPES`` — ``--check`` flags
+  anything undeclared. ``tools/report.py`` folds the stream into
+  ``run_report.json``.
 
 Everything is disabled by ``EWT_TELEMETRY=0``: recorders become
 no-ops, the registry hands out no-op metrics, and :func:`traced`

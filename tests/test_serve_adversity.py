@@ -1,0 +1,786 @@
+"""Serving-under-adversity + checkpoint-integrity tests (PR:
+admission control, deadlines, poison quarantine, checkpoint
+generations — docs/serving.md "Serving under adversity",
+docs/resilience.md "Checkpoint integrity generations")."""
+
+import importlib.util
+import io
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.io.writers import (checkpoint_exists,
+                                            checkpoint_replace,
+                                            prev_generation,
+                                            remove_checkpoint,
+                                            resolve_checkpoint,
+                                            sidecar_path,
+                                            verify_checkpoint)
+from enterprise_warp_tpu.resilience import faults
+from enterprise_warp_tpu.utils import telemetry
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"ewt_tool_adv_{name}", str(REPO_ROOT / "tools" / f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.install_plan(None)
+
+
+# ------------------------------------------------------------------ #
+#  checkpoint integrity generations (io/writers.py)                   #
+# ------------------------------------------------------------------ #
+
+def _write_gen(path, step):
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, step=step)
+    checkpoint_replace(tmp, path)
+
+
+class TestCheckpointGenerations:
+    def test_sidecar_and_rotation(self, tmp_path):
+        p = str(tmp_path / "state.npz")
+        _write_gen(p, 1)
+        assert verify_checkpoint(p) is True
+        assert resolve_checkpoint(p) == p
+        _write_gen(p, 2)
+        prev = prev_generation(p)
+        assert os.path.exists(prev)
+        assert verify_checkpoint(prev) is True
+        assert int(np.load(resolve_checkpoint(p))["step"]) == 2
+        assert int(np.load(prev)["step"]) == 1
+
+    def test_corrupt_falls_back_one_generation(self, tmp_path):
+        p = str(tmp_path / "state.npz")
+        _write_gen(p, 1)
+        _write_gen(p, 2)
+        with open(p, "r+b") as fh:
+            fh.seek(10)
+            fh.write(b"\x00\x00\x00\x00")
+        snap0 = telemetry.registry().snapshot()["counters"].get(
+            "ckpt_verify{outcome=corrupt}", 0)
+        r = resolve_checkpoint(p)
+        assert r == prev_generation(p)
+        assert int(np.load(r)["step"]) == 1
+        snap1 = telemetry.registry().snapshot()["counters"].get(
+            "ckpt_verify{outcome=corrupt}", 0)
+        assert snap1 == snap0 + 1
+
+    def test_both_generations_corrupt_is_none(self, tmp_path):
+        p = str(tmp_path / "state.npz")
+        _write_gen(p, 1)
+        _write_gen(p, 2)
+        for cand in (p, prev_generation(p)):
+            with open(cand, "r+b") as fh:
+                fh.seek(8)
+                fh.write(b"\xff\xff\xff\xff")
+        assert resolve_checkpoint(p) is None
+
+    def test_legacy_without_sidecar_accepted(self, tmp_path):
+        p = str(tmp_path / "state.npz")
+        _write_gen(p, 7)
+        os.remove(sidecar_path(p))
+        assert verify_checkpoint(p) is None
+        assert resolve_checkpoint(p) == p
+
+    def test_remove_and_exists(self, tmp_path):
+        p = str(tmp_path / "state.npz")
+        _write_gen(p, 1)
+        _write_gen(p, 2)
+        assert checkpoint_exists(p)
+        remove_checkpoint(p)
+        assert not checkpoint_exists(p)
+        assert not os.path.exists(sidecar_path(p))
+        assert not os.path.exists(prev_generation(p))
+
+    def test_repeat_resolve_memoized_single_telemetry(self,
+                                                      tmp_path):
+        """One logical resume resolves the checkpoint twice (the
+        convergence driver, then the sampler) — unchanged files must
+        not re-hash or double-count corruption telemetry (review
+        hardening)."""
+        p = str(tmp_path / "state.npz")
+        _write_gen(p, 1)
+        _write_gen(p, 2)
+        with open(p, "r+b") as fh:
+            fh.seek(10)
+            fh.write(b"\x00\x00\x00\x00")
+
+        def corrupt_count():
+            return telemetry.registry().snapshot()["counters"].get(
+                "ckpt_verify{outcome=corrupt}", 0)
+
+        c0 = corrupt_count()
+        r1 = resolve_checkpoint(p)
+        assert corrupt_count() == c0 + 1
+        r2 = resolve_checkpoint(p)          # memo hit: same verdict,
+        assert r2 == r1                     # no second corrupt event
+        assert corrupt_count() == c0 + 1
+        _write_gen(p, 3)                    # a write invalidates
+        assert resolve_checkpoint(p) == p
+        assert corrupt_count() == c0 + 1
+
+    def test_ckpt_verify_fault_site_torn(self, tmp_path):
+        """The ``ckpt.verify`` site's ``torn`` kind physically rots
+        the archive so the restore must fall back."""
+        p = str(tmp_path / "state.npz")
+        _write_gen(p, 5)
+        _write_gen(p, 6)
+        faults.install_plan({"faults": [
+            {"site": "ckpt.verify", "kind": "torn", "at": 1,
+             "frac": 0.25}]})
+        r = resolve_checkpoint(p)
+        assert r == prev_generation(p)
+        assert int(np.load(r)["step"]) == 5
+
+
+def test_pt_digest_rotation_resume_bit_equal(tmp_path):
+    """A digest-corrupted PT ``state.npz`` resumes from the previous
+    generation and replays to a chain bit-equal to the uninterrupted
+    run (the acceptance contract)."""
+    import sys
+    sys.path.insert(0, str(REPO_ROOT / "tests"))
+    from test_samplers import GaussianLike
+
+    from enterprise_warp_tpu.samplers import PTSampler
+
+    def mk():
+        return GaussianLike([1.0, -2.0], [0.3, 0.7])
+
+    opts = dict(ntemps=2, nchains=8, seed=0, cov_update=100)
+    full = PTSampler(mk(), str(tmp_path / "full"), **opts)
+    full.sample(400, resume=False, verbose=False, block_size=100)
+    ch_full = np.loadtxt(tmp_path / "full" / "chain_1.txt")
+
+    part = PTSampler(mk(), str(tmp_path / "split"), **opts)
+    part.sample(200, resume=False, verbose=False, block_size=100)
+    ckpt = str(tmp_path / "split" / "state.npz")
+    assert os.path.exists(prev_generation(ckpt))   # >= 2 generations
+    with open(ckpt, "r+b") as fh:                  # digest rot
+        fh.seek(os.path.getsize(ckpt) // 2)
+        fh.write(b"\xde\xad\xbe\xef")
+    res = PTSampler(mk(), str(tmp_path / "split"), **opts)
+    res.sample(400, resume=True, verbose=False, block_size=100)
+    ch_res = np.loadtxt(tmp_path / "split" / "chain_1.txt")
+    assert np.array_equal(ch_full, ch_res)
+
+
+# ------------------------------------------------------------------ #
+#  admission control                                                  #
+# ------------------------------------------------------------------ #
+
+def _toy_like(ndim=2):
+    import sys
+    sys.path.insert(0, str(REPO_ROOT / "tests"))
+    from test_samplers import GaussianLike
+    return GaussianLike([0.0] * ndim, [1.0] * ndim, lo=-5.0, hi=5.0)
+
+
+def _driver(root, like, width=8, buckets=(1, 2, 4, 8), **kw):
+    from enterprise_warp_tpu.serve import ServeDriver
+    drv = ServeDriver(str(root), buckets=buckets, **kw)
+    drv.register("m0", like, width=width)
+    return drv
+
+
+class TestAdmission:
+    def test_typed_rejections(self, tmp_path):
+        from enterprise_warp_tpu.serve import Rejection
+        like = _toy_like()
+        with _driver(tmp_path / "adm", like) as drv:
+            cases = [
+                (np.full((1, 2), np.nan), "nonfinite"),
+                (np.ones((1, 3)), "bad_shape"),
+                (np.ones((2, 2, 2)), "bad_shape"),
+                (np.full((1, 2), 99.0), "prior_support"),
+                ([["a", "b"]], "bad_dtype"),
+            ]
+            for thetas, reason in cases:
+                with pytest.raises(Rejection) as ei:
+                    drv.submit("t0", "m0", thetas)
+                assert ei.value.reason == reason
+            # unknown model: typed AND KeyError-compatible
+            with pytest.raises(KeyError, match="not registered"):
+                drv.submit("t0", "nope", np.zeros((1, 2)))
+            with pytest.raises(Rejection):
+                drv.submit("t0", "nope", np.zeros((1, 2)))
+            assert drv.rejected_requests == 7
+            assert drv.requests_seen == 0
+            s = drv.run() if drv.queue else drv.summary()
+            assert s["accounting"]["balanced"]
+        # every rejection is a typed event on the tenant stream
+        evs = [json.loads(ln) for ln in open(
+            tmp_path / "adm" / "tenants" / "t0" / "events.jsonl")]
+        rej = [e for e in evs if e["type"] == "serve_rejected"]
+        assert len(rej) == 7
+        assert all(e.get("reason") and e.get("detail") for e in rej)
+
+    def test_queue_bound_and_quota(self, tmp_path):
+        from enterprise_warp_tpu.serve import Rejection
+        like = _toy_like()
+        with _driver(tmp_path / "bound", like, max_queue=3,
+                     tenant_quota=2) as drv:
+            drv.submit("t0", "m0", np.zeros((1, 2)))
+            drv.submit("t0", "m0", np.zeros((1, 2)))
+            with pytest.raises(Rejection) as ei:
+                drv.submit("t0", "m0", np.zeros((1, 2)))
+            assert ei.value.reason == "tenant_quota"
+            drv.submit("t1", "m0", np.zeros((1, 2)))
+            with pytest.raises(Rejection) as ei:
+                drv.submit("t2", "m0", np.zeros((1, 2)))
+            assert ei.value.reason == "queue_full"
+            s = drv.run()
+        assert s["requests_done"] == 3
+        assert s["rejected_requests"] == 2
+        assert s["accounting"]["balanced"]
+
+    def test_admit_fault_drill_keeps_accounting_balanced(self,
+                                                         tmp_path):
+        """An injected serve.admit error (the documented drill) is
+        not a Rejection — it must leave the shed-accounting identity
+        untouched (review hardening: the site fires BEFORE the
+        submitted-side bump)."""
+        like = _toy_like()
+        faults.install_plan({"faults": [
+            {"site": "serve.admit", "kind": "error", "at": 1}]})
+        with _driver(tmp_path / "drill", like) as drv:
+            with pytest.raises(faults.InjectedFault):
+                drv.submit("t0", "m0", np.zeros((1, 2)))
+            drv.submit("t0", "m0", np.zeros((1, 2)))
+            s = drv.run()
+        faults.install_plan(None)
+        assert s["requests_done"] == 1
+        assert s["accounting"]["submitted"] == 1
+        assert s["accounting"]["balanced"], s["accounting"]
+
+    def test_fair_share_order_unit(self):
+        from enterprise_warp_tpu.serve import fair_share_order
+
+        class R:
+            def __init__(self, rid, tenant):
+                self.rid, self.tenant = rid, tenant
+
+        # greedy t0 floods; t1/t2 each one job
+        reqs = [R(f"g{i}", "t0") for i in range(5)] \
+            + [R("a", "t1"), R("b", "t2")]
+        order = [r.rid for r in fair_share_order(reqs)]
+        # round-robin: one per tenant per cycle, FIFO within tenant
+        assert order[:3] == ["g0", "a", "b"]
+        assert order[3:] == ["g1", "g2", "g3", "g4"]
+        # weights grant bigger shares per cycle
+        order_w = [r.rid for r in fair_share_order(
+            reqs, weights={"t0": 2})]
+        assert order_w[:4] == ["g0", "g1", "a", "b"]
+
+    def test_driver_fair_share_under_greedy_tenant(self, tmp_path):
+        """A greedy tenant's burst must not starve a later tenant:
+        with fair-share the small tenant rides the FIRST batch."""
+        like = _toy_like()
+        rng = np.random.default_rng(0)
+        with _driver(tmp_path / "greedy", like, width=2,
+                     buckets=(1, 2)) as drv:
+            for i in range(6):
+                drv.submit("greedy", "m0", like.sample_prior(rng, 1),
+                           rid=f"g{i}")
+            drv.submit("small", "m0", like.sample_prior(rng, 1),
+                       rid="s0")
+            s = drv.run()
+        assert s["requests_done"] == 7
+        done_order = [r["rid"] for r in drv.request_log]
+        # batch 1 (width 2) = fair-share heads g0 + s0 — the small
+        # tenant finishes in the first batch, not after the burst
+        assert "s0" in done_order[:2], done_order
+
+    def test_parse_serve_config(self):
+        from enterprise_warp_tpu.serve import parse_serve_config
+        cfg = parse_serve_config(
+            "max_queue=64 tenant_quota=8 default_deadline_ms=5000 "
+            "weight.gold=4")
+        assert cfg == {"max_queue": 64, "tenant_quota": 8,
+                       "default_deadline_ms": 5000.0,
+                       "tenant_weights": {"gold": 4.0}}
+        # the paramfile parser whitespace-splits values into a list
+        assert parse_serve_config(["max_queue=8"]) == {"max_queue": 8}
+        assert parse_serve_config(None) == {}
+        with pytest.raises(ValueError, match="unknown serve config"):
+            parse_serve_config("bogus_knob=1")
+
+    def test_paramfile_serve_key(self, tmp_path):
+        from enterprise_warp_tpu.config import Params
+        pr = tmp_path / "p.dat"
+        pr.write_text("paramfile_label: x\n"
+                      "out: out/\n"
+                      "serve: max_queue=16 tenant_quota=4\n"
+                      "{0}\n")
+        params = Params(str(pr), opts=None, init_pulsars=False)
+        from enterprise_warp_tpu.serve import parse_serve_config
+        assert parse_serve_config(params.serve) == {
+            "max_queue": 16, "tenant_quota": 4}
+
+
+# ------------------------------------------------------------------ #
+#  deadlines                                                          #
+# ------------------------------------------------------------------ #
+
+class TestDeadlines:
+    def test_expiry_at_pack_time(self, tmp_path):
+        like = _toy_like()
+        with _driver(tmp_path / "dl", like) as drv:
+            ok = drv.submit("t0", "m0", np.zeros((1, 2)),
+                            deadline_ms=60000.0)
+            dead = drv.submit("t0", "m0", np.zeros((1, 2)),
+                              deadline_ms=0.0)
+            s = drv.run()
+        assert s["requests_done"] == 1 and ok in drv.results
+        assert s["expired_requests"] == 1 and dead in drv.expired
+        assert dead not in drv.results
+        assert s["accounting"]["balanced"]
+        evs = [json.loads(ln) for ln in open(
+            tmp_path / "dl" / "tenants" / "t0" / "events.jsonl")]
+        exp = [e for e in evs if e["type"] == "serve_expired"]
+        assert len(exp) == 1 and exp[0]["request_id"] == dead
+        assert exp[0]["waited_ms"] >= 0.0
+        # completed-with-deadline reports the budget in its result
+        res = [e for e in evs if e["type"] == "serve_result"
+               and e["request_id"] == ok]
+        assert res[0]["deadline_ms"] == 60000.0
+        assert res[0]["deadline_met"] is True
+
+    def test_default_deadline_from_config(self, tmp_path):
+        like = _toy_like()
+        with _driver(tmp_path / "dl2", like,
+                     default_deadline_ms=0.0) as drv:
+            rid = drv.submit("t0", "m0", np.zeros((1, 2)))
+            s = drv.run()
+        assert s["expired_requests"] == 1 and rid in drv.expired
+
+
+# ------------------------------------------------------------------ #
+#  poison quarantine                                                  #
+# ------------------------------------------------------------------ #
+
+class TestQuarantine:
+    def _jobs(self, like, n, rng):
+        return [(f"t{i % 3}", like.sample_prior(rng, 1), f"r{i}")
+                for i in range(n)]
+
+    def test_one_poison_row_in_full_bucket(self, tmp_path):
+        """One poison row in a full width-8 bucket: exactly that
+        request quarantined, every co-tenant bit-equal to a clean
+        run, shed accounting balanced."""
+        like = _toy_like()
+        rng = np.random.default_rng(1)
+        jobs = self._jobs(like, 8, rng)        # exactly one bucket
+        with _driver(tmp_path / "clean", like) as drv:
+            for t, th, rid in jobs:
+                drv.submit(t, "m0", th, rid=rid)
+            drv.run()
+            clean = {r: drv.results[r].copy() for _, _, r in jobs}
+        faults.install_plan({"faults": [
+            {"site": "serve.harvest", "kind": "nonfinite",
+             "where": "r3"}]})
+        with _driver(tmp_path / "poison", like) as drv:
+            for t, th, rid in jobs:
+                drv.submit(t, "m0", th, rid=rid)
+            s = drv.run()
+        faults.install_plan(None)
+        assert set(drv.quarantined) == {"r3"}
+        assert s["quarantined_requests"] == 1
+        assert s["requests_done"] == 7
+        assert s["dropped_requests"] == 0
+        assert s["bisect_dispatches"] > 0
+        assert s["accounting"]["balanced"]
+        for _, _, rid in jobs:
+            if rid != "r3":
+                assert np.array_equal(drv.results[rid], clean[rid]), \
+                    f"co-tenant casualty: {rid}"
+        # typed event + counter + registry label
+        t1 = tmp_path / "poison" / "tenants" / "t0" / "events.jsonl"
+        evs = [json.loads(ln) for ln in open(t1)]
+        q = [e for e in evs if e["type"] == "serve_quarantined"]
+        assert len(q) == 1 and q[0]["request_id"] == "r3"
+        assert q[0]["reason"] == "nonfinite_result"
+        counters = telemetry.registry().snapshot()["counters"]
+        assert counters.get("serve_quarantined{tenant=t0}", 0) >= 1
+
+    def test_partial_contamination_attributes_directly(self,
+                                                       tmp_path):
+        """Nonfinite rows that map cleanly onto one request are
+        quarantined WITHOUT bisection (attribution is direct)."""
+        like = _toy_like()
+        rng = np.random.default_rng(2)
+        jobs = self._jobs(like, 4, rng)
+        # poison only r2's row post-harvest: monkeypatch-free — use
+        # a one-shot injected poison scoped by where, but on a batch
+        # with partial attribution we emulate via a likelihood that
+        # NaNs on a marker theta instead
+        marker = np.full((1, 2), 4.75)
+
+        import jax
+        import jax.numpy as jnp
+
+        base = like._fn
+
+        def poisoned(theta):
+            hit = jnp.all(jnp.abs(theta - 4.75) < 1e-12)
+            return jnp.where(hit, jnp.nan, base(theta))
+
+        like.loglike_batch = jax.jit(jax.vmap(poisoned))
+        with _driver(tmp_path / "direct", like) as drv:
+            for t, th, rid in jobs:
+                drv.submit(t, "m0", th, rid=rid)
+            bad = drv.submit("tbad", "m0", marker, rid="bad")
+            s = drv.run()
+        assert set(drv.quarantined) == {"bad"}
+        assert s["requests_done"] == 4
+        # direct attribution: no bisect dispatches needed
+        assert s["bisect_dispatches"] == 0
+        assert s["accounting"]["balanced"]
+
+    def test_dispatch_exception_bisects(self, tmp_path,
+                                        monkeypatch):
+        """A whole-batch dispatch exception isolates the poison by
+        bisection instead of failing every passenger."""
+        like = _toy_like()
+        rng = np.random.default_rng(3)
+        jobs = self._jobs(like, 5, rng)
+        marker = np.full((1, 2), 4.75)
+        with _driver(tmp_path / "exc", like) as drv:
+            real_exec = drv.cache.executable
+
+            def tripwire_exec(lk, bucket):
+                compiled = real_exec(lk, bucket)
+
+                def run(rows_dev, consts):
+                    rows = np.asarray(rows_dev)
+                    if np.any(np.all(np.abs(rows - 4.75) < 1e-12,
+                                     axis=1)):
+                        raise RuntimeError("poisoned batch crash")
+                    return compiled(rows_dev, consts)
+                return run
+
+            monkeypatch.setattr(drv.cache, "executable",
+                                tripwire_exec)
+            for t, th, rid in jobs:
+                drv.submit(t, "m0", th, rid=rid)
+            drv.submit("tbad", "m0", marker, rid="bad")
+            s = drv.run()
+        assert set(drv.quarantined) == {"bad"}
+        assert drv.quarantined["bad"].startswith("dispatch_error")
+        assert s["requests_done"] == 5
+        assert s["dropped_requests"] == 0
+        # the INFRA failure class is split out: a dispatch-error
+        # quarantine must fail the serve CLI's exit code (a poison
+        # theta exiting 0 is the contract, a broken executable is not)
+        assert s["dispatch_error_quarantines"] == 1
+        assert s["accounting"]["balanced"]
+
+
+# ------------------------------------------------------------------ #
+#  serve queue checkpoint                                             #
+# ------------------------------------------------------------------ #
+
+class TestQueueCheckpoint:
+    def test_roundtrip_and_corruption_fallback(self, tmp_path):
+        like = _toy_like()
+        root = tmp_path / "q"
+        drv = _driver(root, like)
+        drv.submit("t0", "m0", np.zeros((2, 2)), rid="q0")
+        drv.submit("t1", "m0", np.ones((1, 2)), rid="q1",
+                   deadline_ms=60000.0)
+        drv.checkpoint()                       # generation 1 (2 reqs)
+        drv.submit("t2", "m0", np.zeros((1, 2)), rid="q2")
+        drv.checkpoint()                       # generation 2 (3 reqs)
+        drv.close()
+        ckpt = str(root / "state.npz")
+        with open(ckpt, "r+b") as fh:          # rot the newest
+            fh.seek(os.path.getsize(ckpt) // 2)
+            fh.write(b"\xde\xad\xbe\xef")
+        drv2 = _driver(root, like)
+        n = drv2.restore()
+        assert n == 2                          # the PREV generation
+        assert {r.rid for r in drv2.queue} == {"q0", "q1"}
+        s = drv2.run()
+        drv2.close()
+        assert s["requests_done"] == 2
+        assert s["restored_requests"] == 2
+        assert s["accounting"]["balanced"]
+        # drained run removes every generation
+        assert not checkpoint_exists(ckpt)
+
+    def test_restore_unknown_model_balances(self, tmp_path):
+        """A checkpointed request whose model is no longer registered
+        is rejected at restore — and the accounting identity still
+        balances (review hardening: the restore-side rejection must
+        count on the submitted side too)."""
+        like = _toy_like()
+        root = tmp_path / "qm"
+        drv = _driver(root, like)
+        drv.submit("t0", "m0", np.zeros((1, 2)), rid="k0")
+        # second request against a model the next session won't have
+        drv.register("m1", like, width=8)
+        drv.submit("t0", "m1", np.zeros((1, 2)), rid="k1")
+        drv.checkpoint()
+        drv.close()
+        drv2 = _driver(root, like)       # registers only m0
+        assert drv2.restore() == 1
+        assert drv2.rejected == {"k1": "unknown_model"}
+        s = drv2.run()
+        drv2.close()
+        assert s["requests_done"] == 1
+        assert s["accounting"]["balanced"], s["accounting"]
+
+    def test_restore_revalidates_geometry(self, tmp_path):
+        """A restored request is re-validated against the CURRENT
+        model registration: a geometry change between sessions is a
+        typed restore-time rejection, never a mid-drain shape crash
+        (review hardening)."""
+        like2 = _toy_like(ndim=2)
+        root = tmp_path / "qg"
+        drv = _driver(root, like2)
+        drv.submit("t0", "m0", np.zeros((1, 2)), rid="g0")
+        drv.checkpoint()
+        drv.close()
+        drv2 = _driver(root, _toy_like(ndim=3))   # m0 grew a dim
+        assert drv2.restore() == 0
+        assert drv2.rejected == {"g0": "bad_shape"}
+        s = drv2.summary()
+        drv2.close()
+        assert s["accounting"]["balanced"], s["accounting"]
+
+    def test_unconsumed_checkpoint_preserved(self, tmp_path):
+        """A session that neither wrote nor consumed the queue
+        checkpoint must not delete it when its own trace drains — a
+        restart without --resume cannot silently destroy another
+        session's unfinished requests (review hardening)."""
+        like = _toy_like()
+        root = tmp_path / "qu"
+        drv = _driver(root, like)
+        drv.submit("t0", "m0", np.zeros((1, 2)), rid="u0")
+        drv.checkpoint()
+        drv.close()
+        # fresh session, fresh trace, NO restore: drains fully
+        drv2 = _driver(root, like)
+        drv2.submit("t1", "m0", np.ones((1, 2)), rid="v0")
+        s2 = drv2.run()
+        drv2.close()
+        assert s2["requests_done"] == 1
+        assert os.path.exists(root / "state.npz")   # preserved
+        # the checkpointed request is still recoverable
+        drv3 = _driver(root, like)
+        assert drv3.restore() == 1
+        s3 = drv3.run()
+        drv3.close()
+        assert "u0" in drv3.results
+        assert not checkpoint_exists(str(root / "state.npz"))
+
+    def test_demotion_during_final_flush_checkpoints(self, tmp_path,
+                                                     monkeypatch):
+        """A cpu-rung demotion surfacing from the FINAL deferred
+        flush (a bisect re-dispatch inside run()'s pipe.flush) must
+        still persist the unfinished queue before propagating
+        (review hardening)."""
+        from enterprise_warp_tpu.resilience.supervisor import \
+            PlatformDemotion
+        like = _toy_like()
+        root = tmp_path / "qf"
+        drv = _driver(root, like)
+        drv.submit("t0", "m0", np.zeros((1, 2)), rid="f0")
+        real_flush = drv.pipe.flush
+        state = {"n": 0}
+
+        def demoting_flush():
+            if state["n"] == 0:
+                state["n"] = 1
+                raise PlatformDemotion("classic", None,
+                                       "serve.dispatch")
+            return real_flush()
+
+        monkeypatch.setattr(drv.pipe, "flush", demoting_flush)
+        with pytest.raises(PlatformDemotion):
+            drv.run()
+        assert os.path.exists(root / "state.npz")
+        drv.close()
+        drv2 = _driver(root, like)
+        assert drv2.restore() == 1
+        s = drv2.run()
+        drv2.close()
+        assert "f0" in drv2.results and s["accounting"]["balanced"]
+
+    def test_restore_rearms_remaining_deadline(self, tmp_path):
+        like = _toy_like()
+        root = tmp_path / "qd"
+        drv = _driver(root, like)
+        drv.submit("t0", "m0", np.zeros((1, 2)), rid="d0",
+                   deadline_ms=0.0)            # already expired
+        drv.submit("t0", "m0", np.zeros((1, 2)), rid="d1",
+                   deadline_ms=120000.0)
+        drv.checkpoint()
+        drv.close()
+        drv2 = _driver(root, like)
+        assert drv2.restore() == 2
+        s = drv2.run()
+        drv2.close()
+        assert "d0" in drv2.expired            # stayed expired
+        assert "d1" in drv2.results            # budget carried over
+        assert s["accounting"]["balanced"]
+
+
+# ------------------------------------------------------------------ #
+#  report + sentinel folds                                            #
+# ------------------------------------------------------------------ #
+
+class TestToolingFolds:
+    def test_report_check_accepts_adversity_events(self, tmp_path):
+        report = _load_tool("report")
+        stream = tmp_path / "events.jsonl"
+        t0 = 1000.0
+        evs = [
+            {"t": t0, "type": "run_start", "sampler": "serve"},
+            {"t": t0, "type": "serve_request", "request_id": "r0",
+             "model": "m", "n_theta": 1, "deadline_ms": None},
+            {"t": t0, "type": "serve_request", "request_id": "r1",
+             "model": "m", "n_theta": 1, "deadline_ms": 5.0},
+            {"t": t0, "type": "serve_request", "request_id": "r2",
+             "model": "m", "n_theta": 1, "deadline_ms": None},
+            {"t": t0, "type": "serve_rejected", "request_id": "x0",
+             "model": "m", "reason": "queue_full", "detail": "full"},
+            {"t": t0, "type": "serve_expired", "request_id": "r1",
+             "model": "m", "n_theta": 1, "deadline_ms": 5.0,
+             "waited_ms": 9.0},
+            {"t": t0, "type": "serve_quarantined",
+             "request_id": "r2", "model": "m", "n_theta": 1,
+             "reason": "nonfinite_result", "bucket": 8},
+            {"t": t0, "type": "serve_result", "request_id": "r0",
+             "model": "m", "n_theta": 1, "latency_ms": 3.0,
+             "bucket": 8, "batch_fill": 1.0, "lnl_max": -1.0},
+            {"t": t0, "type": "ckpt_corrupt", "path": "state.npz",
+             "generation": 0, "what": "pt checkpoint"},
+            {"t": t0 + 1, "type": "heartbeat", "phase": "serve",
+             "step": 1, "requests_rejected": 1, "requests_expired": 1,
+             "requests_quarantined": 1, "queue_depth": 0},
+            {"t": t0 + 2, "type": "run_end", "status": "ok"},
+        ]
+        with open(stream, "w") as fh:
+            for ev in evs:
+                fh.write(json.dumps(ev) + "\n")
+        problems = report.check_stream(str(stream), out=io.StringIO())
+        assert problems == 0
+        loaded, _ = report.load_events(str(stream))
+        rep = report.build_report(loaded)
+        sv = rep["serve"]
+        assert sv["rejected"] == 1
+        assert sv["rejected_reasons"] == {"queue_full": 1}
+        assert sv["expired"] == 1
+        assert sv["quarantined"] == 1
+        assert sv["quarantined_requests"] == ["r2"]
+        assert sv["shed_balanced"] is True
+
+    def _serve_record(self):
+        return {
+            "metric": "serve_multi_tenant",
+            "warm_speedup": 120.0,
+            "dispatch_reduction": 9.0,
+            "padded_bit_equal": True,
+            "trace": {"dropped_requests": 0,
+                      "latency_ms": {"p50": 15.0, "p99": 30.0}},
+        }
+
+    def _chaos_serve(self, **over):
+        doc = {"co_tenant_casualties": 0,
+               "accounting_balanced": True,
+               "queue_drained": True,
+               "quarantined": ["r-poison"],
+               "rejected": {"x0": "queue_full"},
+               "pass": True}
+        doc.update(over)
+        return doc
+
+    def test_sentinel_serve_gate_chaos_checks(self, tmp_path):
+        sentinel = _load_tool("sentinel")
+        bd = tmp_path / "bench"
+        os.makedirs(bd)
+        with open(bd / "BENCH_SERVE.json", "w") as fh:
+            json.dump(self._serve_record(), fh)
+        # no CHAOS.json at all: bench-only checkout, still pass
+        g = sentinel.gate_serve(str(bd))
+        assert g["status"] == "pass"
+        assert "storm unproven" in g["detail"]
+        # CHAOS.json WITHOUT a serve section: the storm is owed
+        with open(bd / "CHAOS.json", "w") as fh:
+            json.dump({"pass": True}, fh)
+        g = sentinel.gate_serve(str(bd))
+        assert g["status"] == "fail"
+        assert "chaos.py --serve" in g["detail"]
+        # healthy storm record -> pass
+        with open(bd / "CHAOS.json", "w") as fh:
+            json.dump({"pass": True,
+                       "serve": self._chaos_serve()}, fh)
+        assert sentinel.gate_serve(str(bd))["status"] == "pass"
+        # each storm invariant gates
+        for over, frag in [
+            ({"co_tenant_casualties": 2}, "casualt"),
+            ({"accounting_balanced": False}, "accounting"),
+            ({"queue_drained": False}, "drained"),
+            ({"pass": False}, "FAIL"),
+        ]:
+            with open(bd / "CHAOS.json", "w") as fh:
+                json.dump({"pass": True,
+                           "serve": self._chaos_serve(**over)}, fh)
+            g = sentinel.gate_serve(str(bd))
+            assert g["status"] == "fail", over
+            assert frag in g["detail"], (frag, g["detail"])
+
+    def test_committed_chaos_serve_record_passes(self):
+        """The committed CHAOS.json serve section must satisfy the
+        gate (the acceptance contract of this layer)."""
+        with open(REPO_ROOT / "CHAOS.json") as fh:
+            chaos = json.load(fh)
+        sv = chaos.get("serve")
+        assert isinstance(sv, dict), "CHAOS.json lacks serve section"
+        assert sv["pass"] is True
+        assert sv["co_tenant_casualties"] == 0
+        assert sv["accounting_balanced"] is True
+        assert sv["queue_drained"] is True
+        assert sv["quarantined"] == ["r-poison"]
+
+
+# ------------------------------------------------------------------ #
+#  the serving chaos storm, end to end (slow tier)                    #
+# ------------------------------------------------------------------ #
+
+@pytest.mark.slow
+def test_serve_chaos_storm_smoke(tmp_path):
+    """The seeded overload-plus-poison serve storm vs a clean
+    reference: zero co-tenant casualties, exactly the poison
+    quarantined, typed rejections, demotion/exit-75/--resume drain,
+    balanced accounting (acceptance criteria)."""
+    chaos = _load_tool("chaos")
+    out = tmp_path / "CHAOS.json"
+    rc = chaos.main(["--seed", "0", "--serve",
+                     "--workdir", str(tmp_path / "wd"),
+                     "--output", str(out)])
+    rec = json.loads(out.read_text())["serve"]
+    assert rc == 0, rec
+    assert rec["pass"] is True
+    assert rec["co_tenant_casualties"] == 0
+    assert rec["quarantined"] == ["r-poison"]
+    assert rec["expired"] == ["d-expired"]
+    assert sorted(set(rec["rejected"].values())) == [
+        "nonfinite", "queue_full"]
+    assert rec["accounting_balanced"] is True
+    assert rec["demotion_exit"] == 75 and rec["resume_exit"] == 0
+    assert rec["ckpt_written"] and rec["ckpt_cleared_after_drain"]
+    assert rec["stream_check_exit"] == 0

@@ -3,17 +3,34 @@
 
 Runs a small self-contained PTMCMC campaign twice — once uninterrupted
 (the reference), once under a randomized-but-seeded storm of injected
-process kills, transient dispatch errors, a dispatch hang, and a torn
-event-stream write (the resilience harness, ``EWT_FAULT_PLAN``) — and
-asserts the recovered campaign is **bit-equal** to the uninterrupted
-one, with every fault visible in telemetry and zero torn artifacts
-(``tools/report.py --check`` exits 0). The verdict is written to
-``CHAOS.json``, the robustness counterpart of the BENCH artifacts.
+process kills, transient dispatch errors, a dispatch hang, a torn
+event-stream write, and (when enough checkpoint generations exist) a
+physical digest-rot corruption of ``state.npz`` (the resilience
+harness, ``EWT_FAULT_PLAN`` + direct byte flips) — and asserts the
+recovered campaign is **bit-equal** to the uninterrupted one, with
+every fault visible in telemetry, the corrupted checkpoint restored
+from its previous generation (``ckpt_corrupt`` event), and zero torn
+artifacts (``tools/report.py --check`` exits 0). The verdict is
+written to ``CHAOS.json``, the robustness counterpart of the BENCH
+artifacts.
+
+``--serve`` runs the SERVING-plane storm instead (docs/serving.md):
+a clean reference serve leg vs an overload-plus-poison storm — a
+burst past ``max_queue`` (typed ``queue_full`` rejections), NaN-theta
+submissions (typed ``nonfinite`` rejections), a zero-deadline job
+(shed at pack time), an injected harvest poison scoped to one request
+(quarantine bisection), and one dispatch hang (watchdog -> demotion
+-> exit 75 with the queue checkpointed -> ``--resume`` restart). The
+verdict — every non-poison request bit-equal to the clean leg,
+exactly the poison quarantined, shed accounting balanced, queue
+drained — lands in CHAOS.json under ``"serve"``, which the sentinel's
+serve gate enforces.
 
 Usage::
 
-    python tools/chaos.py --seed 0                 # full soak
+    python tools/chaos.py --seed 0                 # full PT soak
     python tools/chaos.py --seed 0 --nsamp 300 --blocks 3   # smoke
+    python tools/chaos.py --seed 0 --serve         # serving storm
     python tools/chaos.py --seed 0 --workdir /tmp/chaos --keep
 
 Each campaign leg is a real ``enterprise_warp_tpu.cli`` subprocess, so
@@ -140,6 +157,30 @@ def find_one(pattern):
     return hits[0] if hits else None
 
 
+def corrupt_checkpoint(workdir):
+    """Physically rot the chaos leg's ``state.npz`` mid-file (keeping
+    its sidecar), IF a previous generation exists to fall back to.
+    Returns True when a corruption was planted. The next resume must
+    detect the digest mismatch (``ckpt_corrupt`` event) and restore
+    from ``state.prev.npz`` — still bit-equal, because resume-
+    equivalence replays the lost block deterministically."""
+    st = find_one(os.path.join(workdir, "out_chaos", "**",
+                               "state.npz"))
+    if not st:
+        return False
+    prev = st[:-len(".npz")] + ".prev.npz"
+    if not (os.path.exists(prev) and os.path.exists(st + ".sha256")
+            and os.path.exists(prev + ".sha256")):
+        return False
+    size = os.path.getsize(st)
+    if size < 16:
+        return False
+    with open(st, "r+b") as fh:
+        fh.seek(size // 2)
+        fh.write(b"\xde\xad\xbe\xef")
+    return True
+
+
 def stream_events(path):
     out = []
     if path and os.path.exists(path):
@@ -154,6 +195,267 @@ def stream_events(path):
     return out
 
 
+def merge_record(output, record, key=None):
+    """Write ``record`` to CHAOS.json, preserving the other storm
+    mode's section (PT storm = top level, serve storm = ``serve``)."""
+    existing = {}
+    if os.path.exists(output):
+        try:
+            with open(output) as fh:
+                existing = json.load(fh)
+        except ValueError:
+            existing = {}
+    if not isinstance(existing, dict):
+        existing = {}
+    if key is None:
+        if "serve" in existing:
+            record = dict(record, serve=existing["serve"])
+    else:
+        merged = existing
+        merged[key] = record
+        record = merged
+    from enterprise_warp_tpu.io.writers import atomic_write_json
+    atomic_write_json(output, record, indent=1)
+
+
+# ------------------------------------------------------------------ #
+#  the serving-plane storm (--serve)                                  #
+# ------------------------------------------------------------------ #
+
+def build_serve_traces(prfile, workdir, seed):
+    """The deterministic request traces: a core trace (shared by the
+    clean and storm legs, explicit rids so legs compare row-by-row —
+    one of them, ``r-poison``, is the harvest-poison target) and the
+    storm extras (a zero-deadline job, NaN thetas, an overload
+    burst). Returns (clean_path, storm_path, n_core, poison_rid)."""
+    import numpy as np
+
+    from enterprise_warp_tpu.serve.cli import build_serve_models
+
+    models, _ = build_serve_models(os.path.join(workdir, prfile))
+    name = sorted(models)[0]
+    like = models[name]
+    rng = np.random.default_rng(seed + 500)
+    tenants = ("t0", "t1", "t2")
+    core = []
+    for i in range(10):
+        n = int(1 + rng.integers(4))
+        core.append({
+            "rid": f"r{i:02d}", "tenant": tenants[i % 3],
+            "model": name,
+            "thetas": np.asarray(like.sample_prior(rng, n),
+                                 dtype=np.float64).tolist()})
+    poison_rid = "r-poison"
+    core.append({"rid": poison_rid, "tenant": "t1", "model": name,
+                 "thetas": np.asarray(like.sample_prior(rng, 2),
+                                      dtype=np.float64).tolist()})
+    extras = [{"rid": "d-expired", "tenant": "t2", "model": name,
+               "deadline_ms": 0.0,
+               "thetas": np.asarray(like.sample_prior(rng, 1),
+                                    dtype=np.float64).tolist()}]
+    for j in range(2):
+        extras.append({"rid": f"x-nan{j}", "tenant": "t0",
+                       "model": name,
+                       "thetas": [[float("nan")] * int(like.ndim)]})
+    for j in range(4):
+        extras.append({"rid": f"o-{j:02d}", "tenant": "t2",
+                       "model": name,
+                       "thetas": np.asarray(like.sample_prior(rng, 1),
+                                            dtype=np.float64)
+                       .tolist()})
+    clean_path = os.path.join(workdir, "trace_clean.json")
+    storm_path = os.path.join(workdir, "trace_storm.json")
+    with open(clean_path, "w") as fh:
+        json.dump(core, fh)
+    with open(storm_path, "w") as fh:
+        json.dump(core + extras, fh)
+    return clean_path, storm_path, len(core), poison_rid
+
+
+def run_serve_leg(workdir, prfile, out, requests=None, resume=False,
+                  plan=None, env_extra=None, timeout=900):
+    """One serve-CLI subprocess; returns (rc, stdout, stderr_tail)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["EWT_FLIGHTREC"] = "1"
+    env.pop("EWT_FAULT_PLAN", None)
+    if plan is not None:
+        env["EWT_FAULT_PLAN"] = json.dumps(plan)
+    env.update(env_extra or {})
+    cmd = [sys.executable, "-m", "enterprise_warp_tpu.cli", "serve",
+           "-p", prfile, "-o", out]
+    if resume:
+        cmd.append("--resume")
+    else:
+        cmd += ["--requests", requests]
+    r = subprocess.run(cmd, cwd=workdir, env=env, timeout=timeout,
+                       capture_output=True)
+    return (r.returncode, r.stdout.decode("utf-8", "replace"),
+            r.stderr.decode("utf-8", "replace")[-2000:])
+
+
+def fold_serve_streams(root):
+    """Fold every tenant stream under ``root`` into per-rid verdicts:
+    ``lnl[rid]`` (successful results), plus the rejected / expired /
+    quarantined rid sets and the accepted-request count."""
+    lnl, rejected = {}, {}
+    done, expired, quarantined = set(), set(), set()
+    accepted = 0
+    for path in sorted(glob.glob(os.path.join(
+            root, "tenants", "*", "events.jsonl"))):
+        for ev in stream_events(path):
+            t = ev.get("type")
+            rid = ev.get("request_id")
+            if t == "serve_request":
+                accepted += 1
+            elif t == "serve_result" and not ev.get("error"):
+                done.add(rid)
+                if "lnl" in ev:
+                    lnl[rid] = ev["lnl"]
+            elif t == "serve_rejected":
+                rejected[rid] = ev.get("reason")
+            elif t == "serve_expired":
+                expired.add(rid)
+            elif t == "serve_quarantined":
+                quarantined.add(rid)
+    return {"accepted": accepted, "lnl": lnl, "done": done,
+            "rejected": rejected, "expired": expired,
+            "quarantined": quarantined}
+
+
+def serve_storm(opts, workdir):
+    """The serving-plane chaos storm (module docstring). Returns the
+    CHAOS.json ``serve`` record."""
+    make_dataset(workdir, opts.seed)
+    prfile = "serve.dat"
+    write_prfile(workdir, prfile, "out_serve", 100, 50)
+    clean_tr, storm_tr, n_core, poison_rid = build_serve_traces(
+        prfile, workdir, opts.seed)
+
+    base_env = {"EWT_SERVE_BUCKETS": "1,2,4,8", "EWT_SERVE_WIDTH": "8"}
+    print(f"[chaos:serve] workdir={workdir} seed={opts.seed} "
+          f"core={n_core} poison={poison_rid}", flush=True)
+
+    rc, out, err = run_serve_leg(workdir, prfile, "serve_ref",
+                                 requests=clean_tr,
+                                 env_extra=base_env)
+    if rc != 0:
+        print(f"[chaos:serve] clean leg failed (exit {rc}):\n{err}",
+              file=sys.stderr)
+        return {"pass": False, "error": f"clean leg exit {rc}"}
+    print("[chaos:serve] clean reference leg complete", flush=True)
+
+    # the storm: queue bounded one past the legitimate load (the
+    # first overload request is admitted, the rest bounce), one
+    # transient dispatch error (retried), one dispatch hang
+    # (watchdog -> demotion -> exit 75 with the queue checkpointed),
+    # and a harvest poison scoped to r-poison
+    n_accept = n_core + 2            # + d-expired + o-00
+    storm_env = dict(base_env,
+                     EWT_SERVE_MAX_QUEUE=str(n_accept),
+                     EWT_WATCHDOG_S="3.0")
+    poison_fault = {"site": "serve.harvest", "kind": "nonfinite",
+                    "where": poison_rid}
+    plan1 = {"faults": [
+        {"site": "serve.dispatch", "kind": "error", "at": 1},
+        {"site": "serve.dispatch", "kind": "hang", "at": 3,
+         "hang_s": 60},
+        poison_fault,
+    ]}
+    rc1, out1, err1 = run_serve_leg(workdir, prfile, "serve_storm",
+                                    requests=storm_tr, plan=plan1,
+                                    env_extra=storm_env)
+    print(f"[chaos:serve] storm leg 1: exit {rc1} "
+          f"(75 = demoted/checkpointed)", flush=True)
+    root = os.path.join(workdir, "serve_storm")
+    ckpt_written = os.path.exists(os.path.join(root, "state.npz"))
+
+    rc2, out2, err2 = (0, "", "")
+    if rc1 == 75:
+        # the external-supervisor restart: resume the checkpointed
+        # queue (the harvest poison stays armed — its request may
+        # still be unfinished)
+        rc2, out2, err2 = run_serve_leg(
+            workdir, prfile, "serve_storm", resume=True,
+            plan={"faults": [poison_fault]}, env_extra=storm_env)
+        print(f"[chaos:serve] storm leg 2 (--resume): exit {rc2}",
+              flush=True)
+
+    # ---- verification ------------------------------------------- #
+    ref = fold_serve_streams(os.path.join(workdir, "serve_ref"))
+    storm = fold_serve_streams(root)
+    core_rids = [f"r{i:02d}" for i in range(10)]
+    casualties = []
+    for rid in core_rids:
+        if storm["lnl"].get(rid) != ref["lnl"].get(rid) \
+                or storm["lnl"].get(rid) is None:
+            casualties.append(rid)
+    final_summary = {}
+    for line in (out2 or out1).splitlines():
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and "requests_done" in doc:
+            final_summary = doc
+    accepted = storm["accepted"]
+    done = len(storm["done"])
+    balanced = (accepted == done + len(storm["expired"])
+                + len(storm["quarantined"]))
+    drained = (rc2 == 0 if rc1 == 75 else rc1 == 0) and \
+        final_summary.get("queue_depth") == 0
+    ckpt_cleared = not os.path.exists(os.path.join(root, "state.npz"))
+    check_rc = 1
+    ev_path = os.path.join(root, "events.jsonl")
+    if os.path.exists(ev_path):
+        check_rc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "report.py"),
+             root, "--check"], capture_output=True).returncode
+    ok = (not casualties
+          and storm["quarantined"] == {poison_rid}
+          and "d-expired" in storm["expired"]
+          and len(storm["rejected"]) == 5
+          and sorted(set(storm["rejected"].values()))
+          == ["nonfinite", "queue_full"]
+          and rc1 == 75 and ckpt_written and rc2 == 0
+          and balanced and drained and ckpt_cleared
+          and check_rc == 0)
+    record = {
+        "seed": opts.seed,
+        "core_requests": n_core,
+        "accepted": accepted,
+        "done": done,
+        "rejected": {k: v for k, v in
+                     sorted(storm["rejected"].items())},
+        "expired": sorted(storm["expired"]),
+        "quarantined": sorted(storm["quarantined"]),
+        "co_tenant_casualties": len(casualties),
+        "casualty_rids": casualties,
+        "accounting_balanced": balanced,
+        "queue_drained": bool(drained),
+        "demotion_exit": rc1,
+        "ckpt_written": bool(ckpt_written),
+        "ckpt_cleared_after_drain": bool(ckpt_cleared),
+        "resume_exit": rc2,
+        "stream_check_exit": check_rc,
+        "final_summary": {
+            k: final_summary.get(k)
+            for k in ("requests_done", "quarantined_requests",
+                      "restored_requests", "queue_depth",
+                      "dropped_requests")},
+        "pass": bool(ok),
+    }
+    print(f"[chaos:serve] casualties={len(casualties)} "
+          f"quarantined={sorted(storm['quarantined'])} "
+          f"rejected={len(storm['rejected'])} "
+          f"expired={sorted(storm['expired'])} balanced={balanced} "
+          f"drained={drained} check="
+          f"{'clean' if check_rc == 0 else 'DIRTY'}", flush=True)
+    print(f"[chaos:serve] {'PASS' if ok else 'FAIL'}", flush=True)
+    return record
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -163,12 +465,24 @@ def main(argv=None):
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--keep", action="store_true",
                     help="keep the workdir for inspection")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the serving-plane storm instead of the "
+                         "PT campaign storm (CHAOS.json 'serve' key)")
     ap.add_argument("--output", default=os.path.join(REPO,
                                                      "CHAOS.json"))
     opts = ap.parse_args(argv)
 
     workdir = opts.workdir or tempfile.mkdtemp(prefix="ewt_chaos_")
     os.makedirs(workdir, exist_ok=True)
+
+    if opts.serve:
+        record = serve_storm(opts, workdir)
+        merge_record(opts.output, record, key="serve")
+        print(f"[chaos:serve] -> {opts.output}", flush=True)
+        if not opts.keep and opts.workdir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return 0 if record.get("pass") else 1
+
     cov_update = max(opts.nsamp // opts.blocks, 1)
     make_dataset(workdir, opts.seed)
     ref_pr = write_prfile(workdir, "ref.dat", "out_ref", opts.nsamp,
@@ -188,7 +502,7 @@ def main(argv=None):
     rng = random.Random(opts.seed)
     storm = build_storm(rng, opts.blocks)
     attempts = []
-    kills = hangs = 0
+    kills = hangs = ckpt_corruptions = 0
     for attempt in range(1, MAX_ATTEMPTS + 1):
         plan = storm[attempt - 1] if attempt <= len(storm) else None
         watchdog = plan.pop("watchdog_s") if plan else 0.0
@@ -216,6 +530,14 @@ def main(argv=None):
                 [sys.executable, os.path.join(REPO, "tools",
                                               "report.py"),
                  ev_path, "--repair"], capture_output=True)
+        # once per storm, after a kill has left >= 2 checkpoint
+        # generations: physically rot state.npz so the NEXT resume
+        # must digest-fail it and fall back to state.prev.npz
+        if ckpt_corruptions == 0 and attempt >= 2 \
+                and corrupt_checkpoint(workdir):
+            ckpt_corruptions += 1
+            print("[chaos] corrupted state.npz (digest rot); next "
+                  "resume must fall back one generation", flush=True)
     else:
         print("[chaos] storm never completed within "
               f"{MAX_ATTEMPTS} attempts", file=sys.stderr)
@@ -244,13 +566,21 @@ def main(argv=None):
     n_fault_ev = sum(1 for ev in events if ev.get("type") == "fault")
     n_demotion = sum(1 for ev in events
                      if ev.get("type") == "demotion")
+    n_ckpt_corrupt = sum(1 for ev in events
+                         if ev.get("type") == "ckpt_corrupt")
     dispatch_faults = sum(
         1 for ev in events
         if ev.get("type") == "fault" and ev.get("kind") == "error"
         and str(ev.get("site", "")).endswith(".dispatch"))
 
+    # an injected digest rot MUST have been detected (the resume that
+    # followed emits ckpt_corrupt and falls back a generation); at
+    # smoke scale a storm may never accumulate 2 generations, in
+    # which case no corruption was planted and nothing is owed
+    corrupt_ok = (ckpt_corruptions == 0 or n_ckpt_corrupt >= 1)
     ok = (completed and bit_equal and check_rc == 0
-          and kills >= 3 and dispatch_faults >= 2 and hangs >= 1)
+          and kills >= 3 and dispatch_faults >= 2 and hangs >= 1
+          and corrupt_ok)
     record = {
         "seed": opts.seed,
         "nsamp": opts.nsamp,
@@ -260,16 +590,19 @@ def main(argv=None):
                    "dispatch_faults": dispatch_faults,
                    "demotion_events": n_demotion,
                    "retry_events": n_retry,
-                   "fault_events": n_fault_ev},
+                   "fault_events": n_fault_ev,
+                   "ckpt_corruptions": ckpt_corruptions,
+                   "ckpt_corrupt_events": n_ckpt_corrupt},
         "bit_equal": bit_equal,
         "stream_check_exit": check_rc,
         "completed": completed,
         "pass": ok,
     }
-    from enterprise_warp_tpu.io.writers import atomic_write_json
-    atomic_write_json(opts.output, record, indent=1)
+    merge_record(opts.output, record)
     print(f"[chaos] kills={kills} dispatch_faults={dispatch_faults} "
           f"hangs={hangs} demotions={n_demotion} retries={n_retry} "
+          f"ckpt_corruptions={ckpt_corruptions}"
+          f"/{n_ckpt_corrupt} detected "
           f"bit_equal={bit_equal} check={'clean' if check_rc == 0 else 'DIRTY'}",
           flush=True)
     print(f"[chaos] {'PASS' if ok else 'FAIL'} -> {opts.output}",

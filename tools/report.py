@@ -63,8 +63,14 @@ KNOWN_EVENT_TYPES = frozenset({
     "span", "cost_analysis", "anomaly", "fault", "retry", "demotion",
     "run_lineage", "metrics_export", "mixing",
     # serving layer (enterprise_warp_tpu/serve, docs/serving.md):
-    # per-tenant request/result stream + the driver's final roll-up
+    # per-tenant request/result stream + the driver's final roll-up,
+    # plus the adversity vocabulary — typed admission rejections,
+    # deadline sheds, and poison quarantines
     "serve_request", "serve_result", "serve_summary",
+    "serve_rejected", "serve_expired", "serve_quarantined",
+    # checkpoint integrity generations (io/writers.py,
+    # docs/resilience.md): a digest-verification failure at restore
+    "ckpt_corrupt",
 })
 
 #: the heartbeat field vocabulary — every field any sampler/driver
@@ -90,8 +96,10 @@ KNOWN_HEARTBEAT_FIELDS = frozenset({
     "lnz", "dlogz", "scale", "insertion_ks", "converged",
     "scale_min", "scale_max", "budget_exhaust_frac",
     "first_accept_frac",
-    # serving layer (queue pressure + packing efficiency)
+    # serving layer (queue pressure + packing efficiency + shed
+    # accounting)
     "queue_depth", "batch_fill", "dispatches", "requests_done",
+    "requests_rejected", "requests_expired", "requests_quarantined",
     # VI / CEM drivers
     "elbo", "best_lnpost", "is_ess",
 })
@@ -471,7 +479,11 @@ def _fold_serve(by_type):
     results = by_type.get("serve_result", [])
     requests = by_type.get("serve_request", [])
     summaries = by_type.get("serve_summary", [])
-    if not (results or requests or summaries):
+    rejected = by_type.get("serve_rejected", [])
+    expired = by_type.get("serve_expired", [])
+    quarantined = by_type.get("serve_quarantined", [])
+    if not (results or requests or summaries or rejected or expired
+            or quarantined):
         return None
     lats = sorted(float(ev["latency_ms"]) for ev in results
                   if ev.get("latency_ms") is not None)
@@ -481,10 +493,32 @@ def _fold_serve(by_type):
             return None
         return lats[min(int(p * len(lats)), len(lats) - 1)]
 
+    reject_reasons: dict = {}
+    for ev in rejected:
+        r = str(ev.get("reason", "?"))
+        reject_reasons[r] = reject_reasons.get(r, 0) + 1
+    ok_results = sum(1 for ev in results if not ev.get("error"))
+    errors = sum(1 for ev in results if ev.get("error"))
     out = {
         "requests": len(requests),
         "results": len(results),
-        "errors": sum(1 for ev in results if ev.get("error")),
+        "errors": errors,
+        # shed accounting (docs/serving.md): every accepted request
+        # ends in exactly one bucket — completed, expired,
+        # quarantined, or errored. Unbalanced = work went missing
+        # (sessions still draining fold as unbalanced too; the
+        # sentinel gates the FINAL chaos-storm fold)
+        "rejected": len(rejected),
+        "rejected_reasons": reject_reasons or None,
+        "expired": len(expired),
+        "quarantined": len(quarantined),
+        "quarantined_requests": sorted(
+            {str(ev.get("request_id")) for ev in quarantined}),
+        "shed_balanced": bool(
+            len(requests) == ok_results + len(expired)
+            + len(quarantined) + errors) if requests else None,
+        "deadline_missed": sum(
+            1 for ev in results if ev.get("deadline_met") is False),
         "latency_ms": {"p50": q(0.5), "p90": q(0.9), "p99": q(0.99),
                        "max": lats[-1] if lats else None},
     }
@@ -492,7 +526,12 @@ def _fold_serve(by_type):
         s = summaries[-1]
         out["driver_summary"] = {
             k: s.get(k) for k in ("requests_seen", "requests_done",
-                                  "dropped_requests", "dispatches",
+                                  "dropped_requests",
+                                  "rejected_requests",
+                                  "expired_requests",
+                                  "quarantined_requests",
+                                  "dispatch_error_quarantines",
+                                  "bisect_dispatches", "dispatches",
                                   "dispatch_reduction",
                                   "mean_batch_fill")}
     return out
@@ -587,6 +626,10 @@ def _human_summary(report, out=sys.stdout):
         lat = sv.get("latency_ms") or {}
         line = (f"serve: {sv['results']} result(s), "
                 f"{sv['errors']} error(s)")
+        shed = [f"{sv[k]} {k}" for k in ("rejected", "expired",
+                                         "quarantined") if sv.get(k)]
+        if shed:
+            line += " [" + ", ".join(shed) + "]"
         if lat.get("p50") is not None:
             line += (f", latency p50 {lat['p50']}ms / "
                      f"p99 {lat['p99']}ms")

@@ -374,7 +374,16 @@ def gate_serve(bench_dir, min_warm_speedup=10.0, min_dispatch_red=8.0,
       would fail the next check);
     - **zero dropped requests** and **bit-equality** of packed
       results vs the single-job path (the fixed-serve-width
-      contract).
+      contract);
+    - **adversity storm** (CHAOS.json ``serve`` section, written by
+      ``tools/chaos.py --serve`` — docs/serving.md): zero co-tenant
+      casualties under the seeded overload-plus-poison storm, exactly
+      the poison quarantined, shed accounting balanced (accepted =
+      done + expired + quarantined), and the queue drained through
+      the demotion/exit-75/--resume cycle. A committed CHAOS.json
+      WITHOUT the serve section fails (the storm is part of this
+      layer's acceptance); no CHAOS.json at all only warns in the
+      detail (bench-only checkouts).
     """
     doc = _load_json(os.path.join(bench_dir, "BENCH_SERVE.json"))
     if not doc:
@@ -410,6 +419,35 @@ def gate_serve(bench_dir, min_warm_speedup=10.0, min_dispatch_red=8.0,
         problems.append("packed results not bit-equal to the "
                         "single-job path (padding/masking contract "
                         "broke)")
+    chaos = _load_json(os.path.join(bench_dir, "CHAOS.json"))
+    storm_note = "no CHAOS.json (serve storm unproven)"
+    if chaos:
+        sv = chaos.get("serve")
+        if not isinstance(sv, dict):
+            problems.append(
+                "CHAOS.json lacks the serve storm section — run "
+                "tools/chaos.py --serve")
+        else:
+            if sv.get("co_tenant_casualties") != 0:
+                problems.append(
+                    f"{sv.get('co_tenant_casualties')} co-tenant "
+                    "casualt(ies) under the poison storm (quarantine "
+                    "must fail the poison ALONE)")
+            if sv.get("accounting_balanced") is not True:
+                problems.append(
+                    "serve storm shed accounting does not balance "
+                    "(accepted != done + expired + quarantined)")
+            if sv.get("queue_drained") is not True:
+                problems.append(
+                    "serve storm queue not drained through the "
+                    "demotion/resume cycle")
+            if sv.get("pass") is not True:
+                problems.append("serve storm verdict is FAIL "
+                                "(CHAOS.json serve.pass)")
+            storm_note = (
+                f"storm: 0 casualties, "
+                f"{len(sv.get('quarantined', []))} quarantined, "
+                f"{len(sv.get('rejected', {}))} rejected, balanced")
     if problems:
         return _gate("serve", "fail", "; ".join(problems),
                      warm_speedup=ws, dispatch_reduction=red,
@@ -419,8 +457,8 @@ def gate_serve(bench_dir, min_warm_speedup=10.0, min_dispatch_red=8.0,
         f"warm_speedup {ws}x (floor {min_warm_speedup}x), "
         f"dispatch_reduction {red}x (floor {min_dispatch_red}x), "
         f"p50 {p50} ms (ceiling {max_warm_p50_ms}), zero dropped, "
-        "packed bit-equal", warm_speedup=ws, dispatch_reduction=red,
-        p50_ms=p50)
+        f"packed bit-equal; {storm_note}", warm_speedup=ws,
+        dispatch_reduction=red, p50_ms=p50)
 
 
 def gate_staleness(series, stale_days, now=None):
