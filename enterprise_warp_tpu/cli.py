@@ -115,7 +115,22 @@ def main(argv=None):
         custom = import_custom_models(opts.custom_models_py,
                                       opts.custom_models)
 
-    params = Params(opts.prfile, opts=opts, custom_models_obj=custom)
+    # ingestion gate (numerical-integrity plane, docs/resilience.md):
+    # a quarantined dataset or malformed file fails HERE, typed, with
+    # the dedicated exit status — never as a NaN anomaly dump deep
+    # inside a sampler block. Array runs with ``on_quarantine: skip``
+    # degrade gracefully inside Params instead of raising.
+    from .io.errors import ParseError
+    from .resilience.integrity import (EXIT_QUARANTINED, DataQuarantine,
+                                       PulsarQuarantine)
+    try:
+        params = Params(opts.prfile, opts=opts, custom_models_obj=custom)
+    except DataQuarantine as q:
+        print(f"data quarantine: {q}", file=sys.stderr)
+        return EXIT_QUARANTINED
+    except ParseError as exc:
+        print(f"malformed input file: {exc}", file=sys.stderr)
+        return EXIT_QUARANTINED
     likes = init_model_likelihoods(params, gram_mode=opts.gram_mode)
 
     if params.setupsamp or opts.mpi_regime == 1:
@@ -145,6 +160,32 @@ def main(argv=None):
     try:
         _run_samplers(params, opts, resume, likes, first_id,
                       config_hash)
+    except PulsarQuarantine as q:
+        # the health ladder's terminal rung: this pulsar is out of the
+        # campaign — permanently (exit 76 tells an external driver NOT
+        # to restart it; survivors run in their own processes). An
+        # honesty artifact lands next to whatever partial output exists.
+        print(f"pulsar quarantine: {q}", file=sys.stderr)
+        import json
+        from .io.writers import atomic_write_json
+        qpath = os.path.join(params.output_dir, "quarantined.json")
+        record = {"quarantined_pulsars": [q.psr],
+                  "reports": {q.psr: {"cause": q.cause,
+                                      "stats": q.stats}}}
+        try:
+            # merge, never clobber: ingestion-time quarantines for
+            # the same output dir must survive a later sampler-time
+            # quarantine (the honesty artifact is cumulative)
+            with open(qpath) as fh:
+                prev = json.load(fh)
+            record["reports"] = {**prev.get("reports", {}),
+                                 **record["reports"]}
+            record["quarantined_pulsars"] = sorted(
+                set(prev.get("quarantined_pulsars", [])) | {q.psr})
+        except (OSError, ValueError):
+            pass
+        atomic_write_json(qpath, record)
+        return EXIT_QUARANTINED
     except PlatformDemotion as d:
         # the samplers already applied every in-process rung
         # (megakernel -> classic XLA); reaching here means the run must

@@ -146,6 +146,14 @@ class Params:
             # serve.admission.parse_serve_config — e.g.
             # ``serve: max_queue=64 tenant_quota=8 weight.gold=4``
             "serve:": ["serve", str],
+            # numerical-integrity plane (docs/resilience.md): the
+            # ingestion-gate repair policy ('none' quarantines on hard
+            # findings, 'drop' drops offending rows with provenance)
+            # and the array-degradation policy ('raise' aborts on the
+            # first quarantined pulsar, 'skip' continues with the
+            # surviving array + a quarantined.json honesty artifact)
+            "data_repair:": ["data_repair", str],
+            "on_quarantine:": ["on_quarantine", str],
         }
         self.label_attr_map.update(
             self.noise_model_obj().get_label_attr_map())
@@ -258,6 +266,8 @@ class Params:
         d.setdefault("fref", 1400.0)
         d.setdefault("overwrite", "False")
         d.setdefault("array_analysis", "False")
+        d.setdefault("data_repair", "none")
+        d.setdefault("on_quarantine", "raise")
         d.setdefault("sampler", "ptmcmcsampler")
         d.setdefault("paramfile_label",
                      os.path.splitext(
@@ -352,9 +362,12 @@ class Params:
 
         def realize(entry):
             return entry if isinstance(entry, Pulsar) \
-                else load_pulsar(*entry)
+                else load_pulsar(*entry, repair=str(self.data_repair))
 
         array_mode = str(self.array_analysis) == "True"
+        skip_quarantined = array_mode \
+            and str(self.on_quarantine) == "skip"
+        self.quarantined_pulsars = []
         # output stays CWD-relative (reference behavior; never resolved
         # into the read-only data/paramfile tree)
         prefix = os.path.join(self.out,
@@ -373,7 +386,40 @@ class Params:
                     self.output_dir = os.path.join(
                         prefix, f"{num}_{pname}") + "/"
                     continue
-                self.psrs.append(realize(entry))
+                if skip_quarantined:
+                    # graceful array degradation (numerical-integrity
+                    # plane): a quarantined pulsar fails ALONE; the
+                    # run continues with the survivors and carries an
+                    # explicit honesty record (quarantined.json +
+                    # psr_quarantined events)
+                    from ..io.errors import ParseError
+                    from ..resilience import integrity
+                    try:
+                        self.psrs.append(realize(entry))
+                    except integrity.DataQuarantine as q:
+                        integrity.emit_psr_quarantined(
+                            q.psr, cause="data_quarantine",
+                            where="ingestion",
+                            stats={"verdict": q.report.verdict,
+                                   "source": q.report.source})
+                        self.quarantined_pulsars.append(
+                            (q.psr, q.report.to_dict()))
+                    except ParseError as exc:
+                        src = (os.path.basename(str(entry[1]))
+                               if isinstance(entry, tuple) else "")
+                        rep = integrity.parse_error_report(
+                            pname, src, exc)
+                        integrity.emit_psr_quarantined(
+                            pname, cause=f"parse_error: {exc}",
+                            where="ingestion")
+                        self.quarantined_pulsars.append(
+                            (pname, rep.to_dict()))
+                else:
+                    self.psrs.append(realize(entry))
+            if not self.psrs:
+                raise ValueError(
+                    f"every pulsar in {datadir} was quarantined at "
+                    "ingestion — nothing left to analyze")
             tmin = min(p.toas.min() for p in self.psrs)
             tmax = max(p.toas.max() for p in self.psrs)
             self.Tspan = float(tmax - tmin)
@@ -399,6 +445,17 @@ class Params:
                     f"removing everything in {self.output_dir}")
                 shutil.rmtree(self.output_dir)
                 os.makedirs(self.output_dir)
+            # honesty artifact (numerical-integrity plane): any result
+            # computed from this output dir must carry the pulsars the
+            # ingestion gate removed from the array
+            if self.quarantined_pulsars:
+                from ..io.writers import atomic_write_json
+                atomic_write_json(
+                    os.path.join(self.output_dir, "quarantined.json"),
+                    {"quarantined_pulsars":
+                         [n for n, _ in self.quarantined_pulsars],
+                     "reports": {n: r for n, r
+                                 in self.quarantined_pulsars}})
 
     def clone_all_params_to_models(self):
         for key, val in list(self.__dict__.items()):

@@ -8,6 +8,7 @@ timing data from disk and producing the arrays the GP likelihood needs
 linearized timing-model design matrix).
 """
 
+from .errors import ParseError
 from .par import parse_par, ParFile
 from .tim import parse_tim, TimFile
 from .pulsar import Pulsar, load_pulsar, load_pulsars_from_dir
@@ -15,6 +16,7 @@ from .writers import (pulsar_to_timfile, save_pulsar_pair, write_par,
                       write_tim)
 
 __all__ = [
+    "ParseError",
     "parse_par", "ParFile", "parse_tim", "TimFile",
     "Pulsar", "load_pulsar", "load_pulsars_from_dir",
     "write_par", "write_tim", "pulsar_to_timfile", "save_pulsar_pair",

@@ -18,6 +18,7 @@ import math
 from dataclasses import dataclass, field
 
 from .. import constants as const
+from .errors import ParseError
 
 # Parameters whose values are plain floats we care about for the timing model.
 _FLOAT_KEYS = {
@@ -26,6 +27,35 @@ _FLOAT_KEYS = {
     "START", "FINISH", "TZRMJD", "TZRFRQ", "TRES", "NE_SW",
     "PB", "A1", "ECC", "T0", "OM",
 }
+
+# Keys we recognize beyond _FLOAT_KEYS: either handled explicitly below
+# or common tempo2 bookkeeping stored raw without comment. Anything
+# outside this vocabulary is *stored raw anyway* but warned about once
+# per key (numerical-integrity plane: a typo'd key must not vanish
+# silently).
+_KNOWN_KEYS = _FLOAT_KEYS | {
+    "PSRJ", "PSR", "PSRB", "RAJ", "DECJ", "TZRSITE", "UNITS", "EPHEM",
+    "CLK", "JUMP", "NTOA", "NITS", "MODE", "EPHVER", "TIMEEPH",
+    "T2CMETHOD", "CORRECT_TROPOSPHERE", "PLANET_SHAPIRO", "DILATEFREQ",
+    "ELONG", "ELAT", "PMELONG", "PMELAT", "BINARY", "SINI", "M2",
+    "OMDOT", "PBDOT", "XDOT", "EDOT", "FB0", "FB1", "TASC", "EPS1",
+    "EPS2", "KOM", "KIN", "CHI2R", "SOLARN0", "DMMODEL", "DMOFF",
+    "F4", "F5", "F6", "GLEP_1", "GLPH_1", "GLF0_1", "GLF1_1",
+}
+
+# once-per-process unknown-key warning registry (a 45-pulsar campaign
+# must not emit 45 copies of the same warning)
+_WARNED_KEYS: set = set()
+
+
+def _warn_unknown_key(key, path, lineno):
+    if key in _WARNED_KEYS:
+        return
+    _WARNED_KEYS.add(key)
+    from ..utils.logging import get_logger
+    get_logger("ewt.io.par").warning(
+        "unknown .par key %r at %s:%d — stored raw, not interpreted "
+        "(warned once per key)", key, path, lineno)
 
 @dataclass
 class Jump:
@@ -111,10 +141,15 @@ def parse_par(path: str) -> ParFile:
 
     Validated against the two shipped reference fixtures
     (``examples/data/J1832-0836.par``, ``examples/data/fake_psr_0.par``).
+
+    Malformed or truncated lines raise a typed :class:`ParseError`
+    carrying ``path:lineno`` provenance (never a bare ``ValueError``
+    from float conversion at arbitrary depth); unknown-but-well-formed
+    keys are stored raw and warned about once per key.
     """
     pf = ParFile()
     with open(path) as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, start=1):
             line = line.strip()
             if not line:
                 continue
@@ -123,7 +158,14 @@ def parse_par(path: str) -> ParFile:
                 toks = line.lstrip("#").split()
                 if toks and toks[0].startswith("TN"):
                     if toks[0] in ("TNEF", "TNEQ") and len(toks) >= 4:
-                        pf.tn_comments[f"{toks[0]}:{toks[2]}"] = float(toks[3])
+                        try:
+                            pf.tn_comments[f"{toks[0]}:{toks[2]}"] = \
+                                float(toks[3])
+                        except ValueError as exc:
+                            raise ParseError(
+                                path, lineno, line,
+                                f"non-numeric {toks[0]} comment value "
+                                f"{toks[3]!r}") from exc
                     elif len(toks) >= 2:
                         try:
                             pf.tn_comments[toks[0]] = float(toks[1])
@@ -132,37 +174,57 @@ def parse_par(path: str) -> ParFile:
                 continue
             toks = line.split()
             key = toks[0].upper()
-            if key == "JUMP" and len(toks) >= 4:
+            if key == "JUMP":
+                if len(toks) < 4:
+                    raise ParseError(
+                        path, lineno, line,
+                        "truncated JUMP line (need "
+                        "JUMP <-flag> <flagval> <value> [fit])")
                 flag = toks[1].lstrip("-")
                 flagval = toks[2]
-                value = float(toks[3])
+                try:
+                    value = float(toks[3])
+                except ValueError as exc:
+                    raise ParseError(
+                        path, lineno, line,
+                        f"non-numeric JUMP value {toks[3]!r}") from exc
                 fit = len(toks) >= 5 and toks[4] == "1"
                 pf.jumps.append(Jump(flag, flagval, value, fit))
                 continue
             if len(toks) < 2:
-                continue
+                raise ParseError(path, lineno, line,
+                                 f"key {key!r} carries no value "
+                                 "(truncated line)")
             val = toks[1]
             pf.raw[key] = val
             fit = len(toks) >= 3 and toks[2] == "1"
             pf.fit_flags[key] = fit
-            if key == "PSRJ" or key == "PSR":
-                pf.name = val
-            elif key == "RAJ":
-                pf.raj = _parse_hms(val)
-            elif key == "DECJ":
-                pf.decj = _parse_dms(val)
-            elif key in _FLOAT_KEYS:
-                attr = key.lower()
-                if hasattr(pf, attr):
-                    setattr(pf, attr, float(val))
-            elif key == "TZRSITE":
-                pf.tzrsite = val
-            elif key == "UNITS":
-                pf.units = val
-            elif key == "EPHEM":
-                pf.ephem = val
-            elif key == "CLK":
-                pf.clk = val
+            try:
+                if key == "PSRJ" or key == "PSR":
+                    pf.name = val
+                elif key == "RAJ":
+                    pf.raj = _parse_hms(val)
+                elif key == "DECJ":
+                    pf.decj = _parse_dms(val)
+                elif key in _FLOAT_KEYS:
+                    attr = key.lower()
+                    if hasattr(pf, attr):
+                        setattr(pf, attr, float(val))
+                elif key == "TZRSITE":
+                    pf.tzrsite = val
+                elif key == "UNITS":
+                    pf.units = val
+                elif key == "EPHEM":
+                    pf.ephem = val
+                elif key == "CLK":
+                    pf.clk = val
+                elif key not in _KNOWN_KEYS:
+                    _warn_unknown_key(key, path, lineno)
+            except (ValueError, IndexError) as exc:
+                raise ParseError(
+                    path, lineno, line,
+                    f"malformed value {val!r} for key {key!r}: "
+                    f"{exc}") from exc
     if pf.posepoch == 0.0:
         pf.posepoch = pf.pepoch
     if pf.dmepoch == 0.0:

@@ -51,6 +51,10 @@ class Pulsar:
     sys_flags: list = field(default_factory=list)
     sys_flagvals: list = field(default_factory=list)
     par: ParFile = None
+    # ingestion-audit verdict (resilience/integrity.py): attached by
+    # load_pulsar; None for archives/simulated pulsars that never
+    # passed through the gate
+    dq_report: object = None
 
     def __len__(self):
         return len(self.toas)
@@ -138,16 +142,42 @@ def _backend_flag_values(tim: TimFile) -> np.ndarray:
     return tim.sites
 
 
-def load_pulsar(parfile: str, timfile: str) -> Pulsar:
+def load_pulsar(parfile: str, timfile: str, repair: str = "none",
+                audit: bool = True) -> Pulsar:
     """Build a :class:`Pulsar` from a .par/.tim pair.
 
     For real observatory data under the approximate ephemeris, residuals
     cannot be phase-connected; they are then set to zero with
     ``phase_connected=False`` and callers may inject simulated residuals
     (``enterprise_warp_tpu.sim``) to obtain an analysis-grade dataset.
+
+    **Ingestion gate** (numerical-integrity plane,
+    ``resilience/integrity.py``): the parsed TOAs pass a typed
+    data-quality audit before anything is built. Hard findings
+    (non-finite TOAs/uncertainties, zero/negative/absurd
+    uncertainties, malformed files) raise a typed
+    :class:`~..resilience.integrity.DataQuarantine` under the default
+    ``repair="none"`` policy; ``repair="drop"`` drops the offending
+    rows with provenance instead. Soft findings (out-of-order or
+    duplicate epochs, empty backend labels) are recorded as
+    ``data_quality`` events either way. The audit verdict rides the
+    returned pulsar as ``psr.dq_report`` and is folded into the build/
+    topology fingerprints, so a repaired dataset keys fresh compiled
+    executables. ``audit=False`` bypasses the gate (trusted archives).
     """
+    from ..resilience import integrity
+
     par = parse_par(parfile)
     tim = parse_tim(timfile)
+
+    report = None
+    if audit:
+        tim, report = integrity.audit_tim(
+            tim, psr_name=par.name or os.path.basename(parfile),
+            source=os.path.basename(timfile), repair=repair)
+        integrity.emit_report(report)
+        if report.verdict == "quarantine":
+            raise integrity.DataQuarantine(report)
 
     delay, obs_pos, is_bary = timing.compute_delays(par, tim)
     res, ok = timing.phase_residuals(par, tim, delay)
@@ -171,12 +201,31 @@ def load_pulsar(parfile: str, timfile: str) -> Pulsar:
         decj=par.decj,
         phase_connected=ok,
         par=par,
+        dq_report=report,
     )
 
 
-def load_pulsars_from_dir(datadir: str, psrlist=None) -> list:
+def load_pulsars_from_dir(datadir: str, psrlist=None,
+                          repair: str = "none",
+                          on_quarantine: str = "raise",
+                          quarantined=None) -> list:
     """Load all .par/.tim pairs in a directory (sorted), as the reference
-    does at ``enterprise_warp.py:350-373``; ``psrlist`` filters by name."""
+    does at ``enterprise_warp.py:350-373``; ``psrlist`` filters by name.
+
+    ``on_quarantine`` — graceful array degradation: ``"raise"``
+    (default) propagates the first typed
+    :class:`~..resilience.integrity.DataQuarantine`; ``"skip"`` drops
+    the quarantined pulsar ALONE (typed ``psr_quarantined`` event +
+    counter) and keeps loading the survivors. Pass a list as
+    ``quarantined`` to collect ``(name, report_dict)`` pairs for the
+    caller's honesty field (``quarantined_pulsars`` in final results).
+    """
+    from ..resilience import integrity
+
+    if on_quarantine not in ("raise", "skip"):
+        raise ValueError(
+            f"unknown on_quarantine policy {on_quarantine!r} "
+            "(one of 'raise', 'skip')")
     pars = sorted(glob.glob(os.path.join(datadir, "*.par")))
     tims = sorted(glob.glob(os.path.join(datadir, "*.tim")))
     if len(pars) != len(tims):
@@ -193,6 +242,7 @@ def load_pulsars_from_dir(datadir: str, psrlist=None) -> list:
             f".par/.tim basenames do not pair up in {datadir}: "
             + ", ".join(f"{os.path.basename(p)} vs {os.path.basename(t)}"
                         for p, t in mismatched[:5]))
+    from .errors import ParseError
     out = []
     for p, t in zip(pars, tims):
         if psrlist is not None and stem(p) not in psrlist:
@@ -200,7 +250,28 @@ def load_pulsars_from_dir(datadir: str, psrlist=None) -> list:
             # below only when the stem was not already a match
             if parse_par(p).name not in psrlist:
                 continue
-        out.append(load_pulsar(p, t))
+        try:
+            out.append(load_pulsar(p, t, repair=repair))
+        except integrity.DataQuarantine as q:
+            if on_quarantine == "raise":
+                raise
+            integrity.emit_psr_quarantined(
+                q.psr, cause="data_quarantine", where="ingestion",
+                stats={"verdict": q.report.verdict,
+                       "source": q.report.source})
+            if quarantined is not None:
+                quarantined.append((q.psr, q.report.to_dict()))
+        except ParseError as exc:
+            # malformed file: same gate, typed as a parse-level hard
+            # finding so the array can degrade gracefully too
+            rep = integrity.parse_error_report(
+                stem(p), os.path.basename(t), exc)
+            if on_quarantine == "raise":
+                raise integrity.DataQuarantine(rep) from exc
+            integrity.emit_psr_quarantined(
+                rep.psr, cause=f"parse_error: {exc}", where="ingestion")
+            if quarantined is not None:
+                quarantined.append((rep.psr, rep.to_dict()))
     return out
 
 

@@ -24,6 +24,16 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .errors import ParseError
+
+# non-TOA directive heads tempo2 .tim files may carry besides
+# FORMAT/MODE/INCLUDE (skipped with a once-per-head warning rather
+# than misread as a truncated TOA line)
+_DIRECTIVE_HEADS = {"EFAC", "EQUAD", "EMAX", "EMIN", "EFLOOR", "TIME",
+                    "SKIP", "NOSKIP", "END", "TRACK", "PHASE", "JUMP",
+                    "SIGMA", "FMIN", "FMAX"}
+_WARNED_HEADS: set = set()
+
 
 def _is_flag(tok: str) -> bool:
     """A '-x' token introduces a flag unless it parses as a number."""
@@ -55,11 +65,117 @@ class TimFile:
 
 
 def _split_mjd(text: str):
-    """Split an MJD string into (int day, float seconds-of-day) losslessly."""
-    if "." in text:
-        ip, fp = text.split(".", 1)
-        return int(ip), float("0." + fp) * 86400.0
-    return int(text), 0.0
+    """Split an MJD string into (int day, float seconds-of-day) losslessly.
+
+    Non-finite values (a corrupted file's ``nan``/``inf`` TOA) parse to
+    ``(0, non-finite seconds)`` instead of raising — they must REACH
+    the ingestion audit (``resilience/integrity.py``), which can then
+    quarantine the pulsar or drop the row under a repair policy; a
+    parser hard-fail here would make the row unrepairable."""
+    try:
+        if "." in text:
+            ip, fp = text.split(".", 1)
+            return int(ip), float("0." + fp) * 86400.0
+        return int(text), 0.0
+    except ValueError:
+        v = float(text)           # ParseError provenance added by caller
+        if not np.isfinite(v):
+            return 0, v
+        return int(v), (v - int(v)) * 86400.0
+
+
+def _looks_like_toa(toks):
+    """A short line "looks like" a truncated TOA when any field past
+    the head parses as a number; an all-word line is a directive."""
+    for t in toks[1:]:
+        try:
+            float(t)
+            return True
+        except ValueError:
+            continue
+    return False
+
+
+def _check_toa_line(toks, p, lineno, s):
+    """Grammar check for one non-directive .tim line: returns True for
+    a valid TOA row, False for a skippable directive (known heads, or
+    unknown word-only lines — warned once per head, never fatal:
+    production datasets carry site-local annotations), raises a typed
+    :class:`ParseError` for truncated/malformed TOA rows."""
+    head = toks[0].upper()
+    if len(toks) < 5:
+        if head not in _DIRECTIVE_HEADS and _looks_like_toa(toks):
+            raise ParseError(
+                p, lineno, s,
+                f"truncated TOA line ({len(toks)} token(s), need "
+                "<name> <freq> <MJD> <err> <site>)")
+        if head not in _WARNED_HEADS:
+            _WARNED_HEADS.add(head)
+            from ..utils.logging import get_logger
+            get_logger("ewt.io.tim").warning(
+                "uninterpreted .tim directive %r at %s:%d "
+                "(warned once per directive)", head, p, lineno)
+        return False
+    try:
+        float(toks[1])
+        _split_mjd(toks[2])
+        float(toks[3])
+    except (ValueError, IndexError) as exc:
+        raise ParseError(p, lineno, s,
+                         f"malformed TOA fields: {exc}") from exc
+    return True
+
+
+def _walk_tim(path, depth=0):
+    """The ONE .tim line walk (comment skip, ``FORMAT``/``MODE``,
+    ``INCLUDE`` recursion, depth-16 cycle guard) shared by the Python
+    parser and the post-native grammar validator: yields
+    ``(path, lineno, toks, stripped_line)`` for every candidate
+    TOA/directive line."""
+    if depth > 16:
+        raise ValueError(
+            f"INCLUDE nesting deeper than 16 at {path} "
+            "(cyclic include?)")
+    base = os.path.dirname(path)
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, start=1):
+            s = line.strip()
+            if not s or s.startswith(("#", "C ", "CN ")):
+                continue
+            toks = s.split()
+            head = toks[0].upper()
+            if head in ("FORMAT", "MODE"):
+                continue
+            if head == "INCLUDE" and len(toks) >= 2:
+                inc = toks[1]
+                if not os.path.isabs(inc):
+                    inc = os.path.join(base, inc)
+                yield from _walk_tim(inc, depth + 1)
+                continue
+            yield path, lineno, toks, s
+
+
+def _validate_grammar(path):
+    """Grammar validation (the typed-ParseError contract) without
+    building arrays — run over files the NATIVE core parsed, whose
+    C++ reader silently skips lines it cannot read."""
+    for p, lineno, toks, s in _walk_tim(path):
+        _check_toa_line(toks, p, lineno, s)
+
+
+def _grammar_matches_native(path, n_native):
+    """Cheap post-native gate: when every candidate line is TOA-shaped
+    (>= 5 tokens) and the count matches the rows the native core
+    returned, the core skipped nothing and the per-field typed walk
+    (three ``float()``s per TOA — roughly the whole Python-parser
+    cost) is unnecessary. Any short line or count mismatch returns
+    False: exactly the cases the validator exists to judge."""
+    n = 0
+    for _, _, toks, _ in _walk_tim(path):
+        if len(toks) < 5:
+            return False
+        n += 1
+    return n == n_native
 
 
 def parse_tim(path: str, engine: str = "auto") -> TimFile:
@@ -75,7 +191,22 @@ def parse_tim(path: str, engine: str = "auto") -> TimFile:
     if engine == "auto":
         from ..native import parse_tim_native
 
-        parsed = parse_tim_native(path)
+        try:
+            parsed = parse_tim_native(path)
+        except ValueError:
+            # native parse error: re-parse through the Python oracle so
+            # the caller gets the typed ParseError with file:line
+            # provenance (or a successful parse where the native core
+            # was stricter than the grammar requires)
+            parsed = None
+        if parsed is not None:
+            # the native core SKIPS lines it cannot read; the typed
+            # grammar check must still hold (numerical-integrity
+            # plane). A tokenize-only count gate confirms the core
+            # swallowed nothing; only a short line or a count
+            # mismatch pays the full per-field typed walk.
+            if not _grammar_matches_native(path, len(parsed[0])):
+                _validate_grammar(path)
         if parsed is not None:
             freqs, mjd_i, sec, errs, names, sites, flags = parsed
             tf = TimFile(
@@ -88,51 +219,30 @@ def parse_tim(path: str, engine: str = "auto") -> TimFile:
     names, freqs, mjd_i, secs, errs, sites = [], [], [], [], [], []
     flag_rows: list[dict] = []
 
-    def _parse_file(p, depth=0):
-        if depth > 16:
-            raise ValueError(
-                f"INCLUDE nesting deeper than 16 at {p} (cyclic include?)")
-        base = os.path.dirname(p)
-        with open(p) as fh:
-            for line in fh:
-                s = line.strip()
-                if not s or s.startswith(("#", "C ", "CN ")):
-                    continue
-                toks = s.split()
-                head = toks[0].upper()
-                if head == "FORMAT" or head == "MODE":
-                    continue
-                if head == "INCLUDE" and len(toks) >= 2:
-                    inc = toks[1]
-                    if not os.path.isabs(inc):
-                        inc = os.path.join(base, inc)
-                    _parse_file(inc, depth + 1)
-                    continue
-                if len(toks) < 5:
-                    continue
-                names.append(toks[0])
-                freqs.append(float(toks[1]))
-                di, sec = _split_mjd(toks[2])
-                mjd_i.append(di)
-                secs.append(sec)
-                errs.append(float(toks[3]))
-                sites.append(toks[4])
-                row = {}
-                i = 5
-                while i < len(toks):
-                    if _is_flag(toks[i]):
-                        flag = toks[i][1:]
-                        if i + 1 < len(toks) and not _is_flag(toks[i + 1]):
-                            row[flag] = toks[i + 1]
-                            i += 2
-                        else:
-                            row[flag] = "1"
-                            i += 1
-                    else:
-                        i += 1
-                flag_rows.append(row)
-
-    _parse_file(path)
+    for p, lineno, toks, s in _walk_tim(path):
+        if not _check_toa_line(toks, p, lineno, s):
+            continue              # skippable directive
+        names.append(toks[0])
+        freqs.append(float(toks[1]))
+        di, sec = _split_mjd(toks[2])
+        mjd_i.append(di)
+        secs.append(sec)
+        errs.append(float(toks[3]))
+        sites.append(toks[4])
+        row = {}
+        i = 5
+        while i < len(toks):
+            if _is_flag(toks[i]):
+                flag = toks[i][1:]
+                if i + 1 < len(toks) and not _is_flag(toks[i + 1]):
+                    row[flag] = toks[i + 1]
+                    i += 2
+                else:
+                    row[flag] = "1"
+                    i += 1
+            else:
+                i += 1
+        flag_rows.append(row)
 
     tf = TimFile(
         names=np.array(names, dtype=object),

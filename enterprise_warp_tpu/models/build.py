@@ -567,7 +567,9 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     # lnL error; resolved at build time like the toggles above)
     n_refine = int(_os.environ.get("EWT_REFINE", "3"))
 
-    def loglike_inner(theta, sh):
+    def _loglike_core(theta, sh, with_health, gm=None):
+        gm = gram_mode if gm is None else gm
+        oracle = gm != gram_mode       # f64 re-eval twin: no fold/pair
         wb = [(kind, mm, refs) for (kind, _, refs), mm
               in zip(wb_static, sh["wmm"])]
         nw = eval_nw(theta, wb, ntoa_tot, sh["s2"])
@@ -577,25 +579,52 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
             c = jnp.stack([param_value(theta, rf) for rf in det_refs])
             r_eff = r_eff - sh["D"] @ c
         if tm_refs is None:
-            lnl = marginalized_loglike(nw, phi, r_eff, sh["M"], T_mat,
+            out = marginalized_loglike(nw, phi, r_eff, sh["M"], T_mat,
                                        mask=sh["mask"],
-                                       gram_mode=gram_mode,
-                                       pair_program=None if grams_cached
-                                       is not None else pair_prog,
+                                       gram_mode=gm,
+                                       pair_program=None if (
+                                           oracle or grams_cached
+                                           is not None) else pair_prog,
                                        blocked_chol=use_blocked_chol,
                                        refine=n_refine,
-                                       grams=grams_cached)
+                                       grams=None if oracle
+                                       else grams_cached,
+                                       with_health=with_health)
         else:
             dp = jnp.stack([param_value(theta, rf) for rf in tm_refs])
             r_eff = r_eff - sh["M"] @ dp
-            lnl = marginalized_loglike(nw, phi, r_eff, None, T_mat,
+            out = marginalized_loglike(nw, phi, r_eff, None, T_mat,
                                        mask=sh["mask"],
-                                       gram_mode=gram_mode,
+                                       gram_mode=gm,
                                        blocked_chol=use_blocked_chol,
-                                       refine=n_refine)
+                                       refine=n_refine,
+                                       with_health=with_health)
+        lnl, hw = out if with_health else (out, None)
         # a numerically non-PD Sigma (extreme prior corners) yields NaN;
         # the reference stack maps Cholesky failure to -inf likewise
-        return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
+        lnl = jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
+        return (lnl, hw) if with_health else lnl
+
+    def loglike_inner(theta, sh):
+        return _loglike_core(theta, sh, False)
+
+    def loglike_f64_inner(theta, sh):
+        """f64 oracle twin (the health ladder's ``reeval`` rung): the
+        same whitened inputs through the oracle-grade pure-f64 path —
+        no constant-folded Grams, no pair program, no reduced
+        precision anywhere."""
+        return _loglike_core(theta, sh, False, gm="f64")
+
+    def loglike_health_inner(theta, sh):
+        """Health-instrumented twin of ``loglike_inner``: identical lnl
+        math on the classic chain plus the fixed-shape (3,) kernel
+        health word (ops.kernel docstring) — the side output the
+        sampler's in-scan accumulators fold (numerical-integrity
+        plane). On the classic route (CPU, or EWT_PALLAS=0) the lnl is
+        bit-identical to ``loglike_inner``'s; a megakernel-routed
+        production eval differs by the megakernel's documented
+        tolerance class because health instrumentation pins classic."""
+        return _loglike_core(theta, sh, True)
 
     sharded = dict(r=r_w_j, M=M_w_j, T=T_w_j, s2=sigma2_j, mask=mask_j,
                    D=D_all_j, wmm=[mm for _, mm, _ in wb_static])
@@ -625,6 +654,12 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     _bfp.update(f"tm={tm};refine={n_refine};"
                 f"bchol={use_blocked_chol};cg={bool(const_grams)};"
                 f"pair={pair_prog is not None};".encode())
+    # ingestion-audit verdict (numerical-integrity plane): a repaired
+    # dataset must key fresh executables — its arrays differ, but the
+    # token also distinguishes "clean" from "repaired with provenance"
+    dq = getattr(psr, "dq_report", None)
+    _bfp.update(f"dq={dq.token() if dq is not None else 'unaudited'};"
+                .encode())
     like.build_fingerprint = _bfp.hexdigest()[:16]
     # sampler evaluation protocol (samplers/evalproto.py): pure function
     # + the device-array pytree, so every jit can take the arrays as
@@ -635,6 +670,19 @@ def build_pulsar_likelihood(psr, terms, fixed_values=None,
     from ..samplers.evalproto import install_protocol
     install_protocol(like, loglike_inner, sharded,
                      public=mesh is not None, name="pulsar")
+    # kernel health protocol (numerical-integrity plane): the sampler's
+    # block jit calls the vmapped health twin when the health plane is
+    # armed — same consts pytree, zero extra dispatches (it rides the
+    # block program)
+    like._eval_health = loglike_health_inner
+    like._eval_health_batch = jax.vmap(loglike_health_inner,
+                                       in_axes=(0, None))
+    from ..utils.telemetry import traced
+    # traced jit (escalation path only — a handful of walkers per
+    # reeval): the f64 oracle twin the health ladder compares against
+    like._eval_f64_batch = traced(
+        jax.vmap(loglike_f64_inner, in_axes=(0, None)),
+        name="pulsar.eval_f64")
     return like
 
 
@@ -701,6 +749,11 @@ def topology_fingerprint(like):
             np.asarray(psr.residuals, dtype=np.float64)).tobytes())
         h.update(np.ascontiguousarray(
             np.asarray(psr.toaerrs, dtype=np.float64)).tobytes())
+        # ingestion-audit verdict: a repaired dataset keys fresh
+        # executables even where its arrays happen to collide
+        dq = getattr(psr, "dq_report", None)
+        h.update(f"dq={dq.token() if dq is not None else 'unaudited'};"
+                 .encode())
     else:
         h.update(f"instance={id(like)};".encode())
     import os as _os2

@@ -52,6 +52,27 @@ import numpy as np
 _HIGH = jax.lax.Precision.HIGHEST
 _CHUNK = 256  # TOA-axis chunk length for f64 accumulation of f32 partials
 
+# health-word layout (numerical-integrity plane — see
+# resilience/integrity.py and docs/resilience.md): a fixed-shape (3,)
+# side output of the factorization/solve chain. [HW_JITTER]: a
+# jittered-retry / identity-fallback factorization was substituted
+# (the previously SILENT accuracy degradation); [HW_DIVERGE]:
+# iterative refinement diverged and the preconditioner solution was
+# kept; [HW_LOGCOND]: log10 dynamic range of the equilibration
+# diagonal — a cheap condition proxy (upper-bound surrogate for
+# log10 kappa before equilibration), costing one reduction over a
+# diagonal already in registers.
+HW_JITTER, HW_DIVERGE, HW_LOGCOND = 0, 1, 2
+
+
+def _health_word(jitter_bit, diverge_bit, d):
+    """Pack a health word from the equilibration diagonal ``d`` and
+    the two event bits (arrays or Python scalars)."""
+    logcond = jnp.log10(jnp.max(d) / jnp.maximum(jnp.min(d), 1e-300))
+    return jnp.stack([jnp.asarray(jitter_bit, dtype=d.dtype),
+                      jnp.asarray(diverge_bit, dtype=d.dtype),
+                      logcond.astype(d.dtype)])
+
 
 # ewt: allow-precision — build-time whitening: TOA residuals span
 # ~1e-6 s on ~1e9 s baselines — the dynamic range NEEDS the f64
@@ -286,7 +307,7 @@ def blocked_cholesky(S, block=16):
     return L[:n, :n]
 
 
-def equilibrated_cholesky(S, jitter):
+def equilibrated_cholesky(S, jitter, with_health=False):
     """Cholesky of a symmetric PD matrix via unit-diagonal equilibration,
     with an on-failure jitter fallback.
 
@@ -300,23 +321,33 @@ def equilibrated_cholesky(S, jitter):
     indefinite), the jittered factor ``chol(. + jitter*I)`` is substituted
     — so well-conditioned evaluations pay zero accuracy cost and prior
     corners degrade to a condition-bounded solve instead of ``-inf``.
+
+    ``with_health=True`` appends a fixed-shape health word (see
+    :data:`HW_JITTER`): ``(L, s, logdet, hw)``. The jitter bit is 1.0
+    exactly when the fallback factor was substituted — the event that
+    was previously invisible even to telemetry.
     """
     d = jnp.maximum(jnp.diagonal(S), 1e-30)
     s = 1.0 / jnp.sqrt(d)
     Sn = S * s[:, None] * s[None, :]
     L = jnp.linalg.cholesky(Sn)
+    engaged = jnp.zeros((), dtype=S.dtype)
     if jitter:
         bad = ~jnp.all(jnp.isfinite(L))
         Lj = jnp.linalg.cholesky(
             Sn + jitter * jnp.eye(S.shape[-1], dtype=S.dtype))
         L = jnp.where(bad, Lj, L)
+        engaged = bad.astype(S.dtype)
     logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(L))) + jnp.sum(jnp.log(d))
+    # ewt: allow-host-sync — with_health is a static route pin
+    if with_health:
+        return L, s, logdet, _health_word(engaged, 0.0, d)
     return L, s, logdet
 
 
 def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
                             delta_mode="tree", blocked=False,
-                            fused=None, mega=None):
+                            fused=None, mega=None, with_health=False):
     """Solve ``S Z = B`` and compute ``log|S|`` for symmetric PD ``S`` in
     mixed precision (TPU-fast: no emulated-f64 factorization).
 
@@ -364,10 +395,28 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     reference); ``mega='interpret'`` runs the kernel through the
     Pallas interpreter (CPU-testable).
 
+    ``with_health=True`` appends a fixed-shape health word — the
+    jittered-retry/identity-fallback bit, the refinement-divergence
+    bit, and the equilibration-diagonal condition proxy (see
+    :data:`HW_JITTER`) — and returns ``(Z, logdet, hw)``. The health
+    word declines only the MEGA route (one opaque Pallas dispatch —
+    it cannot carry the word; ``mega=False`` is its documented
+    tolerance-class fallback): the plain and fused-preconditioner
+    chains are both instrumented, so arming health does not move an
+    eval off its route and the computed ``Z``/``logdet`` are
+    UNCHANGED (the instrumentation only adds side outputs).
+
     Returns ``(Z, logdet)`` with ``Z`` (n, k) f64.
     """
     f64 = S.dtype
     n = S.shape[-1]
+    # ewt: allow-host-sync — with_health is a static route pin
+    if with_health:
+        if mega:
+            raise ValueError("with_health=True cannot ride the mega "
+                             "route (one opaque dispatch carries no "
+                             "health word); pass mega=False or None")
+        mega = False
     if jitter2 is None:
         jitter2 = 30.0 * jitter
     # Numerically NULL rows: Schur complements can cancel to a tiny
@@ -431,6 +480,17 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
         from .cholfuse import chol_precond
         U, Vu, E32f = chol_precond(Sn32, float(jitter), float(jitter2))
         diagL = jnp.diagonal(U)
+        # ewt: allow-host-sync — with_health is a static route pin
+        if with_health:
+            # tier detection WITHOUT moving the eval off the fused
+            # route: replay tier 1's factorization for its finiteness
+            # bit (identical input — XLA can CSE it against the fused
+            # kernel's own tier 1), and read the identity tier straight
+            # off U. Side outputs only; U/Vu/E are untouched.
+            bad1 = ~jnp.all(jnp.isfinite(jnp.linalg.cholesky(
+                Sn32 + jnp.float32(jitter) * eye)))
+            tier3 = jnp.all(U == eye)
+            engaged = jnp.maximum(bad1.astype(f64), tier3.astype(f64))
 
         def psolve(R):
             x = jnp.matmul(Vu.T, R.astype(jnp.float32), precision=_HIGH)
@@ -441,6 +501,11 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
         bad = ~jnp.all(jnp.isfinite(L))
         L = jnp.where(bad, _chol(Sn32 + jnp.float32(jitter2) * eye),
                       L)
+        # health: tier-2 (jitter2) retry or tier-3 identity fallback
+        # engaged — the first-tier jitter is the DESIGNED
+        # preconditioner and does not count (refinement removes it)
+        bad2 = ~jnp.all(jnp.isfinite(L))
+        engaged = jnp.maximum(bad, bad2).astype(f64)
         # last-resort Jacobi preconditioner: when the equilibrated cast
         # is so far from PSD that both jittered factorizations fail
         # (numerically null Schur rows with relatively large
@@ -498,7 +563,10 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     res_ref = jnp.sum(jnp.square(Bn - mm64(Sn, Z)))
     res_pre = jnp.sum(jnp.square(r0 if r0 is not None
                                  else Bn - mm64(Sn, Z0)))
-    Z = jnp.where(res_ref <= res_pre, Z, Z0)
+    # NaN-propagating comparison kept in the original operand order: a
+    # NaN refined residual must also fall back to the preconditioner
+    diverged = ~(res_ref <= res_pre)
+    Z = jnp.where(diverged, Z0, Z)
 
     # delta_mode='split' computes L L^T on the MXU with f64 chunk
     # accumulation (O(n^3) f32 instead of O(n^3) f64-elementwise tree
@@ -533,6 +601,10 @@ def _mixed_psd_solve_logdet(S, B, jitter, jitter2=None, refine=2,
     corr = jnp.where(jnp.sum(E * E) < 0.09, corr, 0.0)
     logdet = (2.0 * jnp.sum(jnp.log(diagL.astype(f64)))
               + corr + jnp.sum(jnp.log(d)))
+    # ewt: allow-host-sync — with_health is a static route pin
+    if with_health:
+        return (s[:, None] * Z, logdet,
+                _health_word(engaged, diverged.astype(f64), d))
     return s[:, None] * Z, logdet
 
 
@@ -612,10 +684,11 @@ def gram_blocks(nw, r_w, M_w, T_w, mask=None, gram_mode="split",
 # every outer-trace inlining as a retrace and emit phantom compile
 # events; the real XLA compiles are already counted at the entry.
 @partial(jax.jit, static_argnames=("gram_mode", "blocked_chol",
-                                   "refine", "mega"))
+                                   "refine", "mega", "with_health"))
 def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
                          pair_program=None, blocked_chol=False,
-                         refine=3, grams=None, mega=None):
+                         refine=3, grams=None, mega=None,
+                         with_health=False):
     """Marginalized GP log-likelihood for one pulsar at one parameter point.
 
     Parameters
@@ -652,9 +725,25 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
     Returns lnL up to a theta-independent constant (see
     ``oracle.kernel_constant_offset`` for the exact relation to the dense
     oracle).
+
+    ``with_health=True`` (static) returns ``(lnL, hw)`` with ``hw`` the
+    fixed-shape (3,) health word joined (elementwise max) over the
+    Sigma solve and the TM Schur factorization — see
+    :data:`HW_JITTER`. Health instrumentation pins the classic chain
+    (``mega=False`` end to end): the fused routes cannot carry the
+    word, and the classic path is their documented bit-equal fallback.
     """
     f64 = r_w.dtype
     ntm = 0 if M_w is None else M_w.shape[1]
+    # ewt: allow-host-sync — with_health/mega are static route
+    # pins (jit static args, Python values resolved at trace time)
+    if with_health and mega:
+        raise ValueError("with_health=True pins the classic chain; an "
+                         "explicit mega route cannot carry the health "
+                         "word")
+    # ewt: allow-host-sync — with_health is a static route pin
+    if with_health:
+        mega = False
     # explicit mega=False must pin the classic chain END TO END — the
     # AD/bit-exactness reference — so the inner solve's auto-route is
     # disabled too; a declined AUTO route leaves the inner decision
@@ -700,33 +789,58 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
     b = b.astype(f64)
 
     Sigma = G + jnp.diag(1.0 / b)
+    hw = None
     if M_w is None:
         # no-TM path: C_n-only quadratic form and determinant
         if gram_mode == "f64":
-            L, sS, logdet_sigma = equilibrated_cholesky(Sigma, 0.0)
+            # ewt: allow-host-sync — with_health is a static route pin
+            if with_health:
+                L, sS, logdet_sigma, hw = equilibrated_cholesky(
+                    Sigma, 0.0, with_health=True)
+            else:
+                L, sS, logdet_sigma = equilibrated_cholesky(Sigma, 0.0)
             u = jax.scipy.linalg.solve_triangular(L, sS * X, lower=True)
             quad = rwr - u @ u
         else:
             jitter = CHOL_JITTER[gram_mode]
-            zx, logdet_sigma = _mixed_psd_solve_logdet(
-                Sigma, X[:, None], jitter, refine=refine,
-                delta_mode="split", blocked=blocked_chol,
-                mega=solve_mega)
+            # ewt: allow-host-sync — with_health is a static route pin
+            if with_health:
+                zx, logdet_sigma, hw = _mixed_psd_solve_logdet(
+                    Sigma, X[:, None], jitter, refine=refine,
+                    delta_mode="split", blocked=blocked_chol,
+                    mega=solve_mega, with_health=True)
+            else:
+                zx, logdet_sigma = _mixed_psd_solve_logdet(
+                    Sigma, X[:, None], jitter, refine=refine,
+                    delta_mode="split", blocked=blocked_chol,
+                    mega=solve_mega)
             quad = rwr - X @ zx[:, 0]
         logdet_n = jnp.sum(jnp.log(nw) * (mask if mask is not None
                                           else 1.0))
         logdet_b = jnp.sum(jnp.log(b))
-        return -0.5 * (quad + logdet_n + logdet_b + logdet_sigma)
+        lnl = -0.5 * (quad + logdet_n + logdet_b + logdet_sigma)
+        return (lnl, hw) if with_health else lnl
 
     if gram_mode == "f64":
         # oracle-grade pure-f64 path (CPU tests / reference comparisons)
-        L, sS, logdet_sigma = equilibrated_cholesky(Sigma, 0.0)
+        # ewt: allow-host-sync — with_health is a static route pin
+        if with_health:
+            L, sS, logdet_sigma, hw = equilibrated_cholesky(
+                Sigma, 0.0, with_health=True)
+        else:
+            L, sS, logdet_sigma = equilibrated_cholesky(Sigma, 0.0)
         u = jax.scipy.linalg.solve_triangular(L, sS * X, lower=True)
         V = jax.scipy.linalg.solve_triangular(L, sS[:, None] * H,
                                               lower=True)
         A = P - V.T @ V
         y = q - V.T @ u
-        LA, sA, logdet_a = equilibrated_cholesky(A, 0.0)
+        # ewt: allow-host-sync — with_health is a static route pin
+        if with_health:
+            LA, sA, logdet_a, hw_a = equilibrated_cholesky(
+                A, 0.0, with_health=True)
+            hw = jnp.maximum(hw, hw_a)
+        else:
+            LA, sA, logdet_a = equilibrated_cholesky(A, 0.0)
         z = jax.scipy.linalg.solve_triangular(LA, sA * y, lower=True)
         quad = rwr - u @ u - z @ z
     else:
@@ -747,10 +861,17 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
         # (|lnL| error up to ~3e-2 at strong red noise), and it removes
         # the (nb,nb,nb) f64 tree product — the mixed solve's dominant
         # cost (CPU: 83 -> 18 ms/16-batch)
-        ZXH, logdet_sigma = _mixed_psd_solve_logdet(
-            Sigma, jnp.concatenate([X[:, None], H], axis=1), jitter,
-            refine=refine, delta_mode="split", blocked=blocked_chol,
-            mega=solve_mega)
+        # ewt: allow-host-sync — with_health is a static route pin
+        if with_health:
+            ZXH, logdet_sigma, hw = _mixed_psd_solve_logdet(
+                Sigma, jnp.concatenate([X[:, None], H], axis=1), jitter,
+                refine=refine, delta_mode="split", blocked=blocked_chol,
+                mega=solve_mega, with_health=True)
+        else:
+            ZXH, logdet_sigma = _mixed_psd_solve_logdet(
+                Sigma, jnp.concatenate([X[:, None], H], axis=1), jitter,
+                refine=refine, delta_mode="split", blocked=blocked_chol,
+                mega=solve_mega)
         zx, ZH = ZXH[:, 0], ZXH[:, 1:]
         A = P - H.T @ ZH
         y = q - ZH.T @ X
@@ -758,14 +879,21 @@ def marginalized_loglike(nw, b, r_w, M_w, T_w, mask=None, gram_mode="split",
         # f64 path); f32 mode's ~1e-5 Gram noise can make A numerically
         # indefinite, so it keeps the jittered-retry fallback.
         jitter_a = CHOL_JITTER["f32"] if gram_mode == "f32" else 0.0
-        LA, sA, logdet_a = equilibrated_cholesky(A, jitter_a)
+        # ewt: allow-host-sync — with_health is a static route pin
+        if with_health:
+            LA, sA, logdet_a, hw_a = equilibrated_cholesky(
+                A, jitter_a, with_health=True)
+            hw = jnp.maximum(hw, hw_a)
+        else:
+            LA, sA, logdet_a = equilibrated_cholesky(A, jitter_a)
         z = jax.scipy.linalg.solve_triangular(LA, sA * y, lower=True)
         quad = rwr - X @ zx - z @ z
 
     logdet_n = jnp.sum(jnp.log(nw) * (mask if mask is not None else 1.0))
     logdet_b = jnp.sum(jnp.log(b))
 
-    return -0.5 * (quad + logdet_n + logdet_b + logdet_sigma + logdet_a)
+    lnl = -0.5 * (quad + logdet_n + logdet_b + logdet_sigma + logdet_a)
+    return (lnl, hw) if with_health else lnl
 
 
 def _named_entry(name, fn):

@@ -760,6 +760,24 @@ def _env_interpret():
     return os.environ.get("EWT_PALLAS_INTERPRET", "0") == "1"
 
 
+def mega_route_possible():
+    """Whether the megakernel route could take production evals on
+    this backend (enablement env + TPU backend, or interpreter mode)
+    — the question the kernel-health plane asks before arming by
+    default: the health twin pins the classic chain (``mega=False``),
+    so where the megakernel could engage, arming health would move
+    production evals off their route and must be an explicit
+    ``EWT_KERNEL_HEALTH=1`` opt-in."""
+    if not _mega_enabled():
+        return False
+    if _env_interpret():
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 # ewt: allow-jit-purity — trace-time-only execution is this helper's
 # CONTRACT: one pallas_path increment per (re)trace, not per eval (the
 # jit caches the route decision with the executable)

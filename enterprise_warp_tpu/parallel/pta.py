@@ -698,14 +698,19 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
     # the joint device eval.
     stage1_delta = "tree" if gram_mode == "f64" else "split"
 
-    def _stage12_single(G_a, X_a, invphi_a, tmpad_a):
+    def _stage12_single(G_a, X_a, invphi_a, tmpad_a, with_health=False):
         """Stages 1+2 for ONE pulsar: mixed-precision factorization of
         the noise block, exact timing-model marginalization, and this
         pulsar's contributions to the GW Schur system. The full path is
         its ``vmap`` over the pulsar axis; the evaluation-structure
         layer's single-site update calls it once on the touched block
         and scatters the result into the cache — that block-sparsity is
-        exactly why stages 1+2 live in per-pulsar form."""
+        exactly why stages 1+2 live in per-pulsar form.
+
+        ``with_health=True`` adds this pulsar's stage-1 kernel health
+        word (``hw`` — ops.kernel docstring) to the returned dict: the
+        PER-PULSAR attribution the quarantine ladder needs (stage 3's
+        joint solve has no single owner and is not instrumented)."""
         Gnn = G_a[:NW, :NW] + jnp.diag(invphi_a)
         H = G_a[:NW, NW:NW + MW]
         P = G_a[NW:NW + MW, NW:NW + MW] + jnp.diag(tmpad_a)
@@ -725,9 +730,17 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         # chain into one batched-grid Pallas dispatch (the outer-vmap
         # composition its probe validates).
         RHS = jnp.concatenate([Xn[:, None], H, Cng], axis=1)
-        Z, ld_nn = _mixed_psd_solve_logdet(Gnn, RHS, jitter, refine=3,
-                                           delta_mode=stage1_delta,
-                                           mega=mega)
+        hw = None
+        # ewt: allow-host-sync — with_health is a static route pin
+        if with_health:
+            Z, ld_nn, hw = _mixed_psd_solve_logdet(
+                Gnn, RHS, jitter, refine=3, delta_mode=stage1_delta,
+                mega=False, with_health=True)
+        else:
+            Z, ld_nn = _mixed_psd_solve_logdet(Gnn, RHS, jitter,
+                                               refine=3,
+                                               delta_mode=stage1_delta,
+                                               mega=mega)
         Zx, ZH, ZC = Z[:, 0], Z[:, 1:1 + MW], Z[:, 1 + MW:]
 
         # stage 2: exact timing-model marginalization, genuine f64
@@ -752,7 +765,11 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         Xs = Xg - jnp.sum(Cng * Zx[:, None], axis=0) \
             - jnp.sum(Cmt * Wy[:, None], axis=0)
         Ss = Dgg - mm64(Cng, ZC) - mm64(Cmt, WC)
-        return dict(q1=q1, ld_nn=ld_nn, ld_tm=ld_tm, Xs=Xs, Ss=Ss)
+        out = dict(q1=q1, ld_nn=ld_nn, ld_tm=ld_tm, Xs=Xs, Ss=Ss)
+        # ewt: allow-host-sync — with_health is a static route pin
+        if with_health:
+            out["hw"] = hw
+        return out
 
     def _stage3(theta, cache):
         """Final assembly from the cache pytree: the GW Schur system
@@ -880,9 +897,28 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         lnl = -0.5 * (quad + logdet_n + logphi + logdet_b + logdet_sigma)
         return jnp.where(jnp.isnan(lnl), -jnp.inf, lnl)
 
+    def loglike_health(theta, sh):
+        """Health-instrumented joint eval (numerical-integrity plane):
+        the schur-path lnl plus the stacked PER-PULSAR stage-1 health
+        words ``(npsr, 3)`` — per-pulsar attribution for the
+        quarantine ladder. Classic chain pinned (mega=False inside
+        the instrumented stage-1 solves)."""
+        G, X, rwr_p, logdet_n, logphi, invphi_N = _common(theta, sh)
+        st = jax.vmap(lambda g, x, ip, tp: _stage12_single(
+            g, x, ip, tp, with_health=True))(G, X, invphi_N, tm_pad_j)
+        hw = st.pop("hw")
+        cache = dict(st, rwr=rwr_p, ldn=logdet_n, lphi=logphi)
+        return _stage3(theta, cache), hw
+
     inner = loglike_schur if joint_mode == "schur" else loglike_dense
     like = PTALikelihood(psrs, sampled, inner, gram_mode, mesh=mesh,
                          consts=_sh)
+    if joint_mode == "schur":
+        like._eval_health = loglike_health
+        like._eval_health_batch = jax.vmap(loglike_health,
+                                           in_axes=(0, None))
+        # pulsar-axis attribution for the health ladder (pads excluded)
+        like.health_psr_names = [p.name for p in psrs]
     # update_mask contract (evaluation-structure layer): installed for
     # the nested-Schur path on process-local arrays with a static basis
     # (a sampled chromatic index makes T walker-dependent, and a psr

@@ -13,6 +13,17 @@ important, *exercisable*:
   sites threaded through the samplers, the Pallas probes, the
   checkpoint/event writers and the CLI model-build loop. Fully inert
   when no plan is set.
+- :mod:`~enterprise_warp_tpu.resilience.integrity` — the numerical-
+  integrity plane: the typed data-quality audit the ingestion gate
+  (``io.pulsar.load_pulsar``) runs over every .par/.tim pair
+  (:class:`~enterprise_warp_tpu.resilience.integrity.DataQualityReport`
+  / :class:`~enterprise_warp_tpu.resilience.integrity.DataQuarantine`),
+  the fixed-shape kernel health-word contract the mixed-precision
+  solvers emit, and the per-pulsar escalation ladder
+  (:class:`~enterprise_warp_tpu.resilience.integrity.HealthLedger` ->
+  :class:`~enterprise_warp_tpu.resilience.integrity.PulsarQuarantine`)
+  that fails a numerically sick pulsar ALONE while the surviving
+  array keeps running.
 - :mod:`~enterprise_warp_tpu.resilience.supervisor` — the supervised
   dispatch wrapper the samplers route device blocks through: a
   wall-clock watchdog that converts a hung dispatch into a typed
@@ -32,6 +43,9 @@ contract.
 
 from .faults import (FaultPlan, FaultSpec, InjectedFault, fire,
                      install_plan, plan)
+from .integrity import (EXIT_QUARANTINED, DataQualityReport,
+                        DataQuarantine, HealthLedger, PulsarQuarantine,
+                        audit_tim)
 from .supervisor import (BlockSupervisor, DispatchHang, PlatformDemotion,
                          apply_demotion, current_level,
                          install_graceful_sigterm, next_level,
@@ -40,6 +54,8 @@ from .supervisor import (BlockSupervisor, DispatchHang, PlatformDemotion,
 __all__ = [
     "FaultPlan", "FaultSpec", "InjectedFault", "fire", "install_plan",
     "plan",
+    "DataQualityReport", "DataQuarantine", "PulsarQuarantine",
+    "HealthLedger", "audit_tim", "EXIT_QUARANTINED",
     "BlockSupervisor", "DispatchHang", "PlatformDemotion",
     "apply_demotion", "current_level", "next_level",
     "install_graceful_sigterm", "preemption_requested",

@@ -298,6 +298,35 @@ class PTSampler:
         # direct inline invocation: the block program and the host-sync
         # pattern are byte-identical to the unsupervised path.
         self._supervisor = BlockSupervisor("pt.dispatch")
+        # kernel-health plane (resilience/integrity.py, numerical-
+        # integrity plane): when the likelihood exposes the health-
+        # instrumented eval twin, the block accumulates fixed-shape
+        # health words in-scan (jitter-engaged / refine-diverged
+        # counts, condition proxy) and the host-side ledgers (one per
+        # pulsar — strikes must not cross-contaminate an array)
+        # escalate at the commit boundary — observe -> f64 re-eval ->
+        # classic route -> per-pulsar quarantine. Master-gated by
+        # EWT_TELEMETRY (off = bit-identical block program),
+        # plane-gated by EWT_KERNEL_HEALTH. Default arming declines
+        # where the megakernel route could engage: the health twin
+        # pins the classic chain, so on such a backend the plane is
+        # an explicit EWT_KERNEL_HEALTH=1 opt-in (accepting the pin).
+        self.health = None
+        health_env = os.environ.get("EWT_KERNEL_HEALTH")
+        if health_env is None:
+            from ..ops.megakernel import mega_route_possible
+            arm_health = not mega_route_possible()
+        else:
+            arm_health = health_env != "0"
+        if telemetry.enabled() and arm_health \
+                and hasattr(like, "_eval_health_batch"):
+            from ..resilience.integrity import HealthLedger
+            names = list(getattr(like, "health_psr_names", None) or [])
+            if not names:
+                names = [getattr(getattr(like, "psr", None), "name",
+                                 "?")]
+            self._health_psrs = names
+            self.health = [HealthLedger(psr=n) for n in names]
         os.makedirs(outdir, exist_ok=True)
 
     # ---------------- initialization / resume -------------------------- #
@@ -486,6 +515,27 @@ class PTSampler:
             hist_lo = jnp.asarray(self._hist_lo)
             hist_span = jnp.asarray(self._hist_span)
             rung_idx = jnp.arange(W) // nchains
+        # kernel-health plane: the health-instrumented eval twin
+        # replaces batch_eval inside the scan (same lnl math on the
+        # classic chain, plus the fixed-shape health word side output);
+        # accumulators ride the carry like the diagnostics plane —
+        # zero-initialized inside the jit, harvested at the commit
+        # snapshot, empty pytree when off (bit-identical program).
+        emit_health = self.health is not None
+        self._health_emitted = emit_health
+        if emit_health:
+            n_hpsr = len(self._health_psrs)
+            batch_eval_h = like._eval_health_batch
+            if ck > 0 and self.W > ck and self.W % ck == 0:
+                full_h, nchunks_h = batch_eval_h, self.W // ck
+
+                def batch_eval_h(thetas, consts):     # noqa: F811
+                    tc = thetas.reshape(nchunks_h, ck,
+                                        thetas.shape[-1])
+                    lnl_c, hw_c = jax.lax.map(
+                        lambda t: full_h(t, consts), tc)
+                    return (lnl_c.reshape(-1),
+                            hw_c.reshape((-1,) + hw_c.shape[2:]))
         use_ind = bool(self.jump_probs[4] > 0)
         use_cg = bool(self.jump_probs[5] > 0)
         use_kde = bool(self.jump_probs[6] > 0)
@@ -524,7 +574,7 @@ class PTSampler:
                 fam_acc, fam_prop, mask_counts, \
                 eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL, \
                 lam, cg_rows, kde_pts, kde_bw, temps, consts, \
-                dstate = carry
+                dstate, hstate = carry
             key, k1, k2, k3, k4, k5, k6, k7, k8, k9, k10, k11 = \
                 jax.random.split(key, 12)
 
@@ -706,7 +756,24 @@ class PTSampler:
             key, ka = jax.random.split(key)
             with jax.named_scope("pt.eval"):
                 lnp_new = like.log_prior(prop)
-                lnl_new = batch_eval(prop, consts)
+                if emit_health:
+                    lnl_new, hw_new = batch_eval_h(prop, consts)
+                else:
+                    lnl_new = batch_eval(prop, consts)
+            if emit_health:
+                # in-scan health fold (numerical-integrity plane):
+                # per-pulsar jitter/divergence EVAL counts + worst
+                # condition proxy — fixed shapes, no upload, harvested
+                # at the commit snapshot
+                hwv = hw_new if hw_new.ndim == 3 else hw_new[:, None, :]
+                h_n, h_jit, h_div, h_cond = hstate
+                hstate = (
+                    h_n + float(W),
+                    h_jit + jnp.sum(hwv[:, :, 0] > 0.5, axis=0)
+                    .astype(h_jit.dtype),
+                    h_div + jnp.sum(hwv[:, :, 1] > 0.5, axis=0)
+                    .astype(h_div.dtype),
+                    jnp.maximum(h_cond, jnp.max(hwv[:, :, 2], axis=0)))
             if emit_nf:
                 nf_t = jnp.sum(
                     (~jnp.isfinite(lnl_new) & ~jnp.isneginf(lnp_new))
@@ -845,7 +912,7 @@ class PTSampler:
                      fam_acc, fam_prop, mask_counts,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
                      lam, cg_rows, kde_pts, kde_bw, temps, consts,
-                     dstate), ys)
+                     dstate, hstate), ys)
 
         def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                   fam_acc, fam_prop, mask_counts,
@@ -859,11 +926,16 @@ class PTSampler:
                               jnp.zeros((ntemps, _NFAM))))
             else:
                 dstate0 = ()
+            if emit_health:
+                hstate0 = (jnp.zeros(()), jnp.zeros((n_hpsr,)),
+                           jnp.zeros((n_hpsr,)), jnp.zeros((n_hpsr,)))
+            else:
+                hstate0 = ()
             carry = (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                      fam_acc, fam_prop, mask_counts,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
                      lam, cg_rows, kde_pts, kde_bw, temps, consts,
-                     dstate0)
+                     dstate0, hstate0)
             # named for jax.profiler captures (EWT_PROFILE_CAPTURE):
             # the whole K-step scan shows up as one legible region
             with jax.named_scope("ptmcmc_block"):
@@ -1085,7 +1157,7 @@ class PTSampler:
         # diagnostics-plane harvest rides the SAME commit snapshot —
         # the one designed sync per block, so the plane adds zero host
         # round-trips (the BENCH_MIXING zero-overhead contract)
-        dstate = carry[-1] if getattr(self, "_diag_emitted", False) \
+        dstate = carry[-2] if getattr(self, "_diag_emitted", False) \
             else ()
         if dstate:
             leaves.update(
@@ -1093,6 +1165,13 @@ class PTSampler:
                 diag_m2=dstate[2], diag_min=dstate[3],
                 diag_max=dstate[4], diag_hist=dstate[5],
                 diag_fam_a=dstate[6], diag_fam_p=dstate[7])
+        # kernel-health harvest: same single designed sync — the
+        # health plane adds zero dispatches and zero host round-trips
+        hstate = carry[-1] if getattr(self, "_health_emitted", False) \
+            else ()
+        if hstate:
+            leaves.update(h_n=hstate[0], h_jit=hstate[1],
+                          h_div=hstate[2], h_cond=hstate[3])
         with span("pt.commit", steps=todo):
             # the commit sync is where a dead relay actually manifests
             # (the dispatch above is async) — watchdog-supervised, but
@@ -1149,6 +1228,8 @@ class PTSampler:
         st.step += todo
         if nf_steps is not None:
             self._escalate_nonfinite(snap, st, todo)
+        if hstate:
+            self._fold_health(snap, st, todo)
         return snap, snap["cold"], snap["cold_lnl"], snap["cold_lnp"]
 
     # ewt: allow-host-sync — anomaly forensics: reads the committed
@@ -1185,6 +1266,114 @@ class PTSampler:
             bad_walker_idx=np.nonzero(bad)[0][:8],
             bad_theta=x[bad][:8], bad_lnl=lnl[bad][:8],
             bad_lnp=lnp[bad][:8])
+
+    # ewt: allow-host-sync — health escalation at the commit boundary:
+    # reads the committed host snapshot; the reeval rung's f64 oracle
+    # pass is an explicit, counted diagnostic eval (escalation path
+    # only, never the steady-state hot path)
+    def _fold_health(self, snap, st, todo):
+        """Fold one block's harvested kernel-health accumulators into
+        the ledger and act on its escalation verdict (see
+        ``resilience.integrity.HealthLedger``): ``observe`` — typed
+        ``kernel_health`` event; ``reeval`` — f64-oracle re-evaluation
+        of a committed cold-chain sample, verdict recorded; ``classic``
+        — megakernel hatch flipped (the bit-equal XLA route, effective
+        at the next trace); ``quarantine`` — typed
+        :class:`~..resilience.integrity.PulsarQuarantine`, failing this
+        pulsar ALONE. The fault site ``kernel.health`` lets the chaos
+        harness plant a near-singular-Gram pathology here."""
+        from ..resilience.integrity import LADDER, PulsarQuarantine
+        n = float(np.asarray(snap["h_n"]))
+        jit_c = np.atleast_1d(np.asarray(snap["h_jit"], dtype=float))
+        div_c = np.atleast_1d(np.asarray(snap["h_div"], dtype=float))
+        cond = np.atleast_1d(np.asarray(snap["h_cond"], dtype=float))
+        spec = faults.fire("kernel.health", step=int(st.step),
+                           psr=self._health_psrs[0])
+        if spec is not None and spec.kind == "nonfinite":
+            # planted near-singular Gram: every eval of the first
+            # pulsar trips the jitter fallback at condition ~1e99
+            jit_c = jit_c.copy()
+            jit_c[0] = n
+            cond = cond.copy()
+            cond[0] = 99.0
+        tot_jit = int(jit_c.sum())
+        if tot_jit:
+            # the previously-silent fallback, now first-class telemetry
+            telemetry.registry().counter(
+                "jitter_engaged", where="pt.block").inc(tot_jit)
+        if int(div_c.sum()):
+            telemetry.registry().counter(
+                "refine_diverged", where="pt.block").inc(
+                int(div_c.sum()))
+        # every pulsar walks its OWN strike ladder with its own block
+        # stats (a shared counter would let pulsar A's strikes
+        # quarantine pulsar B); the most-escalated verdict acts
+        worst, action = None, None
+        for i, led in enumerate(self.health):
+            act = led.update(n, jit_c[i], div_c[i], cond[i])
+            if act is not None and (action is None
+                                    or LADDER.index(act)
+                                    > LADDER.index(action)):
+                worst, action = i, act
+        if action is None:
+            return
+        led = self.health[worst]
+        psr = self._health_psrs[worst]
+        stats = dict(led.stats(), psr=psr,
+                     block_jitter_frac=round(jit_c[worst] / max(n, 1.0),
+                                             4),
+                     block_logcond=round(float(cond[worst]), 2))
+        rec = telemetry.active_recorder()
+        reeval = None
+        if action == "reeval":
+            # f64-oracle re-evaluation of committed cold walkers: does
+            # the mixed-precision chain still agree where it matters?
+            fn = getattr(self.like, "_eval_f64_batch", None)
+            if fn is not None:
+                sub = np.asarray(snap["x"])[:min(self.nchains, 8)]
+                ref = np.asarray(fn(jnp.asarray(sub), self._consts))
+                got = np.asarray(snap["lnl"])[:len(sub)]
+                finite = np.isfinite(ref) & np.isfinite(got)
+                diff = (float(np.max(np.abs(ref - got)[finite]))
+                        if finite.any() else float("inf"))
+                agreed = diff < 0.1
+                led.note_reeval(agreed, diff)
+                reeval = {"agreed": agreed,
+                          "max_abs_diff": round(diff, 6)}
+        if action == "classic":
+            # the supervisor's mega -> classic rung, health-triggered:
+            # the documented bit-equal XLA fallback. The cached block
+            # executable baked its route decision in at trace time, so
+            # the hatch must invalidate it — the next dispatch then
+            # retraces with EWT_PALLAS=0 and every remaining
+            # mega-routed solve (e.g. the joint stage-3) moves to the
+            # classic chain immediately, not at the next size change.
+            os.environ["EWT_PALLAS"] = "0"
+            self._compiled_block = None
+        _log.warning("kernel health tripped at step %d: action=%s "
+                     "psr=%s %s", int(st.step), action, psr, stats)
+        flight_recorder().record("kernel_health", action=action,
+                                 psr=psr, **{k: v for k, v in
+                                             stats.items()
+                                             if k != "psr"})
+        if rec is not None:
+            ev = dict(stats)
+            if reeval is not None:
+                ev["reeval_agreed"] = reeval["agreed"]
+                ev["reeval_max_abs_diff"] = reeval["max_abs_diff"]
+            rec.event("kernel_health", action=action, step=int(st.step),
+                      **ev)
+            rec.flush()    # must survive the quarantine raise below
+        if action == "quarantine":
+            faults.fire("psr.quarantine", psr=psr)
+            # mark the live likelihood so the serving door's
+            # model_quarantined gate refuses it from now on
+            # (serve/admission.quarantine_reason)
+            self.like.quarantined = True
+            from ..resilience.integrity import emit_psr_quarantined
+            emit_psr_quarantined(psr, cause="kernel_health",
+                                 where="sampler", stats=stats)
+            raise PulsarQuarantine(psr, "kernel_health", stats)
 
     def _run_block(self, st, todo, temps=None):
         """Advance ``st`` by ``todo`` steps (dispatch + commit in one
@@ -1744,6 +1933,16 @@ class PTSampler:
                 if worst_stream is not None:
                     hb["rhat_stream"] = worst_stream["rhat"]
                     hb["ess_stream"] = worst_stream["ess"]
+                if self.health is not None:
+                    # kernel-health plane: run-cumulative fallback
+                    # engagements + worst condition proxy (the
+                    # previously-silent jitter path, now a heartbeat)
+                    hb["jitter_engaged"] = sum(
+                        led.n_jitter for led in self.health)
+                    hb["refine_diverged"] = sum(
+                        led.n_diverge for led in self.health)
+                    hb["kernel_cond"] = round(max(
+                        led.max_logcond for led in self.health), 3)
                 # device-memory watermark gauges (profiling layer):
                 # present only on backends exposing memory_stats()
                 mem = profiling.memory_watermark()

@@ -35,12 +35,30 @@ from collections import deque
 import numpy as np
 
 __all__ = ["Rejection", "UnknownModel", "validate_thetas",
-           "prior_bounds", "fair_share_order", "parse_serve_config"]
+           "prior_bounds", "fair_share_order", "parse_serve_config",
+           "quarantine_reason"]
 
 #: the machine-readable rejection vocabulary (``serve_rejected`` event
 #: ``reason`` field + ``serve_rejected{reason=}`` counter labels)
 REASONS = ("unknown_model", "bad_dtype", "bad_shape", "nonfinite",
-           "prior_support", "queue_full", "tenant_quota")
+           "prior_support", "queue_full", "tenant_quota",
+           "model_quarantined")
+
+
+def quarantine_reason(like):
+    """Why a likelihood must not be served, or None when it is clean
+    (numerical-integrity plane, docs/resilience.md): a pulsar whose
+    ingestion audit verdict is ``quarantine``, or a likelihood an
+    escalation ladder explicitly marked (``like.quarantined = True``),
+    is rejected at the serving door — a known-corrupt model must not
+    answer tenant traffic."""
+    if getattr(like, "quarantined", False):
+        return "likelihood marked quarantined by the health ladder"
+    dq = getattr(getattr(like, "psr", None), "dq_report", None)
+    if dq is not None and getattr(dq, "verdict", None) == "quarantine":
+        return (f"pulsar {getattr(like.psr, 'name', '?')!r} carries a "
+                "quarantine-verdict ingestion audit")
+    return None
 
 
 class Rejection(ValueError):

@@ -82,7 +82,8 @@ from ..samplers.evalproto import eval_protocol
 from ..utils import profiling, telemetry
 from ..utils.logging import EvalRateMeter, get_logger
 from .admission import (Rejection, UnknownModel, fair_share_order,
-                        prior_bounds, validate_thetas)
+                        prior_bounds, quarantine_reason,
+                        validate_thetas)
 from .aot import AOTExecutableCache
 from .packer import pack_requests, split_batch
 
@@ -209,6 +210,15 @@ class ServeDriver:
                 f"serve width {width} is not a configured bucket "
                 f"{self.cache.buckets} — a warmed replica would "
                 "still cold-compile it")
+        # numerical-integrity gate: a quarantined model (ingestion
+        # audit verdict, or an escalation-ladder mark) never enters
+        # the registry — tenants must not be served known-corrupt
+        # answers (typed, same vocabulary as submit-time rejections)
+        why = quarantine_reason(like)
+        if why is not None:
+            raise Rejection("model_quarantined",
+                            f"model {name!r} refused at register: "
+                            f"{why}")
         _, _, consts = eval_protocol(like)
         self.models[name] = like
         self.widths[name] = width
@@ -257,6 +267,13 @@ class ServeDriver:
                 raise UnknownModel(
                     f"model {model!r} is not registered "
                     f"(have {sorted(self.models)})")
+            # a model quarantined AFTER registration (health ladder
+            # marking a live likelihood) is shed at the door too
+            why = quarantine_reason(like)
+            if why is not None:
+                raise Rejection("model_quarantined",
+                                f"model {model!r} is quarantined: "
+                                f"{why}")
             thetas = validate_thetas(thetas, int(like.ndim), model,
                                      self._bounds.get(model))
             if self.max_queue and len(self.queue) >= self.max_queue:

@@ -1,0 +1,433 @@
+"""Numerical-integrity plane tests (ISSUE 15).
+
+Covers the ingestion gate (typed ParseError with file:line provenance,
+typed DataQuarantine vs repair='drop' behavior on corrupt fixtures —
+NaN TOAs, zero uncertainties, shuffled epochs, truncated lines), the
+``data_quality``/``psr_quarantined`` event schema against
+``tools/report.py --check``, the kernel health-word contract (fixed
+shape, lnl bit-equality under jit, jitter-bit semantics), the
+HealthLedger escalation ladder, serve-admission quarantine rejection,
+and fingerprint keying of repaired datasets.
+"""
+
+import importlib.util
+import json
+import logging
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from enterprise_warp_tpu.io import (ParseError, load_pulsar,
+                                    load_pulsars_from_dir, parse_par,
+                                    parse_tim)
+from enterprise_warp_tpu.resilience.integrity import (
+    DataQuarantine, Finding, HealthLedger, PulsarQuarantine, audit_tim)
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+PAR_TEXT = ("PSRJ J0123+4567\nRAJ 01:23:45\nDECJ 45:06:07\n"
+            "F0 100.0 1\nF1 -1e-15 1\nPEPOCH 55000\n"
+            "TZRSITE BAT\nUNITS TDB\n")
+
+
+def _tim_lines(n=12, err="1.0"):
+    rows = ["FORMAT 1"]
+    for i in range(n):
+        rows.append(f" fake 1400.0 {55000 + 10 * i}.1234567 {err} BAT "
+                    "-group RX")
+    return rows
+
+
+def write_pair(tmp_path, tim_rows, par_text=PAR_TEXT, stem="t"):
+    par = tmp_path / f"{stem}.par"
+    tim = tmp_path / f"{stem}.tim"
+    par.write_text(par_text)
+    tim.write_text("\n".join(tim_rows) + "\n")
+    return str(par), str(tim)
+
+
+def _load_report_cli():
+    spec = importlib.util.spec_from_file_location(
+        "ewt_report_cli", str(REPO_ROOT / "tools" / "report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------------ #
+#  typed parse errors                                                 #
+# ------------------------------------------------------------------ #
+
+class TestParseErrors:
+    def test_truncated_tim_line_carries_provenance(self, tmp_path):
+        rows = _tim_lines(6)
+        rows.insert(4, " fake 1400.0 55900.5")     # 3 tokens, line 5
+        _, tim = write_pair(tmp_path, rows)
+        with pytest.raises(ParseError) as ei:
+            parse_tim(tim, engine="python")
+        assert ei.value.lineno == 5
+        assert ei.value.path == tim
+        assert "truncated TOA line" in str(ei.value)
+
+    def test_malformed_tim_field_is_typed(self, tmp_path):
+        rows = _tim_lines(4)
+        rows[2] = " fake not-a-freq 55020.1 1.0 BAT"
+        _, tim = write_pair(tmp_path, rows)
+        with pytest.raises(ParseError) as ei:
+            parse_tim(tim, engine="python")
+        assert ei.value.lineno == 3
+
+    def test_par_key_without_value(self, tmp_path):
+        par, _ = write_pair(tmp_path, _tim_lines(4),
+                            par_text=PAR_TEXT + "DMEPOCH\n")
+        with pytest.raises(ParseError) as ei:
+            parse_par(par)
+        assert "truncated" in str(ei.value)
+
+    def test_par_malformed_float(self, tmp_path):
+        par, _ = write_pair(tmp_path, _tim_lines(4),
+                            par_text="PSRJ J1\nF0 1oo.0 1\n")
+        with pytest.raises(ParseError) as ei:
+            parse_par(par)
+        assert "F0" in str(ei.value)
+
+    def test_truncated_jump(self, tmp_path):
+        par, _ = write_pair(tmp_path, _tim_lines(4),
+                            par_text=PAR_TEXT + "JUMP -group\n")
+        with pytest.raises(ParseError):
+            parse_par(par)
+
+    def test_unknown_par_key_warns_once(self, tmp_path, caplog):
+        par, _ = write_pair(
+            tmp_path, _tim_lines(4),
+            par_text=PAR_TEXT + "ZZUNKNOWNKEY 1.0\n")
+        with caplog.at_level(logging.WARNING, logger="ewt.io.par"):
+            pf = parse_par(par)
+            parse_par(par)                     # second parse: no repeat
+        hits = [r for r in caplog.records
+                if "ZZUNKNOWNKEY" in r.getMessage()]
+        assert len(hits) == 1
+        assert pf.raw["ZZUNKNOWNKEY"] == "1.0"   # still stored raw
+
+
+# ------------------------------------------------------------------ #
+#  ingestion audit: quarantine vs repair                              #
+# ------------------------------------------------------------------ #
+
+class TestIngestionGate:
+    def test_nan_toa_quarantines(self, tmp_path):
+        rows = _tim_lines(8)
+        rows[3] = " fake 1400.0 nan 1.0 BAT -group RX"
+        par, tim = write_pair(tmp_path, rows)
+        with pytest.raises(DataQuarantine) as ei:
+            load_pulsar(par, tim)
+        codes = {f.code for f in ei.value.report.hard}
+        assert "nonfinite_toa" in codes
+        assert ei.value.report.verdict == "quarantine"
+
+    def test_nan_toa_repairs_under_drop(self, tmp_path):
+        rows = _tim_lines(8)
+        rows[3] = " fake 1400.0 nan 1.0 BAT -group RX"
+        par, tim = write_pair(tmp_path, rows)
+        psr = load_pulsar(par, tim, repair="drop")
+        assert len(psr) == 7
+        rep = psr.dq_report
+        assert rep.verdict == "repaired"
+        assert rep.repairs[0]["action"] == "drop_rows"
+        assert rep.repairs[0]["rows"] == [2]       # provenance
+        assert np.all(np.isfinite(psr.toas))
+
+    def test_zero_uncertainty(self, tmp_path):
+        rows = _tim_lines(8)
+        rows[5] = rows[5].replace(" 1.0 BAT", " 0.0 BAT")
+        par, tim = write_pair(tmp_path, rows)
+        with pytest.raises(DataQuarantine) as ei:
+            load_pulsar(par, tim)
+        assert any(f.code == "nonpositive_err"
+                   for f in ei.value.report.hard)
+        psr = load_pulsar(par, tim, repair="drop")
+        assert len(psr) == 7
+        assert np.all(psr.toaerrs > 0)
+
+    def test_absurd_uncertainty(self, tmp_path):
+        rows = _tim_lines(8)
+        rows[2] = rows[2].replace(" 1.0 BAT", " 1e7 BAT")
+        par, tim = write_pair(tmp_path, rows)
+        with pytest.raises(DataQuarantine) as ei:
+            load_pulsar(par, tim)
+        assert any(f.code == "absurd_err"
+                   for f in ei.value.report.hard)
+
+    def test_shuffled_epochs_soft_and_sort_repair(self, tmp_path):
+        rows = _tim_lines(8)
+        rows[2], rows[6] = rows[6], rows[2]     # out-of-order epochs
+        par, tim = write_pair(tmp_path, rows)
+        psr = load_pulsar(par, tim)             # soft: loads anyway
+        assert psr.dq_report.verdict == "soft"
+        assert any(f.code == "nonmonotonic_toas"
+                   for f in psr.dq_report.findings)
+        psr2 = load_pulsar(par, tim, repair="drop")
+        assert np.all(np.diff(psr2.toas) >= 0)
+        assert any(r["action"] == "sort_epochs"
+                   for r in psr2.dq_report.repairs)
+
+    def test_clean_data_clean_report(self, tmp_path):
+        par, tim = write_pair(tmp_path, _tim_lines(8))
+        psr = load_pulsar(par, tim)
+        assert psr.dq_report.verdict == "clean"
+        assert psr.dq_report.token() == "clean"
+
+    def test_audit_tim_rejects_unknown_policy(self, tmp_path):
+        par, tim = write_pair(tmp_path, _tim_lines(4))
+        tf = parse_tim(tim, engine="python")
+        with pytest.raises(ValueError):
+            audit_tim(tf, "X", repair="bogus")
+
+    def test_repaired_token_keys_differently(self, tmp_path):
+        rows = _tim_lines(8)
+        rows[3] = " fake 1400.0 nan 1.0 BAT -group RX"
+        par, tim = write_pair(tmp_path, rows)
+        psr = load_pulsar(par, tim, repair="drop")
+        tok = psr.dq_report.token()
+        assert tok != "clean" and tok.startswith("repaired:")
+
+    def test_dir_skip_collects_quarantined(self, tmp_path):
+        write_pair(tmp_path, _tim_lines(8), stem="a_good")
+        bad = _tim_lines(8)
+        bad[3] = " fake 1400.0 nan 0.0 BAT"
+        write_pair(tmp_path, bad, stem="b_bad")
+        with pytest.raises(DataQuarantine):
+            load_pulsars_from_dir(str(tmp_path))
+        quarantined = []
+        psrs = load_pulsars_from_dir(str(tmp_path),
+                                     on_quarantine="skip",
+                                     quarantined=quarantined)
+        assert len(psrs) == 1
+        assert len(quarantined) == 1
+        assert quarantined[0][1]["verdict"] == "quarantine"
+
+    def test_dir_skip_handles_parse_error(self, tmp_path):
+        write_pair(tmp_path, _tim_lines(8), stem="a_good")
+        bad = _tim_lines(8)
+        bad.insert(3, " fake 1400.0")           # truncated TOA line
+        write_pair(tmp_path, bad, stem="b_bad")
+        quarantined = []
+        psrs = load_pulsars_from_dir(str(tmp_path),
+                                     on_quarantine="skip",
+                                     quarantined=quarantined)
+        assert len(psrs) == 1
+        assert quarantined[0][1]["findings"][0]["code"] == "parse_error"
+
+
+# ------------------------------------------------------------------ #
+#  event schema                                                       #
+# ------------------------------------------------------------------ #
+
+class TestEventSchema:
+    def test_data_quality_and_quarantine_events_check_clean(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("EWT_TELEMETRY", "1")
+        from enterprise_warp_tpu.utils import telemetry
+        data = tmp_path / "data"
+        data.mkdir()
+        rows = _tim_lines(8)
+        rows[3] = " fake 1400.0 nan 1.0 BAT -group RX"
+        write_pair(data, rows, stem="a_repairable")
+        bad = _tim_lines(8)
+        bad[2] = bad[2].replace(" 1.0 BAT", " 0.0 BAT")
+        write_pair(data, bad,
+                   par_text=PAR_TEXT.replace("J0123+4567",
+                                             "J0123+4568"),
+                   stem="b_bad")
+        run_dir = tmp_path / "run"
+        run_dir.mkdir()
+        with telemetry.run_scope(str(run_dir), sampler="test"):
+            quarantined = []
+            load_pulsars_from_dir(str(data), repair="none",
+                                  on_quarantine="skip",
+                                  quarantined=quarantined)
+        events = [json.loads(line) for line in
+                  (run_dir / "events.jsonl").read_text().splitlines()]
+        dq = [e for e in events if e["type"] == "data_quality"]
+        pq = [e for e in events if e["type"] == "psr_quarantined"]
+        assert len(pq) == 2          # both pulsars hard-fail w/o repair
+        for ev in dq:
+            assert {"psr", "code", "severity", "count"} <= set(ev)
+        rep = _load_report_cli()
+        problems = rep.check_stream(str(run_dir / "events.jsonl"),
+                                    out=open(os.devnull, "w"))
+        assert problems == 0
+        folded = rep.build_report(events)
+        assert folded["integrity"]["quarantined_pulsars"]
+
+    def test_report_vocabulary(self):
+        rep = _load_report_cli()
+        assert {"data_quality", "kernel_health",
+                "psr_quarantined"} <= rep.KNOWN_EVENT_TYPES
+        assert {"jitter_engaged", "refine_diverged",
+                "kernel_cond"} <= rep.KNOWN_HEARTBEAT_FIELDS
+
+
+# ------------------------------------------------------------------ #
+#  health words                                                       #
+# ------------------------------------------------------------------ #
+
+class TestHealthWord:
+    def test_equilibrated_cholesky_health(self):
+        import jax.numpy as jnp
+
+        from enterprise_warp_tpu.ops.kernel import equilibrated_cholesky
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((8, 8))
+        S = jnp.asarray(A @ A.T + 8 * np.eye(8))
+        L0, s0, ld0 = equilibrated_cholesky(S, 1e-6)
+        L1, s1, ld1, hw = equilibrated_cholesky(S, 1e-6,
+                                                with_health=True)
+        assert hw.shape == (3,)
+        assert float(hw[0]) == 0.0                # no fallback engaged
+        assert np.array_equal(np.asarray(L0), np.asarray(L1))
+        # an indefinite matrix must engage the jitter fallback bit
+        Sb = jnp.asarray(np.diag([1.0, -1.0, 1.0]))
+        _, _, _, hwb = equilibrated_cholesky(Sb, 1e-3,
+                                             with_health=True)
+        assert float(hwb[0]) == 1.0
+
+    def test_mixed_solve_health_bit_equal(self):
+        import jax
+        import jax.numpy as jnp
+
+        from enterprise_warp_tpu.ops.kernel import _mixed_psd_solve_logdet
+        rng = np.random.default_rng(1)
+        A = rng.standard_normal((40, 24))
+        S = jnp.asarray(A.T @ A + 0.5 * np.eye(24))
+        B = jnp.asarray(rng.standard_normal((24, 3)))
+        f0 = jax.jit(lambda S, B: _mixed_psd_solve_logdet(
+            S, B, 3e-6, refine=3, delta_mode="split"))
+        f1 = jax.jit(lambda S, B: _mixed_psd_solve_logdet(
+            S, B, 3e-6, refine=3, delta_mode="split",
+            with_health=True))
+        Z0, ld0 = f0(S, B)
+        Z1, ld1, hw = f1(S, B)
+        assert hw.shape == (3,)
+        assert np.array_equal(np.asarray(Z0), np.asarray(Z1))
+        assert float(ld0) == float(ld1)
+
+    def test_likelihood_health_twin_bit_equal_under_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        from enterprise_warp_tpu.models.build import \
+            build_pulsar_likelihood
+        from enterprise_warp_tpu.models.standard import StandardModels
+        from enterprise_warp_tpu.models.terms import TermList
+        from enterprise_warp_tpu.sim import (inject_white,
+                                             make_fake_pulsar)
+        psr = make_fake_pulsar(ntoa=50, backends=("RX",),
+                               toaerr_us=1.0, seed=7)
+        inject_white(psr, efac={"RX": 1.3},
+                     rng=np.random.default_rng(8))
+        sm = StandardModels(psr=psr)
+        terms = TermList(psr)
+        for name, opt in (("efac", "by_backend"),
+                          ("spin_noise", "powerlaw")):
+            res = getattr(sm, name)(option=opt)
+            terms.extend(res if isinstance(res, list) else [res])
+        like = build_pulsar_likelihood(psr, terms)
+        th = np.asarray(like.sample_prior(np.random.default_rng(0), 6))
+        l0 = np.asarray(jax.jit(like._eval_batch)(jnp.asarray(th),
+                                                  like.consts))
+        l1, hw = jax.jit(like._eval_health_batch)(jnp.asarray(th),
+                                                  like.consts)
+        assert np.array_equal(l0, np.asarray(l1))
+        assert np.asarray(hw).shape == (6, 3)
+        # the f64 oracle twin agrees to oracle tolerance
+        lf = np.asarray(like._eval_f64_batch(jnp.asarray(th),
+                                             like.consts))
+        assert np.max(np.abs(lf - l0)) < 1e-2
+
+    def test_mega_route_refuses_health(self):
+        import jax.numpy as jnp
+
+        from enterprise_warp_tpu.ops.kernel import _mixed_psd_solve_logdet
+        S = jnp.eye(4)
+        with pytest.raises(ValueError):
+            _mixed_psd_solve_logdet(S, jnp.ones((4, 1)), 1e-6,
+                                    mega=True, with_health=True)
+
+
+# ------------------------------------------------------------------ #
+#  escalation ladder                                                  #
+# ------------------------------------------------------------------ #
+
+class TestHealthLedger:
+    def test_ladder_walks_to_quarantine(self):
+        led = HealthLedger("J1", jitter_frac=0.25, logcond_max=14.0)
+        acts = [led.update(100, 50, 0, 5.0) for _ in range(4)]
+        assert acts == ["observe", "reeval", "classic", "quarantine"]
+        assert led.tripped_blocks == 4
+
+    def test_healthy_blocks_walk_back_down(self):
+        led = HealthLedger("J1")
+        assert led.update(100, 60, 0, 5.0) == "observe"
+        assert led.update(100, 0, 0, 2.0) is None
+        assert led.strikes == 0
+        # the ladder restarts from the bottom after recovery
+        assert led.update(100, 60, 0, 5.0) == "observe"
+
+    def test_trip_conditions(self):
+        led = HealthLedger("J1", jitter_frac=0.5, logcond_max=10.0)
+        assert not led.tripped(100, 10, 0, 3.0)
+        assert led.tripped(100, 60, 0, 3.0)       # jitter fraction
+        assert led.tripped(100, 0, 1, 3.0)        # any divergence
+        assert led.tripped(100, 0, 0, 12.0)       # condition proxy
+        assert not led.tripped(0, 0, 0, 0.0)      # empty block
+
+    def test_reeval_verdicts_recorded(self):
+        led = HealthLedger("J1")
+        led.note_reeval(True, 1e-9)
+        assert led.reeval_verdicts[0]["agreed"] is True
+
+
+# ------------------------------------------------------------------ #
+#  serve admission + quarantine propagation                           #
+# ------------------------------------------------------------------ #
+
+class TestServeQuarantine:
+    def test_quarantine_reason(self, tmp_path):
+        from enterprise_warp_tpu.serve.admission import (
+            REASONS, quarantine_reason)
+        assert "model_quarantined" in REASONS
+
+        class Clean:
+            pass
+
+        assert quarantine_reason(Clean()) is None
+
+        class Marked:
+            quarantined = True
+
+        assert quarantine_reason(Marked()) is not None
+
+        class Psr:
+            name = "J1"
+
+        class Like:
+            psr = Psr()
+
+        rep_obj = type("R", (), {"verdict": "quarantine"})()
+        Like.psr.dq_report = rep_obj
+        assert "quarantine" in quarantine_reason(Like())
+
+    def test_pulsar_quarantine_is_typed(self):
+        q = PulsarQuarantine("J1", "kernel_health", {"strikes": 4})
+        assert q.psr == "J1"
+        assert q.stats["strikes"] == 4
+        assert isinstance(q, RuntimeError)
+
+    def test_finding_roundtrip(self):
+        f = Finding(code="nonfinite_toa", severity="hard", count=2,
+                    detail="x", rows=[1, 5])
+        d = f.to_dict()
+        assert d["code"] == "nonfinite_toa" and d["rows"] == [1, 5]

@@ -31,7 +31,20 @@ Usage::
     python tools/chaos.py --seed 0                 # full PT soak
     python tools/chaos.py --seed 0 --nsamp 300 --blocks 3   # smoke
     python tools/chaos.py --seed 0 --serve         # serving storm
+    python tools/chaos.py --seed 0 --integrity     # integrity storm
     python tools/chaos.py --seed 0 --workdir /tmp/chaos --keep
+
+``--integrity`` runs the NUMERICAL-integrity storm instead
+(docs/resilience.md, "Numerical integrity"): a corrupt-data leg (one
+pulsar's .tim rots with a NaN TOA + a zero uncertainty — quarantined
+at the ingestion gate, per-pulsar and array campaigns both continue
+with the survivors), a near-singular leg (a ``kernel.health`` fault
+plants a condition pathology every block, walking the escalation
+ladder observe -> f64 re-eval -> classic -> per-pulsar quarantine),
+and an in-process health-plane A/B (telemetry-off vs health-armed:
+chains bit-equal, zero added dispatches/host syncs). The verdict
+lands in CHAOS.json under ``"integrity"``, gated by the sentinel's
+``integrity`` gate.
 
 Each campaign leg is a real ``enterprise_warp_tpu.cli`` subprocess, so
 kills are real SIGKILLs (torn writes and stale checkpoints included)
@@ -94,7 +107,8 @@ def write_prfile(workdir, name, out, nsamp, cov_update):
     return path
 
 
-def run_leg(workdir, prfile, plan=None, watchdog_s=0.0, timeout=600):
+def run_leg(workdir, prfile, plan=None, watchdog_s=0.0, timeout=600,
+            num=0, env_extra=None):
     """One CLI subprocess; returns its returncode (negative = killed
     by that signal)."""
     env = dict(os.environ)
@@ -106,9 +120,10 @@ def run_leg(workdir, prfile, plan=None, watchdog_s=0.0, timeout=600):
     if plan is not None:
         env["EWT_FAULT_PLAN"] = json.dumps(plan)
     env["EWT_WATCHDOG_S"] = str(watchdog_s)
+    env.update(env_extra or {})
     r = subprocess.run(
         [sys.executable, "-m", "enterprise_warp_tpu.cli",
-         "--prfile", prfile, "--num", "0"],
+         "--prfile", prfile, "--num", str(num)],
         cwd=workdir, env=env, timeout=timeout, capture_output=True)
     return r.returncode, r.stderr.decode("utf-8", "replace")[-2000:]
 
@@ -208,8 +223,10 @@ def merge_record(output, record, key=None):
     if not isinstance(existing, dict):
         existing = {}
     if key is None:
-        if "serve" in existing:
-            record = dict(record, serve=existing["serve"])
+        for side_key in ("serve", "integrity"):
+            if side_key in existing:
+                record = dict(record,
+                              **{side_key: existing[side_key]})
     else:
         merged = existing
         merged[key] = record
@@ -456,6 +473,322 @@ def serve_storm(opts, workdir):
     return record
 
 
+# ------------------------------------------------------------------ #
+#  the numerical-integrity storm (--integrity)                         #
+# ------------------------------------------------------------------ #
+
+PSR_NAMES = ("J0001+0001", "J0002+0002", "J0003+0003")
+EXIT_QUARANTINED = 76
+
+
+def make_array_dataset(workdir, seed, sub="data"):
+    """Three deterministic fake pulsars + a universal efac noise
+    model — the integrity storm's array."""
+    import numpy as np
+
+    from enterprise_warp_tpu.io.writers import save_pulsar_pair
+    from enterprise_warp_tpu.sim import inject_white, make_fake_pulsar
+
+    datadir = os.path.join(workdir, sub)
+    for i, name in enumerate(PSR_NAMES):
+        psr = make_fake_pulsar(name=name, ntoa=50, backends=("RX",),
+                               toaerr_us=1.0, seed=seed + 200 + i,
+                               raj=0.4 * (i + 1), decj=-0.2 * (i + 1))
+        inject_white(psr, efac={"RX": 1.2 + 0.1 * i},
+                     rng=np.random.default_rng(seed + 300 + i))
+        save_pulsar_pair(psr, datadir)
+    with open(os.path.join(workdir, "nm.json"), "w") as fh:
+        json.dump({"universal": {"efac": "by_backend"}}, fh)
+    return datadir
+
+
+def write_arr_prfile(workdir, name, datadir, out, nsamp, cov_update,
+                     array=False, extra=""):
+    path = os.path.join(workdir, name)
+    with open(path, "w") as fh:
+        fh.write(
+            "paramfile_label: chaos\n"
+            f"datadir: {datadir}/\n"
+            f"out: {out}/\n"
+            f"array_analysis: {'True' if array else 'False'}\n"
+            "sampler: ptmcmcsampler\n"
+            "SCAMweight: 30\nAMweight: 15\nDEweight: 50\n"
+            f"nsamp: {nsamp}\n"
+            f"covUpdate: {cov_update}\n"
+            + extra +
+            "{0}\n"
+            "noise_model_file: nm.json\n")
+    return path
+
+
+def corrupt_tim(path):
+    """Plant the documented corruption: one NaN TOA epoch and one
+    zero uncertainty — both HARD audit findings."""
+    lines = open(path).read().splitlines()
+    out, n_toa = [], 0
+    for ln in lines:
+        toks = ln.split()
+        head = toks[0].upper() if toks else ""
+        if len(toks) >= 5 and head not in ("FORMAT", "MODE", "C",
+                                           "INCLUDE"):
+            n_toa += 1
+            if n_toa == 3:
+                toks[2] = "nan"
+                ln = " " + " ".join(toks)
+            elif n_toa == 5:
+                toks[3] = "0.0"
+                ln = " " + " ".join(toks)
+        out.append(ln)
+    with open(path, "w") as fh:
+        fh.write("\n".join(out) + "\n")
+
+
+def psr_chain(workdir, out, name):
+    """The per-pulsar cold-chain file under one leg's output tree."""
+    return find_one(os.path.join(workdir, out, "**", f"*_{name}",
+                                 "chain_1.txt"))
+
+
+def _chains_eq(a, b):
+    return bool(a and b and filecmp.cmp(a, b, shallow=False))
+
+
+def _chain(root):
+    return find_one(os.path.join(root, "**", "chain_1.txt"))
+
+
+def health_ab(workdir, seed):
+    """In-process health-plane A/B: telemetry-off baseline vs
+    telemetry-on-health-off vs health-armed — chains must be bit-equal
+    and the armed leg must add ZERO dispatches and ZERO host syncs
+    (the in-scan accumulator contract)."""
+    import numpy as np
+
+    from enterprise_warp_tpu.models.build import build_pulsar_likelihood
+    from enterprise_warp_tpu.models.standard import StandardModels
+    from enterprise_warp_tpu.models.terms import TermList
+    from enterprise_warp_tpu.sim import inject_white, make_fake_pulsar
+
+    psr = make_fake_pulsar(name="J0009+0009", ntoa=50,
+                           backends=("RX",), toaerr_us=1.0,
+                           seed=seed + 900)
+    inject_white(psr, efac={"RX": 1.3},
+                 rng=np.random.default_rng(seed + 901))
+    sm = StandardModels(psr=psr)
+    terms = TermList(psr)
+    res = sm.efac(option="by_backend")
+    terms.extend(res if isinstance(res, list) else [res])
+    like = build_pulsar_likelihood(psr, terms)
+
+    def one(tag, env):
+        from enterprise_warp_tpu.samplers.ptmcmc import PTSampler
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            out = os.path.join(workdir, f"ab_{tag}")
+            smp = PTSampler(like, out, ntemps=1, nchains=8,
+                            seed=seed, cov_update=40)
+            smp.sample(160, resume=False, verbose=False)
+            return {"out": out, "n_dispatch": smp.n_dispatch,
+                    "n_sync": smp.n_sync,
+                    "health_armed": smp.health is not None}
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    base = one("off", {"EWT_TELEMETRY": "0"})
+    plain = one("plain", {"EWT_TELEMETRY": "1",
+                          "EWT_KERNEL_HEALTH": "0"})
+    armed = one("health", {"EWT_TELEMETRY": "1",
+                           "EWT_KERNEL_HEALTH": "1"})
+    eq = filecmp.cmp(os.path.join(base["out"], "chain_1.txt"),
+                     os.path.join(armed["out"], "chain_1.txt"),
+                     shallow=False)
+    return {
+        "baseline_dispatches": base["n_dispatch"],
+        "added_dispatches": armed["n_dispatch"] - plain["n_dispatch"],
+        "added_host_syncs": armed["n_sync"] - plain["n_sync"],
+        "added_vs_telemetry_off": armed["n_dispatch"]
+        - base["n_dispatch"],
+        "health_armed": armed["health_armed"],
+        "chains_bit_equal": bool(eq),
+    }
+
+
+def integrity_storm(opts, workdir):
+    """The numerical-integrity storm (docs/resilience.md): a
+    corrupt-data leg (ingestion quarantine, array degradation), a
+    near-singular leg (planted ``kernel.health`` pathology walking
+    the escalation ladder to a per-pulsar quarantine), and the
+    health-plane zero-overhead A/B — each asserting survivors
+    bit-equal to the clean reference. Returns the CHAOS.json
+    ``integrity`` record."""
+    nsamp, cov = 240, 40                 # 6 blocks: ladder needs >= 4
+    datadir = make_array_dataset(workdir, opts.seed)
+    sick = PSR_NAMES[1]
+    print(f"[chaos:integrity] workdir={workdir} seed={opts.seed} "
+          f"psrs={PSR_NAMES} sick={sick}", flush=True)
+
+    # corrupted copy of the array (the sick pulsar's tim rots)
+    bad_dir = os.path.join(workdir, "data_bad")
+    shutil.copytree(datadir, bad_dir)
+    corrupt_tim(os.path.join(bad_dir, f"{sick}.tim"))
+    # survivor-only copy (the array-leg clean reference)
+    ref2_dir = os.path.join(workdir, "data_ref2")
+    os.makedirs(ref2_dir)
+    for n in PSR_NAMES:
+        if n == sick:
+            continue
+        for ext in (".par", ".tim"):
+            shutil.copy(os.path.join(datadir, n + ext), ref2_dir)
+
+    # ---- per-pulsar clean reference (also the health-leg ref) ----- #
+    pr_ref = write_arr_prfile(workdir, "iref.dat", "data", "out_iref",
+                              nsamp, cov)
+    ref_exits = {}
+    for i in range(len(PSR_NAMES)):
+        rc, err = run_leg(workdir, pr_ref, num=i)
+        ref_exits[i] = rc
+        if rc != 0:
+            print(f"[chaos:integrity] clean ref num={i} failed "
+                  f"(exit {rc}):\n{err}", file=sys.stderr)
+            return {"pass": False,
+                    "error": f"clean ref num={i} exit {rc}"}
+    print("[chaos:integrity] per-pulsar clean reference complete",
+          flush=True)
+
+    # ---- leg 1: corrupt data, per-pulsar campaign ----------------- #
+    pr_bad = write_arr_prfile(workdir, "ibad.dat", "data_bad",
+                              "out_ibad", nsamp, cov)
+    data_exits = {}
+    for i in range(len(PSR_NAMES)):
+        rc, err = run_leg(workdir, pr_bad, num=i)
+        data_exits[i] = rc
+    data_surv_eq = all(
+        _chains_eq(psr_chain(workdir, "out_iref", PSR_NAMES[i]),
+                   psr_chain(workdir, "out_ibad", PSR_NAMES[i]))
+        for i in (0, 2))
+    data_leg = {
+        "exits": data_exits,
+        "sick_exit_quarantined": data_exits[1] == EXIT_QUARANTINED,
+        "survivors_bit_equal": bool(data_surv_eq),
+    }
+    print(f"[chaos:integrity] data leg: exits={data_exits} "
+          f"survivors_bit_equal={data_surv_eq}", flush=True)
+
+    # ---- leg 2: array run degrades gracefully --------------------- #
+    pr_aref = write_arr_prfile(workdir, "iaref.dat", "data_ref2",
+                               "out_aref", nsamp, cov, array=True)
+    rc_aref, err = run_leg(workdir, pr_aref)
+    pr_astorm = write_arr_prfile(workdir, "iastorm.dat", "data_bad",
+                                 "out_astorm", nsamp, cov, array=True,
+                                 extra="on_quarantine: skip\n")
+    rc_astorm, err2 = run_leg(workdir, pr_astorm)
+    aref_chain = _chain(os.path.join(workdir, "out_aref"))
+    astorm_chain = _chain(os.path.join(workdir, "out_astorm"))
+    arr_eq = bool(aref_chain and astorm_chain
+                  and filecmp.cmp(aref_chain, astorm_chain,
+                                  shallow=False))
+    qjson = find_one(os.path.join(workdir, "out_astorm", "**",
+                                  "quarantined.json"))
+    qnames = []
+    if qjson:
+        with open(qjson) as fh:
+            qnames = json.load(fh).get("quarantined_pulsars", [])
+    arr_leg = {
+        "ref_exit": rc_aref, "storm_exit": rc_astorm,
+        "survivors_bit_equal": arr_eq,
+        "quarantine_artifact": bool(qjson),
+        "quarantined": qnames,
+    }
+    print(f"[chaos:integrity] array leg: exits=({rc_aref},"
+          f"{rc_astorm}) bit_equal={arr_eq} quarantined={qnames}",
+          flush=True)
+
+    # ---- leg 3: planted near-singular pathology (kernel.health) --- #
+    plan = {"faults": [{"site": "kernel.health", "kind": "nonfinite"}]}
+    pr_h = write_arr_prfile(workdir, "ihealth.dat", "data",
+                            "out_ihealth", nsamp, cov)
+    health_exits = {}
+    for i in range(len(PSR_NAMES)):
+        rc, err = run_leg(workdir, pr_h, num=i,
+                          plan=plan if i == 1 else None)
+        health_exits[i] = rc
+    h_surv_eq = all(
+        _chains_eq(psr_chain(workdir, "out_iref", PSR_NAMES[i]),
+                   psr_chain(workdir, "out_ihealth", PSR_NAMES[i]))
+        for i in (0, 2))
+    ev_path = find_one(os.path.join(workdir, "out_ihealth", "**",
+                                    f"*_{sick}", "events.jsonl"))
+    events = stream_events(ev_path)
+    kh = [ev for ev in events if ev.get("type") == "kernel_health"]
+    pq = [ev for ev in events if ev.get("type") == "psr_quarantined"]
+    actions = [ev.get("action") for ev in kh]
+    check_rc = 1
+    if ev_path:
+        check_rc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "report.py"),
+             ev_path, "--check"], capture_output=True).returncode
+    health_leg = {
+        "exits": health_exits,
+        "sick_exit_quarantined": health_exits[1] == EXIT_QUARANTINED,
+        "survivors_bit_equal": bool(h_surv_eq),
+        "kernel_health_events": len(kh),
+        "ladder_actions": actions,
+        "psr_quarantined_events": len(pq),
+        "stream_check_exit": check_rc,
+    }
+    print(f"[chaos:integrity] health leg: exits={health_exits} "
+          f"ladder={actions} psr_quarantined={len(pq)} "
+          f"check={'clean' if check_rc == 0 else 'DIRTY'}", flush=True)
+
+    # ---- leg 4: health-plane zero-overhead A/B -------------------- #
+    ab = health_ab(workdir, opts.seed)
+    print(f"[chaos:integrity] health A/B: +dispatch="
+          f"{ab['added_dispatches']} +sync={ab['added_host_syncs']} "
+          f"bit_equal={ab['chains_bit_equal']}", flush=True)
+
+    # ---- verdict -------------------------------------------------- #
+    casualties = (0 if (data_surv_eq and h_surv_eq and arr_eq)
+                  else 1)
+    balanced = (len(qnames) + 2 == len(PSR_NAMES)
+                and data_exits[0] == 0 and data_exits[2] == 0
+                and health_exits[0] == 0 and health_exits[2] == 0)
+    ok = (data_leg["sick_exit_quarantined"]
+          and data_leg["survivors_bit_equal"]
+          and arr_leg["survivors_bit_equal"]
+          and arr_leg["quarantine_artifact"]
+          and qnames == [sick]
+          and rc_aref == 0 and rc_astorm == 0
+          and health_leg["sick_exit_quarantined"]
+          and health_leg["survivors_bit_equal"]
+          and health_leg["psr_quarantined_events"] >= 1
+          and "quarantine" in actions
+          and check_rc == 0
+          and ab["health_armed"]
+          and ab["added_dispatches"] == 0
+          and ab["added_host_syncs"] == 0
+          and ab["chains_bit_equal"])
+    record = {
+        "seed": opts.seed,
+        "npsr": len(PSR_NAMES),
+        "sick_pulsar": sick,
+        "quarantined": qnames,
+        "data_leg": data_leg,
+        "array_leg": arr_leg,
+        "health_leg": health_leg,
+        "health_ab": ab,
+        "survivor_casualties": casualties,
+        "accounting_balanced": bool(balanced),
+        "pass": bool(ok),
+    }
+    print(f"[chaos:integrity] {'PASS' if ok else 'FAIL'}", flush=True)
+    return record
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -468,6 +801,10 @@ def main(argv=None):
     ap.add_argument("--serve", action="store_true",
                     help="run the serving-plane storm instead of the "
                          "PT campaign storm (CHAOS.json 'serve' key)")
+    ap.add_argument("--integrity", action="store_true",
+                    help="run the numerical-integrity storm (corrupt "
+                         "tim, planted near-singular pathology, health "
+                         "A/B) — CHAOS.json 'integrity' key")
     ap.add_argument("--output", default=os.path.join(REPO,
                                                      "CHAOS.json"))
     opts = ap.parse_args(argv)
@@ -479,6 +816,14 @@ def main(argv=None):
         record = serve_storm(opts, workdir)
         merge_record(opts.output, record, key="serve")
         print(f"[chaos:serve] -> {opts.output}", flush=True)
+        if not opts.keep and opts.workdir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return 0 if record.get("pass") else 1
+
+    if opts.integrity:
+        record = integrity_storm(opts, workdir)
+        merge_record(opts.output, record, key="integrity")
+        print(f"[chaos:integrity] -> {opts.output}", flush=True)
         if not opts.keep and opts.workdir is None:
             shutil.rmtree(workdir, ignore_errors=True)
         return 0 if record.get("pass") else 1

@@ -71,6 +71,10 @@ KNOWN_EVENT_TYPES = frozenset({
     # checkpoint integrity generations (io/writers.py,
     # docs/resilience.md): a digest-verification failure at restore
     "ckpt_corrupt",
+    # numerical-integrity plane (resilience/integrity.py,
+    # docs/resilience.md): ingestion-audit findings, kernel health
+    # escalations, and a pulsar leaving the array alone
+    "data_quality", "kernel_health", "psr_quarantined",
 })
 
 #: the heartbeat field vocabulary — every field any sampler/driver
@@ -102,6 +106,10 @@ KNOWN_HEARTBEAT_FIELDS = frozenset({
     "requests_rejected", "requests_expired", "requests_quarantined",
     # VI / CEM drivers
     "elbo", "best_lnpost", "is_ess",
+    # kernel-health plane (numerical-integrity): run-cumulative
+    # jitter-fallback engagements, refinement divergences, and the
+    # worst condition proxy seen so far
+    "jitter_engaged", "refine_diverged", "kernel_cond",
 })
 
 
@@ -459,6 +467,7 @@ def build_report(events, dropped=0):
         "spans": (span_stats or None),
         "spans_open_at_end": (len(open_ids) if spans else None),
         "memory": memory,
+        "integrity": _fold_integrity(by_type),
         "anomalies": [{"t_s": (round(a["t"] - t0, 2)
                                if t0 is not None else None),
                        "reason": a.get("reason"),
@@ -469,6 +478,35 @@ def build_report(events, dropped=0):
     report["run"].pop("t", None)
     report["run"].pop("type", None)
     return report
+
+
+def _fold_integrity(by_type):
+    """Numerical-integrity fold: ingestion-audit findings, kernel
+    health escalations, and quarantined pulsars. None when the stream
+    carries no integrity events."""
+    dq = by_type.get("data_quality", [])
+    kh = by_type.get("kernel_health", [])
+    pq = by_type.get("psr_quarantined", [])
+    if not (dq or kh or pq):
+        return None
+    by_code: dict = {}
+    for ev in dq:
+        c = str(ev.get("code", "?"))
+        by_code[c] = by_code.get(c, 0) + int(ev.get("count", 1))
+    actions: dict = {}
+    for ev in kh:
+        a = str(ev.get("action", "?"))
+        actions[a] = actions.get(a, 0) + 1
+    return {
+        "data_quality_findings": by_code or None,
+        "repaired": sum(1 for ev in dq if ev.get("repaired")),
+        "kernel_health_events": len(kh),
+        "kernel_health_actions": actions or None,
+        "quarantined_pulsars": sorted(
+            {str(ev.get("psr")) for ev in pq}),
+        "quarantine_causes": {str(ev.get("psr")): str(ev.get("cause"))
+                              for ev in pq} or None,
+    }
 
 
 def _fold_serve(by_type):
@@ -639,6 +677,26 @@ def _human_summary(report, out=sys.stdout):
                      f"{ds['dispatch_reduction']}x vs sequential, "
                      f"fill {ds['mean_batch_fill']}")
         p(line)
+    integ = report.get("integrity")
+    if integ:
+        bits = []
+        if integ.get("data_quality_findings"):
+            bits.append("data quality: " + ", ".join(
+                f"{c} x{n}" for c, n in sorted(
+                    integ["data_quality_findings"].items()))
+                + (f" ({integ['repaired']} repaired)"
+                   if integ.get("repaired") else ""))
+        if integ.get("kernel_health_events"):
+            acts = integ.get("kernel_health_actions") or {}
+            bits.append(f"kernel health x"
+                        f"{integ['kernel_health_events']} ["
+                        + ",".join(f"{a}x{n}" for a, n in
+                                   sorted(acts.items())) + "]")
+        if integ.get("quarantined_pulsars"):
+            bits.append("QUARANTINED: "
+                        + ", ".join(integ["quarantined_pulsars"]))
+        if bits:
+            p("integrity: " + "; ".join(bits))
     ir = report.get("insertion_rank")
     if ir:
         p(f"insertion rank: last KS {ir['last_ks']} "

@@ -461,6 +461,71 @@ def gate_serve(bench_dir, min_warm_speedup=10.0, min_dispatch_red=8.0,
         dispatch_reduction=red, p50_ms=p50)
 
 
+def gate_integrity(bench_dir):
+    """Numerical-integrity gates from CHAOS.json's ``integrity``
+    section (written by ``tools/chaos.py --integrity`` —
+    docs/resilience.md):
+
+    - **storm PASS** — the corrupt-data leg (one pulsar's .tim
+      corrupted, quarantined at ingestion, survivors' chains bit-equal
+      to the clean reference) and the near-singular leg (planted
+      ``kernel.health`` pathology escalating the ladder to a typed
+      per-pulsar quarantine) must both hold;
+    - **zero survivor casualties** — quarantine fails the sick pulsar
+      ALONE: every surviving pulsar's chain is bit-equal to the clean
+      reference;
+    - **balanced accounting** — quarantined + surviving = total
+      pulsars in every leg (no pulsar silently vanishes);
+    - **health A/B pin** — arming the health plane adds ZERO
+      dispatches and ZERO host syncs, and the chains are bit-equal to
+      the ``EWT_TELEMETRY=0`` baseline.
+
+    A committed CHAOS.json WITHOUT an integrity section only warns
+    (the storm may not have shipped yet); with one, every sub-verdict
+    is gated.
+    """
+    chaos = _load_json(os.path.join(bench_dir, "CHAOS.json"))
+    if not chaos:
+        return _gate("integrity", "warn",
+                     "no CHAOS.json (integrity storm unproven)")
+    iv = chaos.get("integrity")
+    if not isinstance(iv, dict):
+        return _gate("integrity", "warn",
+                     "CHAOS.json lacks the integrity section — run "
+                     "tools/chaos.py --integrity")
+    problems = []
+    if iv.get("pass") is not True:
+        problems.append("integrity storm verdict is FAIL "
+                        "(CHAOS.json integrity.pass)")
+    if iv.get("survivor_casualties") != 0:
+        problems.append(
+            f"{iv.get('survivor_casualties')} survivor casualt(ies) — "
+            "quarantine must fail the sick pulsar ALONE")
+    if iv.get("accounting_balanced") is not True:
+        problems.append("quarantine accounting does not balance "
+                        "(quarantined + survivors != total)")
+    ab = iv.get("health_ab") or {}
+    if ab.get("added_dispatches") != 0 or ab.get("added_host_syncs") \
+            != 0:
+        problems.append(
+            f"health plane added dispatches/syncs "
+            f"({ab.get('added_dispatches')}/"
+            f"{ab.get('added_host_syncs')}) — the in-scan contract "
+            "broke")
+    if ab.get("chains_bit_equal") is not True:
+        problems.append("health-armed chains not bit-equal to the "
+                        "telemetry-off baseline")
+    if problems:
+        return _gate("integrity", "fail", "; ".join(problems))
+    legs = [k for k in ("data_leg", "health_leg") if iv.get(k)]
+    return _gate(
+        "integrity", "pass",
+        f"storm PASS ({'+'.join(legs)}): 0 survivor casualties, "
+        f"{len(iv.get('quarantined', []))} quarantined, accounting "
+        "balanced; health A/B: 0 added dispatches/syncs, chains "
+        "bit-equal")
+
+
 def gate_staleness(series, stale_days, now=None):
     """The "device leg went stale unnoticed" alarm: the newest
     headline must be a device measurement young enough to trust."""
@@ -642,6 +707,7 @@ def main(argv=None):
                    min_warm_speedup=opts.min_serve_warm_speedup,
                    min_dispatch_red=opts.min_serve_dispatch_red,
                    max_warm_p50_ms=opts.max_serve_warm_p50_ms),
+        gate_integrity(opts.bench_dir),
         gate_staleness(series, opts.stale_days),
     ]
     if opts.run is not None:
