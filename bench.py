@@ -1471,6 +1471,247 @@ def config_benches():
     print(json.dumps(flush()))
 
 
+# ------------------------------------------------------------------ #
+#  pulsar-axis scaling bench (BENCH_SCALE.json, ``--scale``)          #
+# ------------------------------------------------------------------ #
+
+_SCALE_WIDTHS = (1, 2, 4, 8)
+_SCALE_NMODES = 2
+# scaling-curve problem size: per-pulsar stage-1/2 work (Gram over the
+# TOA axis, per-pulsar factorizations) must DOMINATE the replicated
+# stage-3 Schur solve, as it does in a production PTA (ntoa ~ 1e4,
+# dozens of red-noise modes) — a toy ntoa would measure the npsr^3
+# replicated tail instead of the axis the mesh actually shards
+_SCALE_NTOA = 1024
+_SCALE_RED_NFREQS = 50
+_SCALE_STRONG_NPSR = 64
+_SCALE_WEAK_PER_SHARD = 8
+# the ESS legs run a full HMC chain per leg — they keep the small
+# mixing-bench problem size (the scaling signal lives in the curves
+# above; the legs exist to show gradient samplers RIDE the evaluator)
+_SCALE_ESS_NPSR = 8
+_SCALE_ESS_NTOA = 24
+
+
+def _scale_termlists(psrs, red_nfreqs=None):
+    from enterprise_warp_tpu.models import StandardModels, TermList
+    nred = _SCALE_RED_NFREQS if red_nfreqs is None else red_nfreqs
+    tls = []
+    for p in psrs:
+        m = StandardModels(psr=p)
+        tls.append(TermList(p, [
+            m.efac("by_backend"),
+            m.spin_noise(f"powerlaw_{nred}_nfreqs"),
+            m.gwb(f"hd_vary_gamma_{_SCALE_NMODES}_nfreqs")]))
+    return tls
+
+
+def _scale_theta(like):
+    th = np.empty(like.ndim)
+    for i, n in enumerate(like.param_names):
+        if n.endswith("efac"):
+            th[i] = 1.05
+        elif "log10_A" in n:
+            th[i] = -13.5
+        elif "gamma" in n:
+            th[i] = 4.0
+        else:
+            th[i] = 0.5
+    return th
+
+
+def _collective_census(hlo_text):
+    import re as _re
+    return {k: len(_re.findall(p, hlo_text)) for k, p in (
+        ("all_reduce", r"\ball-reduce(?:-start)?\("),
+        ("all_gather", r"\ball-gather(?:-start)?\("),
+        ("all_to_all", r"\ball-to-all\("),
+        ("collective_permute", r"\bcollective-permute(?:-start)?\("))}
+
+
+def scale_worker():
+    """Measurement half of ``--scale`` — MUST run in a process whose
+    ``XLA_FLAGS`` requested the emulated host devices (``scale_bench``
+    spawns it that way). For each mesh width it AOT-compiles the joint
+    Schur evaluation, reads the XLA cost model's PER-PARTITION flops
+    (``compiled.cost_analysis()``), counts the collectives in the
+    compiled HLO, and times real evals. On a single physical CPU the
+    emulated shards timeshare one core, so wall-clock carries no
+    parallelism signal — the committed efficiency figures use the
+    cost-model basis (work per partition), which IS the quantity a real
+    mesh turns into wall-clock; wall times ride along for honesty."""
+    force_cpu()
+    import jax
+    import jax.numpy as jnp
+
+    # x64 comes on with the package import below (process-global, set
+    # once in enterprise_warp_tpu/__init__.py — the precision lint rule
+    # forbids toggling it elsewhere)
+    from enterprise_warp_tpu.parallel import (build_pta_likelihood,
+                                              make_mesh)
+    from enterprise_warp_tpu.parallel.distributed import device_stamp
+    from enterprise_warp_tpu.sim.noise import make_fake_pta
+
+    devs = jax.devices()
+    widths = [w for w in _SCALE_WIDTHS if w <= len(devs)]
+
+    def measure(npsr, width):
+        psrs = make_fake_pta(npsr=npsr, ntoa=_SCALE_NTOA, seed=3)
+        rng = np.random.default_rng(3)
+        for p in psrs:
+            p.residuals = p.toaerrs * rng.standard_normal(len(p))
+        mesh = (make_mesh(npsr, devices=devs[:width])
+                if width > 1 else None)
+        like = build_pta_likelihood(psrs, _scale_termlists(psrs),
+                                    mesh=mesh)
+        th = jnp.asarray(_scale_theta(like))
+        compiled = jax.jit(like._eval).lower(th, like.consts).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float((ca or {}).get("flops", 0.0))
+        census = _collective_census(compiled.as_text())
+        r = compiled(th, like.consts)
+        r.block_until_ready()                  # warm
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            r = compiled(th, like.consts)
+        r.block_until_ready()
+        wall = (time.perf_counter() - t0) / reps
+        return dict(npsr=npsr, width=width,
+                    spmd=bool(like._stages["spmd"]),
+                    flops_per_partition=flops,
+                    wall_s_per_eval=round(wall, 5),
+                    lnl=float(r), collectives=census)
+
+    # strong scaling: fixed problem, growing mesh
+    strong = {}
+    for w in widths:
+        strong[str(w)] = measure(_SCALE_STRONG_NPSR, w)
+        print(f"# strong npsr={_SCALE_STRONG_NPSR} width={w}: "
+              f"{strong[str(w)]['flops_per_partition']:.3e} flops/part",
+              file=sys.stderr)
+    base = strong["1"]["flops_per_partition"]
+    strong_eff = {w: round(base / (int(w) * e["flops_per_partition"]), 4)
+                  for w, e in strong.items()
+                  if e["flops_per_partition"] > 0}
+
+    # weak scaling: fixed pulsars PER SHARD, growing mesh + problem
+    weak = {}
+    for w in widths:
+        weak[str(w)] = measure(_SCALE_WEAK_PER_SHARD * w, w)
+        print(f"# weak npsr={_SCALE_WEAK_PER_SHARD * w} width={w}: "
+              f"{weak[str(w)]['flops_per_partition']:.3e} flops/part",
+              file=sys.stderr)
+    wbase = weak["1"]["flops_per_partition"]
+    weak_eff = {w: round(wbase / e["flops_per_partition"], 4)
+                for w, e in weak.items()
+                if e["flops_per_partition"] > 0}
+
+    # sharded-vs-single parity across the strong curve (same problem)
+    lnls = [e["lnl"] for e in strong.values()]
+    parity = max(abs(v - lnls[0]) for v in lnls)
+
+    # ESS/s: the FLOPs exist to feed gradient samplers — run the HMC
+    # leg on the single-host and widest-mesh evaluator
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from mixing_bench import ess_per_step_hmc
+
+    def ess_leg(width):
+        npsr = _SCALE_ESS_NPSR
+        psrs = make_fake_pta(npsr=npsr, ntoa=_SCALE_ESS_NTOA, seed=5)
+        rng = np.random.default_rng(5)
+        for p in psrs:
+            p.residuals = p.toaerrs * rng.standard_normal(len(p))
+        mesh = (make_mesh(npsr, devices=devs[:width])
+                if width > 1 else None)
+        like = build_pta_likelihood(
+            psrs, _scale_termlists(psrs, red_nfreqs=_SCALE_NMODES),
+            mesh=mesh)
+        t0 = time.perf_counter()
+        rep = ess_per_step_hmc(like, 150, nchains=4, seed=0,
+                               n_leapfrog=8)
+        wall = time.perf_counter() - t0
+        rep["wall_s"] = round(wall, 2)
+        if rep.get("ess_min"):
+            rep["ess_per_s"] = round(rep["ess_min"] / wall, 3)
+        rep["width"] = width
+        return rep
+
+    ess = {"npsr": _SCALE_ESS_NPSR, "single": ess_leg(1),
+           f"sharded_{widths[-1]}way": ess_leg(widths[-1])}
+
+    rec = {
+        "metric": "pta_scale_emulated_mesh",
+        "unit": "cost-model efficiency (XLA per-partition flops; "
+                "CPU emulated mesh)",
+        "timing_basis": "xla_cost_model_flops_per_partition",
+        "widths": widths,
+        "strong": {"npsr": _SCALE_STRONG_NPSR, "per_width": strong,
+                   "efficiency": strong_eff},
+        "weak": {"psr_per_shard": _SCALE_WEAK_PER_SHARD,
+                 "per_width": weak, "efficiency": weak_eff},
+        "parity_max_abs_diff": parity,
+        "ess": ess,
+        "stamp": device_stamp(),
+    }
+    print(json.dumps(rec))
+    return rec
+
+
+from enterprise_warp_tpu.parallel.distributed import \
+    primary_only  # noqa: E402
+
+
+@primary_only
+def _write_scale_artifact(path, rec):
+    # single-writer: on a real multi-host mesh every process runs the
+    # bench, only process 0 may touch the committed artifact
+    from enterprise_warp_tpu.io.writers import atomic_write_json
+    atomic_write_json(path, rec)
+
+
+def scale_bench():
+    """Weak/strong pulsar-axis scaling curves (run with ``python
+    bench.py --scale``; writes BENCH_SCALE.json, gated by
+    ``tools/sentinel.py``). The measurements need emulated host
+    devices, and ``XLA_FLAGS`` must be set before jax initializes —
+    too late for this process (sitecustomize imports jax at startup) —
+    so the sweep runs in ONE subprocess with the flag planted and this
+    process stamps + persists its record."""
+    import subprocess
+
+    device_ok = probe_device()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count="
+                        + str(max(_SCALE_WIDTHS))).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--scale-worker"],
+        env=env, capture_output=True, text=True, timeout=3000)
+    sys.stderr.write(proc.stderr[-6000:])
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"scale worker failed (rc={proc.returncode}): "
+            + (proc.stdout + proc.stderr)[-1500:])
+    rec = json.loads(lines[-1])
+    # emulated CPU shards are never device numbers: stamp the record
+    # so the sentinel's like-for-like comparison can refuse to race
+    # it against a real-mesh artifact
+    rec["device_unavailable"] = not device_ok
+    rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    rec["telemetry"] = telemetry_snapshot()
+    _write_scale_artifact(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_SCALE.json"), rec)
+    print(json.dumps(rec))
+
+
 if __name__ == "__main__":
     configs_mode = "--configs" in sys.argv
     micro_mode = "--micro" in sys.argv
@@ -1478,6 +1719,8 @@ if __name__ == "__main__":
     nested_mode = "--nested" in sys.argv
     mixing_mode = "--mixing" in sys.argv
     serve_mode = "--serve" in sys.argv
+    scale_mode = "--scale" in sys.argv
+    scale_worker_mode = "--scale-worker" in sys.argv
     try:
         if configs_mode:
             config_benches()
@@ -1491,6 +1734,10 @@ if __name__ == "__main__":
             mixing_ab()
         elif serve_mode:
             serve_bench()
+        elif scale_worker_mode:
+            scale_worker()
+        elif scale_mode:
+            scale_bench()
         else:
             main()
     except Exception as e:                              # noqa: BLE001
@@ -1521,6 +1768,14 @@ if __name__ == "__main__":
             print(json.dumps({"metric": "mixing_stream_ab",
                               "unit": "|drhat| / ess ratio "
                                       "(CPU backend)",
+                              "error": f"{type(e).__name__}: {e}"}))
+            sys.exit(1)
+        if scale_mode or scale_worker_mode:
+            print(json.dumps({"metric": "pta_scale_emulated_mesh",
+                              "unit": "cost-model efficiency (XLA "
+                                      "per-partition flops; CPU "
+                                      "emulated mesh)",
+                              "strong": None, "weak": None,
                               "error": f"{type(e).__name__}: {e}"}))
             sys.exit(1)
         if serve_mode:

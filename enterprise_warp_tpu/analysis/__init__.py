@@ -29,6 +29,7 @@ from .core import (Finding, LintResult, Rule, all_rules, iter_target_files,
 # importing the rule modules populates the registry
 from . import rules_style as _rules_style          # noqa: F401,E402
 from . import rules_tracer as _rules_tracer        # noqa: F401,E402
+from . import rules_collective as _rules_collective  # noqa: F401,E402
 
 __all__ = ["Finding", "LintResult", "Rule", "all_rules",
            "iter_target_files", "run_lint"]

@@ -1,0 +1,203 @@
+"""Collective-safety rule: axis hygiene for ``psum``/``pmean`` and
+host-sync discipline inside ``shard_map`` bodies.
+
+The SPMD likelihood path (``parallel/pta.py``) holds a one-collective-
+per-evaluation contract: everything cross-shard rides a single named
+``lax.psum``. The two ways that contract rots silently are (a) a
+collective whose axis name is missing or doesn't match any mesh axis
+declared in the module — under ``shard_map`` that is a trace error at
+best and a wrong-mesh reduction at worst — and (b) a host sync
+(``.item()``, ``jax.device_get``) inside a shard-mapped body, which
+stalls EVERY shard of EVERY device at a per-shard barrier. Both are
+invisible to grep because the shard_map wrapping, the axis
+declaration, and the offending call sit in different statements.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, register
+
+#: collectives whose first kwarg/second positional is the axis name
+_COLLECTIVES = ("jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax",
+                "jax.lax.pmin")
+_COLLECTIVE_SUFFIXES = ("psum", "pmean", "pmax", "pmin")
+
+#: device->host syncs that must never run inside a shard_map body
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_SYNC_CALLS = ("jax.device_get", "numpy.asarray", "numpy.array")
+
+
+def _string_consts(node):
+    """Every string literal anywhere under ``node``."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+def _resolve_axis_name(node, parents, module_strs):
+    """Best-effort static value of a collective's axis argument.
+
+    Returns ``(kind, value)`` — ``("str", s)`` for a resolvable string
+    (literal, module-level constant, or a default of an enclosing
+    function's parameter), ``("name", id)`` for a plain variable the
+    analysis cannot pin down (named — accepted), ``("bad", None)`` for
+    anything else (an f-string, a call: dynamic axis names defeat the
+    mismatch check AND the reader)."""
+    if isinstance(node, ast.Constant):
+        return (("str", node.value) if isinstance(node.value, str)
+                else ("bad", None))
+    if isinstance(node, ast.Name):
+        if node.id in module_strs:
+            return ("str", module_strs[node.id])
+        p = parents.get(id(node))
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = p.args
+                pos = a.posonlyargs + a.args
+                defaults = dict(zip([x.arg for x in
+                                     pos[len(pos) - len(a.defaults):]],
+                                    a.defaults))
+                defaults.update({x.arg: d for x, d in
+                                 zip(a.kwonlyargs, a.kw_defaults)
+                                 if d is not None})
+                d = defaults.get(node.id)
+                if isinstance(d, ast.Constant) and \
+                        isinstance(d.value, str):
+                    return ("str", d.value)
+            p = parents.get(id(p))
+        return ("name", node.id)
+    return ("bad", None)
+
+
+@register
+class CollectiveSafetyRule(Rule):
+    name = "collective-safety"
+    severity = "error"
+    summary = "psum/pmean axis hygiene; host syncs inside shard_map"
+    contract = (
+        "Every lax.psum/pmean/pmax/pmin names its mesh axis with a "
+        "statically resolvable name (literal, module constant, or a "
+        "string parameter default), and when the module declares mesh "
+        "axes (Mesh(...)/PartitionSpec literals) the collective's axis "
+        "must be one of them — a mismatched name reduces over the "
+        "wrong mesh axis or fails at trace time. Inside a function "
+        "handed to shard_map, .item()/.tolist()/.block_until_ready()/"
+        "jax.device_get/np.asarray are banned outright: a host sync "
+        "there is a per-shard barrier on every device. The SPMD joint "
+        "likelihood's one-collective contract (parallel/pta.py) "
+        "depends on both halves.")
+
+    def check(self, mod):
+        tree, al, parents = mod.tree, mod.aliases, mod.parents
+
+        # module-level string constants (NAME = "psr")
+        module_strs = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                module_strs[node.targets[0].id] = node.value.value
+
+        # declared mesh-axis vocabulary: string literals inside
+        # Mesh(...) / PartitionSpec(...) / NamedSharding(...) /
+        # shard_map(...) calls, plus resolvable module constants used
+        # there
+        declared = set()
+        fn_defs = {}
+        shard_calls = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_defs.setdefault(node.name, node)
+            if not isinstance(node, ast.Call):
+                continue
+            d = al.dotted(node.func)
+            if d is None:
+                continue
+            base = d.rsplit(".", 1)[-1]
+            if base in ("Mesh", "PartitionSpec", "NamedSharding",
+                        "make_mesh", "make_psr_mesh"):
+                declared |= _string_consts(node)
+                for a in ast.walk(node):
+                    if isinstance(a, ast.Name) and a.id in module_strs:
+                        declared.add(module_strs[a.id])
+            elif base == "shard_map":
+                declared |= _string_consts(node)
+                shard_calls.append(node)
+
+        # bodies handed to shard_map: direct first-arg lambdas/names
+        # and @shard_map / @partial(shard_map, ...) decorations
+        shard_bodies = []
+        for call in shard_calls:
+            if call.args:
+                tgt = call.args[0]
+                if isinstance(tgt, ast.Lambda):
+                    shard_bodies.append(tgt)
+                elif isinstance(tgt, ast.Name) and tgt.id in fn_defs:
+                    shard_bodies.append(fn_defs[tgt.id])
+        for fname, fdef in fn_defs.items():
+            for dec in fdef.decorator_list:
+                roots = [dec] + (list(ast.walk(dec))
+                                 if isinstance(dec, ast.Call) else [])
+                if any(al.dotted(r) is not None
+                       and al.dotted(r).rsplit(".", 1)[-1] == "shard_map"
+                       for r in roots
+                       if isinstance(r, (ast.Name, ast.Attribute))):
+                    shard_bodies.append(fdef)
+
+        def in_shard_body(node):
+            p = parents.get(id(node))
+            while p is not None:
+                if p in shard_bodies:
+                    return True
+                p = parents.get(id(p))
+            return False
+
+        for node in mod.calls:
+            # ---- collective axis hygiene ----------------------------
+            if al.resolves(node.func, *_COLLECTIVES,
+                           suffixes=_COLLECTIVE_SUFFIXES):
+                kws = {k.arg: k.value for k in node.keywords}
+                axis = (node.args[1] if len(node.args) > 1
+                        else kws.get("axis_name"))
+                if axis is None:
+                    yield self.finding(
+                        mod, node,
+                        f"{al.dotted(node.func)}() without an axis "
+                        "name — a collective must name the mesh axis "
+                        "it reduces over")
+                    continue
+                kind, val = _resolve_axis_name(axis, parents,
+                                               module_strs)
+                if kind == "bad":
+                    yield self.finding(
+                        mod, node,
+                        f"{al.dotted(node.func)}() axis name is not "
+                        "statically resolvable — use a literal or a "
+                        "named constant")
+                elif kind == "str" and declared and val not in declared:
+                    yield self.finding(
+                        mod, node,
+                        f"{al.dotted(node.func)}() reduces over "
+                        f"'{val}' but this module declares mesh axes "
+                        f"{sorted(declared)} — mismatched axis names "
+                        "reduce over the wrong mesh axis")
+            # ---- host syncs inside shard_map bodies -----------------
+            elif in_shard_body(node):
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _SYNC_METHODS:
+                    yield self.finding(
+                        mod, node,
+                        f".{node.func.attr}() inside a shard_map body "
+                        "— a host sync here barriers every shard on "
+                        "every device")
+                elif al.resolves(node.func, *_SYNC_CALLS):
+                    yield self.finding(
+                        mod, node,
+                        f"{al.dotted(node.func)}() inside a shard_map "
+                        "body — device->host conversion inside the "
+                        "manual-sharding region stalls all shards")

@@ -131,7 +131,30 @@ def main(argv=None):
     except ParseError as exc:
         print(f"malformed input file: {exc}", file=sys.stderr)
         return EXIT_QUARANTINED
-    likes = init_model_likelihoods(params, gram_mode=opts.gram_mode)
+    # pulsar-axis sharding (sampler_kwargs: ``psr_shard: N`` or
+    # ``psr_shard: 1`` for all devices): the correlated joint build
+    # runs its shard_map SPMD path over an N-device ``psr`` mesh —
+    # stages 1–2 local per shard, one packed psum per evaluation
+    # (parallel/pta.py). Orthogonal to chain_shard (different axis
+    # name); single-pulsar and uncorrelated-product models ignore it.
+    mesh = None
+    ps = params.sampler_kwargs.get("psr_shard") \
+        if hasattr(params, "sampler_kwargs") else None
+    if ps and len(params.psrs) > 1:
+        import jax
+
+        from .parallel import make_mesh
+        ndev = len(jax.devices())
+        want = ndev if int(ps) == 1 else min(int(ps), ndev)
+        if want > 1:
+            mesh = make_mesh(len(params.psrs), devices=jax.devices()[:want])
+            print(f"pulsar-axis sharding: joint likelihood over "
+                  f"{int(mesh.size)} of {ndev} devices")
+    elif ps:
+        print("note: psr_shard needs a multi-pulsar joint model; "
+              "single-pulsar run stays unsharded")
+    likes = init_model_likelihoods(params, gram_mode=opts.gram_mode,
+                                   mesh=mesh)
 
     if params.setupsamp or opts.mpi_regime == 1:
         print("Preparations for the sampling are complete "

@@ -233,6 +233,12 @@ class Params:
                 f"Unknown sampler: {name}\nKnown samplers: "
                 + ", ".join(IMPLEMENTED_SAMPLERS))
         self.sampler_kwargs = dict(IMPLEMENTED_SAMPLERS[name])
+        # device-mesh knobs shared by every sampler branch (cli.py):
+        # ``psr_shard`` shards the joint likelihood's pulsar axis
+        # (docs/scaling.md), ``chain_shard`` the PT walker batch
+        # (docs/performance.md). 0 = off, 1 = all devices, N = first N.
+        self.sampler_kwargs.setdefault("psr_shard", 0)
+        self.sampler_kwargs.setdefault("chain_shard", 0)
         for key, val in self.sampler_kwargs.items():
             self.label_attr_map[key + ":"] = [key, type(val)]
 

@@ -146,8 +146,14 @@ def has_correlated_common(termlists) -> bool:
                for tl in termlists for t in tl)
 
 
-def init_model_likelihoods(params, gram_mode="split", write_pars=True):
-    """``init_pta`` equivalent: ``{model_id: compiled likelihood}``."""
+def init_model_likelihoods(params, gram_mode="split", write_pars=True,
+                           mesh=None):
+    """``init_pta`` equivalent: ``{model_id: compiled likelihood}``.
+
+    ``mesh`` — optional pulsar-axis ``jax.sharding.Mesh`` threaded to
+    the correlated joint build (``parallel/pta.py``'s shard_map SPMD
+    path); single-pulsar and uncorrelated-product models ignore it
+    (they have no pulsar axis to shard)."""
     likes = {}
     for ii, pm in params.models.items():
         tm_opt = getattr(pm, "tm", "default") or "default"
@@ -182,7 +188,7 @@ def init_model_likelihoods(params, gram_mode="split", write_pars=True):
             from ..parallel import build_pta_likelihood
             like = build_pta_likelihood(params.psrs, termlists,
                                         fixed_values=fixed,
-                                        gram_mode=gram_mode)
+                                        gram_mode=gram_mode, mesh=mesh)
         else:
             like = MultiPulsarLikelihood([
                 build_pulsar_likelihood(p, tl, fixed_values=fixed,

@@ -63,6 +63,10 @@ _CHUNK = 256  # TOA-axis chunk length for f64 accumulation of f32 partials
 # log10 kappa before equilibration), costing one reduction over a
 # diagonal already in registers.
 HW_JITTER, HW_DIVERGE, HW_LOGCOND = 0, 1, 2
+# lane count of one health word — layout arithmetic that slices
+# per-pulsar words out of packed buffers (the joint kernel's
+# single-psum payload, parallel/pta.py) must use this, not a magic 3
+HW_WIDTH = 3
 
 
 def _health_word(jitter_bit, diverge_bit, d):

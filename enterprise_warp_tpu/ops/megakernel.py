@@ -56,6 +56,17 @@ telemetry counters and in :func:`mega_status` for bench provenance.
 path (exact, pre-fusion cost), so ``vmap(grad(...))`` — the HMC/ADVI
 pattern — never reaches the kernel.
 
+SPMD exclusion: the joint likelihood's explicit pulsar-axis
+``shard_map`` path (``parallel/pta.py``, ``mesh=`` builds) pins
+``mega=False`` before entering the manual-sharding region. The probe
+ladder above validates the outer-vmap composition on a single device
+— not a ``shard_map`` body — and the ``custom_vjp`` has no transpose
+rule through the region's ``psum``, so inside a shard the classic XLA
+chain is the route that both partitions cleanly and differentiates
+exactly. Per-shard Pallas dispatch under manual sharding is future
+work (docs/scaling.md); nothing silently degrades — the SPMD path
+simply never consults this module.
+
 Escape hatches: ``EWT_PALLAS=0`` disables every Pallas kernel
 (megakernel AND ``ops.cholfuse``) and restores the current XLA path
 bit-for-bit; ``EWT_PALLAS_MEGA=0`` disables only the megakernel (the

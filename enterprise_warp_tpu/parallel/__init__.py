@@ -6,6 +6,9 @@ enterprise_warp.py:46-55``): pulsars are sharded over a
 ``jax.sharding.Mesh`` axis and coupled through XLA collectives.
 """
 
+from .distributed import (device_stamp, emulated_host_count,  # noqa: F401
+                          init_distributed, is_primary, make_mesh,
+                          primary_only)
 from .orf import (dipole_matrix, hd_matrix, monopole_matrix,  # noqa: F401
                   orf_matrix)
 from .pta import PTALikelihood, build_pta_likelihood  # noqa: F401

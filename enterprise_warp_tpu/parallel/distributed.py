@@ -23,6 +23,19 @@ then all ranks sample under MPI/PolyChord). Here the replacement is:
   ``state.npz``, ``*_nfreqs.txt``, result JSONs). Writers call
   :func:`is_primary` — in single-process runs it is always True.
 
+- **SPMD pulsar-axis layer**: :func:`make_mesh` sizes a 1-D device mesh
+  to the pulsar count, and the joint likelihood's shard_map path
+  (``parallel/pta.py``) runs stages 1–2 purely locally per shard and
+  folds every cross-pulsar quantity — the GW Schur blocks, the scalar
+  reductions, AND the per-pulsar kernel health words — into ONE packed
+  ``psum`` per evaluation (:func:`scatter_to_global` builds the
+  psum-ready global buffers). Everything is CI-testable on CPU through
+  emulated hosts: ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+  splits one process into N host-platform devices
+  (:func:`emulated_host_count` reads the request back for bench
+  stamping, so CPU-emulated scaling numbers can never be mistaken for
+  device numbers).
+
 Environment contract (set by the launcher, one process per host):
 
     EWT_COORDINATOR   = "host0:port"   coordinator address
@@ -35,9 +48,13 @@ explicit keyword arguments override the environment.
 
 from __future__ import annotations
 
+import functools
 import os
+import re
 
 _INITIALIZED = False
+
+_EMULATED_FLAG = "xla_force_host_platform_device_count"
 
 
 def init_distributed(coordinator=None, num_processes=None,
@@ -64,12 +81,20 @@ def init_distributed(coordinator=None, num_processes=None,
 
 
 def process_index() -> int:
+    # single-process runs (no process group joined, no launcher env)
+    # resolve WITHOUT importing jax: the primary_only single-writer
+    # guard must stay usable from the jax-free standalone CLIs
+    # (tools/report.py loads this module by file path for exactly that)
+    if not _INITIALIZED and "EWT_PROCESS_ID" not in os.environ:
+        return 0
     import jax
 
     return int(jax.process_index())
 
 
 def process_count() -> int:
+    if not _INITIALIZED and "EWT_NUM_PROCESSES" not in os.environ:
+        return 1
     import jax
 
     return int(jax.process_count())
@@ -78,3 +103,83 @@ def process_count() -> int:
 def is_primary() -> bool:
     """True on the single process allowed to write run outputs."""
     return process_index() == 0
+
+
+def primary_only(fn):
+    """Decorator enforcing the single-writer convention on an
+    artifact-write function: on non-primary processes the call is a
+    no-op returning ``None``, so a multi-process run can never tear a
+    BENCH/TRENDS JSON or chain file by racing writers. Single-process
+    runs are unaffected (``is_primary()`` is always True there)."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if not is_primary():
+            return None
+        return fn(*args, **kwargs)
+    return wrapped
+
+
+def emulated_host_count() -> int:
+    """Emulated host-platform device count requested via ``XLA_FLAGS``
+    (``--xla_force_host_platform_device_count=N``), or 0 when the
+    process runs on real devices. Bench artifacts stamp this next to
+    ``device_unavailable`` so CPU-emulated scaling numbers are
+    compared like-for-like only (tools/sentinel.py)."""
+    m = re.search(_EMULATED_FLAG + r"=(\d+)",
+                  os.environ.get("XLA_FLAGS", ""))
+    return int(m.group(1)) if m else 0
+
+
+def device_stamp(mesh=None) -> dict:
+    """Provenance stamp for bench artifacts produced on (possibly
+    emulated) meshes: backend platform, mesh width, and the emulated
+    host count — the metadata the sentinel's like-for-like comparison
+    keys on."""
+    import jax
+
+    stamp = dict(platform=jax.devices()[0].platform,
+                 emulated_hosts=emulated_host_count(),
+                 process_count=process_count())
+    if mesh is not None:
+        stamp["mesh_devices"] = int(mesh.size)
+        stamp["mesh_axes"] = dict(zip(mesh.axis_names,
+                                      (int(s) for s in
+                                       mesh.devices.shape)))
+    return stamp
+
+
+# ewt: allow-host-sync — np.array over the DEVICE LIST to build the
+# mesh; jax.devices() returns host objects, not arrays
+def make_mesh(npsr, axis="psr", devices=None):
+    """A 1-D pulsar-axis mesh sized to the problem.
+
+    Takes the first ``min(len(devices), npsr)`` devices — a mesh wider
+    than the pulsar count would only hold all-padding shards. The
+    joint likelihood pads ``npsr`` up to a multiple of the axis size,
+    so any width <= npsr is valid (shards need not divide evenly).
+    After :func:`init_distributed` the device list is GLOBAL, so the
+    mesh spans hosts and the stage-3 ``psum`` rides ICI/DCN."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = list(jax.devices() if devices is None else devices)
+    n = max(1, min(len(devs), int(npsr)))
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def scatter_to_global(local, global_rows, axis):
+    """Inside ``shard_map``: place this shard's leading-axis rows into
+    a zero global-length buffer at the shard's own offset. Summing the
+    results across shards (one ``psum``) reconstructs the full array —
+    the collective-free half of the joint kernel's single-collective
+    contract: N of these buffers concatenate into one flat vector and
+    ride ONE ``lax.psum`` per evaluation."""
+    import jax
+    import jax.numpy as jnp
+
+    i = jax.lax.axis_index(axis)
+    buf = jnp.zeros((global_rows,) + local.shape[1:], local.dtype)
+    zero = jnp.zeros((), dtype=i.dtype)   # match axis_index's int32
+    start = (i * local.shape[0],) + (zero,) * (local.ndim - 1)
+    return jax.lax.dynamic_update_slice(buf, local, start)

@@ -6,6 +6,14 @@ enterprise_models.py:390-415``) and of its custom zero-auto-term variant
 ``hd_orf_noauto`` (``enterprise_models.py:565-572``). Here the ORF is a
 static (Npsr, Npsr) matrix computed once from pulsar sky positions; the
 joint likelihood couples pulsars through it per GW frequency.
+
+Sharding contract (``parallel/pta.py`` SPMD path): the ORF is build-time
+host numpy and stays REPLICATED — it parameterizes the stage-3 coupling
+solve that runs identically on every shard from the psum-ed Schur
+blocks, so no row of it is ever partitioned along the pulsar mesh axis
+and the cross-correlation structure costs zero collectives beyond the
+evaluation's single ``psum``. Anything added here must keep that
+property: no per-shard geometry, no device-resident state.
 """
 
 from __future__ import annotations

@@ -43,9 +43,18 @@ and eliminates them by nested Schur complements:
 
 The big O(npsr * ntoa * nbasis^2) Gram contractions are batched over the
 pulsar axis and — under a ``jax.sharding.Mesh`` — sharded along it, so each
-device Grams its own pulsars and XLA inserts the collectives for the small
-Schur assembly. This replaces the reference's MPI/PolyChord multi-node path
-(``enterprise_warp.py:46-55``) with ICI collectives.
+device Grams its own pulsars. On the nested-Schur path the sharding is
+EXPLICIT (``shard_map`` over the pulsar axis): stages 1–2 run purely
+locally per shard, and every cross-pulsar quantity of the evaluation —
+the per-pulsar GW Schur blocks ``Ss``/``Xs`` (scattered into zero
+global buffers at each shard's offset), the scalar reductions
+(``q1``/``ln|G_nn|``/``ln|A_tm|``/``r^T N^-1 r``/``ln|N|``/``ln|Phi|``),
+and the per-pulsar kernel health words — is packed into ONE flat vector
+that rides a single ``lax.psum``. Stage 3 (the ORF-coupled
+``(npsr*n_g)^2`` Schur solve) then runs replicated from the summed
+buffers: exactly one collective per evaluation, no gathers of
+per-pulsar blocks. This replaces the reference's MPI/PolyChord
+multi-node path (``enterprise_warp.py:46-55``) with ICI collectives.
 
 Parameter evaluation (white-noise selections, PSD priors) is compiled at
 build time into flat gather/scatter programs, so the traced likelihood is
@@ -66,7 +75,7 @@ import numpy as np
 from ..models.build import (_resolve_params, collect_params, eval_block_phi,
                             lower_terms, param_value)
 from ..models.prior_mixin import PriorMixin
-from ..ops.kernel import (CHOL_JITTER, _HIGH, _gram_pair,
+from ..ops.kernel import (CHOL_JITTER, _HIGH, HW_WIDTH, _gram_pair,
                           _mixed_psd_solve_logdet, equilibrated_cholesky,
                           whiten_inputs)
 from ..ops.spectra import (broken_powerlaw_psd, free_spectrum_psd,
@@ -626,12 +635,14 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
     mask_j = jnp.asarray(toamask)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
-        R_j = jax.device_put(
-            R_j, NamedSharding(mesh, PartitionSpec(psr_axis, None)))
-        mask_j = jax.device_put(
-            mask_j, NamedSharding(mesh, PartitionSpec(psr_axis, None)))
+        psr_sh = NamedSharding(mesh, PartitionSpec(psr_axis, None))
+        R_j = jax.device_put(R_j, psr_sh)
+        mask_j = jax.device_put(mask_j, psr_sh)
         T_j = jax.device_put(
             T_j, NamedSharding(mesh, PartitionSpec(psr_axis, None, None)))
+        sigma2_j = jax.device_put(sigma2_j, psr_sh)
+        cs2_N_j = jax.device_put(cs2_N_j, psr_sh)
+        tm_pad_j = jax.device_put(tm_pad_j, psr_sh)
 
     jitter = CHOL_JITTER[gram_mode]
     ia = jnp.arange(npsr)
@@ -654,8 +665,28 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
 
     # device arrays that may be mesh-sharded (possibly across
     # processes): flow into the jitted functions as ARGUMENTS via the
-    # sampler evaluation protocol (samplers/evalproto.py)
-    _sh = dict(R=R_j, T=T_j, mask=mask_j)
+    # sampler evaluation protocol (samplers/evalproto.py). The
+    # pulsar-stacked whitening constants ride along — on a
+    # process-spanning mesh a closure constant would be an invalid jit
+    _sh = dict(R=R_j, T=T_j, mask=mask_j, sigma2=sigma2_j,
+               cs2N=cs2_N_j, tm_pad=tm_pad_j)
+
+    # ---- explicit SPMD routing decision --------------------------------
+    # Under a pulsar-axis mesh the nested-Schur path goes through
+    # shard_map (loglike_spmd below): stages 1-2 manually local per
+    # shard, ONE packed psum, stage 3 replicated. A sampled chromatic
+    # index makes T walker-dependent through a per-pulsar scatter whose
+    # global indices don't exist inside a shard — that rare combination
+    # stays on the GSPMD auto-sharded path (XLA chooses collectives).
+    use_spmd = (mesh is not None and joint_mode == "schur"
+                and not dyn_blocks)
+    if use_spmd:
+        # classic XLA chain inside the manual-sharding region: the
+        # Pallas megakernel probe validates the outer-vmap composition,
+        # not shard_map bodies, and its custom_vjp has no transpose
+        # rule through the collective — the classic chain differentiates
+        # exactly (the HMC gradients flow through the psum)
+        mega = False
 
     # ewt: allow-precision — stage-1 Gram leaves the split-precision
     # accumulation in f64: the Sigma assembly downstream subtracts
@@ -666,8 +697,8 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         invphi_N) with ``rwr_p`` the PER-PULSAR whitened-residual norms
         (the evaluation-structure cache updates them blockwise; the full
         paths sum them)."""
-        nw = eval_white(theta, sigma2_j)                 # (npsr, ntoa_max)
-        phi_N = eval_phi(theta) * cs2_N_j                # (npsr, NW)
+        nw = eval_white(theta, sh["sigma2"])             # (npsr, ntoa_max)
+        phi_N = eval_phi(theta) * sh["cs2N"]             # (npsr, NW)
         invphi_N = 1.0 / phi_N
         logphi = jnp.sum(jnp.log(phi_N))                 # pads: log 1 = 0
 
@@ -822,7 +853,7 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         per-pulsar stage-1/2 result stage 3 consumes, so a proposal that
         touched one block re-derives only that block."""
         G, X, rwr_p, logdet_n, logphi, invphi_N = _common(theta, sh)
-        st = jax.vmap(_stage12_single)(G, X, invphi_N, tm_pad_j)
+        st = jax.vmap(_stage12_single)(G, X, invphi_N, sh["tm_pad"])
         cache = dict(st, rwr=rwr_p, ldn=logdet_n, lphi=logphi)
         return _stage3(theta, cache), cache
 
@@ -835,8 +866,8 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         O(ntoa * nb^2 + nb^3) instead of npsr times that — then reruns
         stage 3 (the ORF coupling ties every pulsar to the GW columns,
         so the joint Schur solve is always redone)."""
-        nw = eval_white(theta, sigma2_j)
-        phi_N = eval_phi(theta) * cs2_N_j
+        nw = eval_white(theta, sh["sigma2"])
+        phi_N = eval_phi(theta) * sh["cs2N"]
         a = psr_idx
         w_a = sh["mask"][a] / nw[a]
         sqw = jnp.sqrt(w_a)
@@ -844,7 +875,8 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         rs = sh["R"][a] * sqw
         G_a = _gram_pair(Ts, Ts, gram_mode).astype(jnp.float64)
         X_a = jnp.einsum("ik,i->k", Ts, rs, precision=_HIGH)
-        st_a = _stage12_single(G_a, X_a, 1.0 / phi_N[a], tm_pad_j[a])
+        st_a = _stage12_single(G_a, X_a, 1.0 / phi_N[a],
+                               sh["tm_pad"][a])
         cache = dict(cache)
         for k, v in st_a.items():
             cache[k] = cache[k].at[a].set(v)
@@ -876,7 +908,7 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         # full diagonal prior inverse in the permuted layout: region M gets
         # the big-phi stand-in (1 on padded slots), region G none (its
         # prior lives in the coupling blocks)
-        invphi_M = (1.0 - tm_pad_j) / _TM_PHI + tm_pad_j
+        invphi_M = (1.0 - sh["tm_pad"]) / _TM_PHI + sh["tm_pad"]
         invphi = jnp.concatenate(
             [invphi_N, invphi_M, jnp.zeros((npsr, n_g))], axis=1)
         logphi = logphi + tm_const
@@ -905,18 +937,149 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         the instrumented stage-1 solves)."""
         G, X, rwr_p, logdet_n, logphi, invphi_N = _common(theta, sh)
         st = jax.vmap(lambda g, x, ip, tp: _stage12_single(
-            g, x, ip, tp, with_health=True))(G, X, invphi_N, tm_pad_j)
+            g, x, ip, tp, with_health=True))(G, X, invphi_N,
+                                             sh["tm_pad"])
         hw = st.pop("hw")
         cache = dict(st, rwr=rwr_p, ldn=logdet_n, lphi=logphi)
         return _stage3(theta, cache), hw
 
-    inner = loglike_schur if joint_mode == "schur" else loglike_dense
+    # ---- explicit SPMD path: shard_map over the pulsar axis -----------
+    # Stages 1-2 run purely locally per shard; EVERY cross-pulsar
+    # quantity — the GW Schur blocks Ss/Xs (scattered into zero global
+    # buffers at each shard's offset), the six scalar reductions, and
+    # (health variant) the per-pulsar health words — is packed into one
+    # flat vector and summed by a single lax.psum. Stage 3 then runs
+    # replicated from the summed buffers: exactly one collective per
+    # evaluation, no gathers of per-pulsar blocks. The parameter
+    # programs (eval_white/eval_phi) stay OUTSIDE the shard_map: they
+    # are gathers from the replicated theta, so partitioning their
+    # (npsr, ...) outputs along the mesh is a local slice.
+    if use_spmd:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as _P
+
+        from .distributed import scatter_to_global
+
+        nshard = mesh.shape[psr_axis]
+        npsr_loc = npsr // nshard
+        n_ss, n_xs = npsr * n_g * n_g, npsr * n_g
+
+        def _make_spmd(with_health):
+            def shard_fn(nw_l, phi_l, R_l, T_l, mask_l, tmpad_l):
+                # per-shard stages 1-2: identical math to _common +
+                # the _stage12_single vmap, on this shard's pulsars
+                w = mask_l / nw_l
+                sqw = jnp.sqrt(w)
+                Ts = T_l * sqw[:, :, None]
+                rs = R_l * sqw
+                # ewt: allow-precision — stage-1 Gram leaves the
+                # split-precision kernel as the f64 island stages 2-3
+                # factor exactly, same contract as the unsharded path
+                G = _gram_batched(Ts, Ts, gram_mode).astype(jnp.float64)
+                X = jnp.einsum("pik,pi->pk", Ts, rs, precision=_HIGH)
+                st = jax.vmap(lambda g, x, ip, tp: _stage12_single(
+                    g, x, ip, tp, with_health=with_health))(
+                        G, X, 1.0 / phi_l, tmpad_l)
+                scalars = jnp.stack([
+                    jnp.sum(st["q1"]), jnp.sum(st["ld_nn"]),
+                    jnp.sum(st["ld_tm"]), jnp.sum(rs * rs),
+                    jnp.sum(jnp.log(nw_l) * mask_l),
+                    jnp.sum(jnp.log(phi_l))])
+                parts = []
+                if n_g:
+                    parts.append(scatter_to_global(
+                        st["Ss"].reshape(npsr_loc, n_g * n_g), npsr,
+                        psr_axis).ravel())
+                    parts.append(scatter_to_global(
+                        st["Xs"], npsr, psr_axis).ravel())
+                if with_health:
+                    # ewt: allow-precision — health words are tiny
+                    # integer-valued flags widened to ride the packed
+                    # f64 psum (3 lanes/psr; exact under summation)
+                    parts.append(scatter_to_global(
+                        st["hw"].astype(jnp.float64), npsr,
+                        psr_axis).ravel())
+                parts.append(scalars)
+                # THE collective: the evaluation's only cross-shard op
+                return jax.lax.psum(jnp.concatenate(parts), psr_axis)
+
+            spec = _P(psr_axis, None)
+            # check_rep off: the replication checker has no rule for
+            # every op in the mixed-precision stage-1 chain, and the
+            # plain transpose is what lets value_and_grad flow through
+            return shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=(spec, spec, spec, _P(psr_axis, None, None),
+                          spec, spec),
+                out_specs=_P(), check_rep=False)
+
+        _spmd_fwd = _make_spmd(False)
+        _spmd_fwd_h = _make_spmd(True)
+
+        def _unpack_spmd(packed, with_health):
+            off = 0
+            cache = {}
+            if n_g:
+                cache["Ss"] = packed[:n_ss].reshape(npsr, n_g, n_g)
+                cache["Xs"] = packed[n_ss:n_ss + n_xs].reshape(npsr,
+                                                              n_g)
+                off = n_ss + n_xs
+            hw = None
+            if with_health:
+                hw = packed[off:off + npsr * HW_WIDTH].reshape(
+                    npsr, HW_WIDTH)
+                off += npsr * HW_WIDTH
+            sc = packed[off:off + 6]
+            # the scalar slots arrive pre-summed; _stage3's jnp.sum
+            # over them is the identity
+            cache.update(q1=sc[0], ld_nn=sc[1], ld_tm=sc[2], rwr=sc[3],
+                         ldn=sc[4], lphi=sc[5])
+            return cache, hw
+
+        from jax.sharding import NamedSharding as _NS
+
+        def _spmd_front(theta, sh):
+            # nw inherits the pulsar sharding elementwise from sigma2 —
+            # collective-free. The phi program scatters over a flat
+            # (npsr*NW+1,) vector; left to itself GSPMD shards that tiny
+            # vector to match the shard_map operand and pays a
+            # collective-permute re-laying it out. Its only input is
+            # the replicated theta, so pin it replicated: the whole
+            # gather/scatter program runs redundantly per device (a few
+            # KB) and the downstream multiply shards locally.
+            nw = eval_white(theta, sh["sigma2"])
+            phi = jax.lax.with_sharding_constraint(
+                eval_phi(theta), _NS(mesh, _P()))
+            return nw, phi * sh["cs2N"]
+
+        def loglike_spmd(theta, sh):
+            nw, phi_N = _spmd_front(theta, sh)
+            packed = _spmd_fwd(nw, phi_N, sh["R"], sh["T"], sh["mask"],
+                               sh["tm_pad"])
+            cache, _ = _unpack_spmd(packed, False)
+            return _stage3(theta, cache)
+
+        def loglike_health_spmd(theta, sh):
+            """Sharded health-instrumented eval: the per-pulsar health
+            words ride the SAME packed psum as the Schur blocks (no
+            second collective), so the escalation ladder and quarantine
+            see the identical (npsr_real, 3) contract as unsharded."""
+            nw, phi_N = _spmd_front(theta, sh)
+            packed = _spmd_fwd_h(nw, phi_N, sh["R"], sh["T"],
+                                 sh["mask"], sh["tm_pad"])
+            cache, hw = _unpack_spmd(packed, True)
+            return _stage3(theta, cache), hw[:npsr_real]
+
+    if use_spmd:
+        inner = loglike_spmd
+    else:
+        inner = loglike_schur if joint_mode == "schur" else loglike_dense
     like = PTALikelihood(psrs, sampled, inner, gram_mode, mesh=mesh,
                          consts=_sh)
     if joint_mode == "schur":
-        like._eval_health = loglike_health
-        like._eval_health_batch = jax.vmap(loglike_health,
-                                           in_axes=(0, None))
+        _health = loglike_health_spmd if use_spmd else loglike_health
+        like._eval_health = _health
+        like._eval_health_batch = jax.vmap(_health, in_axes=(0, None))
         # pulsar-axis attribution for the health ladder (pads excluded)
         like.health_psr_names = [p.name for p in psrs]
     # update_mask contract (evaluation-structure layer): installed for
@@ -936,5 +1099,8 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
                         stage12_single=_stage12_single, stage3=_stage3,
                         NW=NW, MW=MW, n_g=n_g, npsr=npsr,
                         jitter=jitter, tm_pad=tm_pad_j,
-                        joint_mode=joint_mode, mega=mega)
+                        joint_mode=joint_mode, mega=mega,
+                        spmd=use_spmd,
+                        nshard=(mesh.shape[psr_axis]
+                                if use_spmd else 1))
     return like

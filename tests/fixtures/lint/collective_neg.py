@@ -1,0 +1,30 @@
+"""Disciplined twin of collective_pos.py: every collective names the
+declared mesh axis through one of the accepted static forms. Placed at
+enterprise_warp_tpu/parallel/collective_neg.py."""
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+AXIS = "psr"
+
+
+def local_sum(x):
+    # literal axis matching the mesh axis declared in this module
+    return jax.lax.psum(jnp.sum(x), "psr")
+
+
+def build(mesh):
+    return shard_map(local_sum, mesh=mesh, in_specs=P("psr"),
+                     out_specs=P())
+
+
+def named_axis_reduce(x, axis_name="psr"):
+    # axis named through a string parameter default — the pattern the
+    # joint likelihood builder uses (psr_axis="psr")
+    return jax.lax.pmean(x, axis_name)
+
+
+def const_axis_reduce(x):
+    # axis named through a module-level constant
+    return jax.lax.psum(x, AXIS)

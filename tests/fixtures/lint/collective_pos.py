@@ -1,0 +1,47 @@
+"""Seeded collective-safety violations. Placed at
+enterprise_warp_tpu/parallel/collective_pos.py (a hot module): the
+mesh axis declared here is 'psr', so every collective must name it."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def bad_unnamed(x):
+    # VIOLATION (error): collective without an axis name
+    return jax.lax.psum(jnp.sum(x))
+
+
+def bad_mismatch(x):
+    # VIOLATION (error): 'rows' is not a mesh axis declared in this
+    # module — the reduction would bind the wrong (or no) mesh axis
+    return jax.lax.pmean(x, "rows")
+
+
+def bad_dynamic(x, i):
+    # VIOLATION (error): dynamically built axis name defeats static
+    # axis checking
+    return jax.lax.psum(x, "ax" + str(i))
+
+
+def shard_body(x):
+    part = jnp.sum(x)
+    # VIOLATION (error): .item() host sync inside the shard_map body
+    flag = part.item()
+    # VIOLATION (error): device_get inside the shard_map body
+    host = jax.device_get(part)
+    return jax.lax.psum(part + flag + host, "psr")
+
+
+def build(mesh):
+    return shard_map(shard_body, mesh=mesh, in_specs=P("psr"),
+                     out_specs=P())
+
+
+@partial(shard_map, mesh=None, in_specs=P("psr"), out_specs=P())
+def decorated_body(x):
+    # VIOLATION (error): tolist() inside a shard-mapped function
+    vals = x.tolist()
+    return jax.lax.psum(x + len(vals), "psr")

@@ -80,6 +80,24 @@ class TestParamfileParsing:
         with pytest.raises(ValueError, match="Known samplers"):
             Params(str(bad), opts=make_opts(), init_pulsars=False)
 
+    def test_mesh_knobs_parse_for_every_sampler(self, in_tmp, tmp_path):
+        """``psr_shard``/``chain_shard`` are shared device-mesh knobs
+        (docs/scaling.md, docs/performance.md): every sampler section
+        must accept them from a paramfile, defaulting to 0 (off)."""
+        (tmp_path / "x.json").write_text('{"universal": {}}')
+        pf = tmp_path / "shard.dat"
+        pf.write_text("datadir: data/\nsampler: hmc\npsr_shard: 1\n"
+                      "chain_shard: 2\n{0}\nnoise_model_file: x.json\n")
+        p = Params(str(pf), opts=make_opts(), init_pulsars=False)
+        assert p.sampler_kwargs["psr_shard"] == 1
+        assert p.sampler_kwargs["chain_shard"] == 2
+        for name in IMPLEMENTED_SAMPLERS:
+            pf.write_text(f"datadir: data/\nsampler: {name}\n{{0}}\n"
+                          "noise_model_file: x.json\n")
+            p = Params(str(pf), opts=make_opts(), init_pulsars=False)
+            assert p.sampler_kwargs["psr_shard"] == 0, name
+            assert p.sampler_kwargs["chain_shard"] == 0, name
+
     def test_cli_override_mutates_label(self, in_tmp):
         opts = make_opts(noise_model_file=None)  # None -> no override
         p = Params(f"{PARAMS}/default_model_dynesty.dat", opts=opts,

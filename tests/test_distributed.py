@@ -110,6 +110,242 @@ class TestSingleWriter:
         assert list(tmp_path.iterdir()) == []
 
 
+# ------------------------------------------------------------------ #
+#  SPMD joint likelihood on the in-process emulated mesh              #
+#  (conftest forces --xla_force_host_platform_device_count=8, so      #
+#  every test process has 8 host-platform devices: the 8-way parity   #
+#  and collective-count contracts run in tier-1 without subprocesses) #
+# ------------------------------------------------------------------ #
+
+_NMODES = 2
+
+
+def _gwb_termlists(psrs):
+    from enterprise_warp_tpu.models import StandardModels, TermList
+
+    tls = []
+    for p in psrs:
+        m = StandardModels(psr=p)
+        tls.append(TermList(p, [
+            m.efac("by_backend"),
+            m.spin_noise(f"powerlaw_{_NMODES}_nfreqs"),
+            m.gwb(f"hd_vary_gamma_{_NMODES}_nfreqs")]))
+    return tls
+
+
+def _pta(npsr, ntoa=28, seed=3):
+    from enterprise_warp_tpu.sim.noise import make_fake_pta
+
+    psrs = make_fake_pta(npsr=npsr, ntoa=ntoa, seed=seed)
+    rng = np.random.default_rng(seed)
+    for p in psrs:
+        p.residuals = p.toaerrs * rng.standard_normal(len(p))
+    return psrs
+
+
+def _theta_for(names):
+    out = []
+    for n in names:
+        if n.endswith("efac"):
+            out.append(1.1)
+        elif "log10_A" in n:
+            out.append(-13.2)
+        elif "gamma" in n:
+            out.append(3.9)
+        else:
+            out.append(0.5)
+    return np.array(out)
+
+
+@pytest.fixture(scope="module")
+def spmd_pair():
+    """(unsharded, 8-way sharded) Schur joint likelihood + a theta."""
+    from enterprise_warp_tpu.parallel import (build_pta_likelihood,
+                                              make_mesh)
+
+    psrs = _pta(8)
+    like0 = build_pta_likelihood(psrs, _gwb_termlists(psrs))
+    likeS = build_pta_likelihood(psrs, _gwb_termlists(psrs),
+                                 mesh=make_mesh(8))
+    assert like0.param_names == likeS.param_names
+    return like0, likeS, _theta_for(like0.param_names)
+
+
+class TestSPMDParity:
+    def test_routes_spmd_8way(self, spmd_pair):
+        _, likeS, _ = spmd_pair
+        assert likeS._stages["spmd"] is True
+        assert likeS._stages["nshard"] == 8
+
+    def test_schur_value_and_gradient_match_unsharded(self, spmd_pair):
+        import jax
+        import jax.numpy as jnp
+
+        like0, likeS, theta = spmd_pair
+        # value_and_grad: ONE compile per evaluator (the 8-way
+        # shard_map grad compile dominates this module's wall time)
+        l0, g0 = jax.value_and_grad(
+            lambda t: like0._eval(t, like0.consts))(jnp.asarray(theta))
+        lS, gS = jax.value_and_grad(
+            lambda t: likeS._eval(t, likeS.consts))(jnp.asarray(theta))
+        l0, lS = float(l0), float(lS)
+        assert abs(l0 - lS) < 1e-6 * max(1.0, abs(l0)), (l0, lS)
+        np.testing.assert_allclose(np.asarray(gS), np.asarray(g0),
+                                   rtol=1e-8, atol=1e-10)
+
+    def test_health_words_ride_the_collective_and_match(self, spmd_pair):
+        import jax.numpy as jnp
+
+        like0, likeS, theta = spmd_pair
+        l0, hw0 = like0._eval_health(jnp.asarray(theta), like0.consts)
+        lS, hwS = likeS._eval_health(jnp.asarray(theta), likeS.consts)
+        assert abs(float(l0) - float(lS)) < 1e-6 * abs(float(l0))
+        hw0, hwS = np.asarray(hw0), np.asarray(hwS)
+        assert hwS.shape == (8, 3)
+        np.testing.assert_allclose(hwS, hw0, rtol=1e-10, atol=1e-10)
+
+    def test_dense_path_parity_under_mesh(self):
+        """The dense joint Cholesky path under a pulsar mesh (GSPMD
+        auto-sharding, not the shard_map route) agrees with the
+        unsharded dense evaluator."""
+        from enterprise_warp_tpu.parallel import (build_pta_likelihood,
+                                                  make_mesh)
+
+        psrs = _pta(4, seed=5)
+        like0 = build_pta_likelihood(psrs, _gwb_termlists(psrs),
+                                     joint_mode="dense")
+        likeM = build_pta_likelihood(psrs, _gwb_termlists(psrs),
+                                     joint_mode="dense",
+                                     mesh=make_mesh(4))
+        assert likeM._stages["spmd"] is False
+        theta = _theta_for(like0.param_names)
+        l0, lM = float(like0.loglike(theta)), float(likeM.loglike(theta))
+        assert abs(l0 - lM) < 1e-6 * max(1.0, abs(l0)), (l0, lM)
+
+
+class TestSPMDCollectiveContract:
+    def test_exactly_one_collective_per_evaluation(self, spmd_pair):
+        """The acceptance-criterion proof: the compiled sharded Schur
+        evaluation contains EXACTLY one all-reduce and no gathers,
+        all-to-alls, or collective-permutes — and the health-word twin
+        compiles to the same single collective (the words ride the
+        same packed psum, they do not buy a second one)."""
+        import jax
+        import jax.numpy as jnp
+        import re as _re
+
+        _, likeS, theta = spmd_pair
+        for fn in (likeS._eval, likeS._eval_health):
+            txt = (jax.jit(fn)
+                   .lower(jnp.asarray(theta), likeS.consts)
+                   .compile().as_text())
+            n_ar = len(_re.findall(r"\ball-reduce(?:-start)?\(", txt))
+            n_ag = len(_re.findall(r"\ball-gather(?:-start)?\(", txt))
+            n_a2a = len(_re.findall(r"\ball-to-all\(", txt))
+            n_cp = len(_re.findall(
+                r"\bcollective-permute(?:-start)?\(", txt))
+            assert (n_ar, n_ag, n_a2a, n_cp) == (1, 0, 0, 0), (
+                fn, n_ar, n_ag, n_a2a, n_cp)
+
+
+class TestSPMDQuarantine:
+    def test_quarantine_leaves_survivors_bit_equal(self):
+        """Drop one mid-array pulsar (ingestion quarantine drops it
+        before the build) on a fixed 3-way mesh: the survivors' health
+        words in the quarantined sharded run are BIT-equal to their
+        rows in the clean full sharded run — sharding plus quarantine
+        never perturbs the per-pulsar degradation plane. (Sharded vs
+        UNSHARDED health-word equality is pinned separately by
+        TestSPMDParity on the 8-way mesh.)"""
+        import jax.numpy as jnp
+
+        from enterprise_warp_tpu.parallel import (build_pta_likelihood,
+                                                  make_mesh)
+
+        psrs = _pta(4)
+        surv = psrs[:2] + psrs[3:]
+        mesh = make_mesh(2)          # full: 2/shard; surv: 3->pad 4
+        likeF = build_pta_likelihood(psrs, _gwb_termlists(psrs),
+                                     mesh=mesh)
+        likeS = build_pta_likelihood(surv, _gwb_termlists(surv),
+                                     mesh=mesh)
+
+        theta = _theta_for(likeS.param_names)
+        by_name = dict(zip(likeS.param_names, theta))
+        thF = np.array([by_name.get(n, v) for n, v in zip(
+            likeF.param_names, _theta_for(likeF.param_names))])
+
+        _, hwF = likeF._eval_health(jnp.asarray(thF), likeF.consts)
+        lS, hwS = likeS._eval_health(jnp.asarray(theta), likeS.consts)
+        hwF, hwS = map(np.asarray, (hwF, hwS))
+        assert np.isfinite(float(lS))
+        full_survivors = np.concatenate([hwF[:2], hwF[3:]], axis=0)
+        assert np.array_equal(hwS, full_survivors)
+
+
+class TestMeshHelpers:
+    def test_make_mesh_clamps_to_pulsar_count(self):
+        from enterprise_warp_tpu.parallel import make_mesh
+
+        assert make_mesh(3).size == 3
+        assert make_mesh(100).size == 8    # conftest's emulated devices
+        assert make_mesh(1).axis_names == ("psr",)
+
+    def test_emulated_host_count_reads_xla_flags(self):
+        assert distributed.emulated_host_count() == 8
+
+    def test_device_stamp_carries_mesh_and_emulation(self):
+        from enterprise_warp_tpu.parallel import make_mesh
+
+        stamp = distributed.device_stamp(make_mesh(4))
+        assert stamp["platform"] == "cpu"
+        assert stamp["emulated_hosts"] == 8
+        assert stamp["mesh_devices"] == 4
+        assert stamp["mesh_axes"] == {"psr": 4}
+
+    def test_primary_only_skips_on_secondary(self, as_secondary):
+        calls = []
+
+        @distributed.primary_only
+        def write_artifact(x):
+            calls.append(x)
+            return x
+
+        assert write_artifact(1) is None
+        assert calls == []
+
+    def test_primary_only_passes_through_on_primary(self):
+        @distributed.primary_only
+        def write_artifact(x):
+            return x * 2
+
+        assert write_artifact(3) == 6
+
+    def test_scatter_to_global_reconstructs_under_psum(self):
+        """N shards scatter disjoint row blocks into zero buffers; one
+        psum reconstructs the full array — the collective-free half of
+        the single-collective contract."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from enterprise_warp_tpu.parallel import make_mesh
+        from enterprise_warp_tpu.parallel.distributed import \
+            scatter_to_global
+
+        mesh = make_mesh(4)
+        x = jnp.arange(8.0 * 3).reshape(8, 3)
+
+        def body(x_l):
+            return jax.lax.psum(
+                scatter_to_global(2.0 * x_l, 8, "psr"), "psr")
+
+        y = shard_map(body, mesh=mesh, in_specs=P("psr", None),
+                      out_specs=P())(x)
+        np.testing.assert_array_equal(np.asarray(y), 2.0 * np.asarray(x))
+
+
 _TWO_PROC_SCRIPT = r'''
 import sys, os
 sys.path[:] = [p for p in sys.path if ".axon_site" not in p]

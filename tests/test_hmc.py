@@ -81,6 +81,56 @@ class TestHMC:
             assert g[i] == pytest.approx(fd, rel=2e-4, abs=1e-5)
 
     @pytest.mark.slow
+    def test_sharded_joint_likelihood_leg(self, tmp_path):
+        """HMC against the DISTRIBUTED evaluator: identical sampler
+        config at a fixed seed on the unsharded and the 4-way-sharded
+        joint Schur likelihood. The consts ride as jitted arguments
+        (samplers/evalproto.py), so the sharded build changes only
+        their placement — acceptance rate and ESS must land within
+        statistical tolerance of the single-host run (bitwise equality
+        is NOT expected: the packed psum reorders the f64 sums and
+        trajectories decorrelate chaotically)."""
+        from test_distributed import _gwb_termlists, _pta, _theta_for
+
+        from enterprise_warp_tpu.parallel import (build_pta_likelihood,
+                                                  make_mesh)
+        from enterprise_warp_tpu.utils.diagnostics import \
+            effective_sample_size
+
+        psrs = _pta(3, seed=11)
+        like0 = build_pta_likelihood(psrs, _gwb_termlists(psrs))
+        likeS = build_pta_likelihood(psrs, _gwb_termlists(psrs),
+                                     mesh=make_mesh(3))
+        assert likeS._stages["spmd"] is True
+
+        nsamp, nchains = 120, 6
+
+        def run(like, sub):
+            out = tmp_path / sub
+            s = HMCSampler(like, str(out), nchains=nchains, seed=7,
+                           n_leapfrog=8, warmup=50)
+            s.sample(nsamp, resume=False, verbose=False)
+            chain = np.loadtxt(out / "chain_1.txt")
+            arr = chain.reshape(nsamp, nchains, -1)
+            acc = float(np.mean(arr[-1, :, -2]))
+            burn = nsamp // 3
+            ess = np.array([effective_sample_size(arr[burn:, :, d].T)
+                            for d in range(like.ndim)])
+            return acc, ess
+
+        acc0, ess0 = run(like0, "single")
+        accS, essS = run(likeS, "sharded")
+        assert 0.5 < accS <= 1.0, accS
+        assert abs(accS - acc0) < 0.15, (acc0, accS)
+        # per-parameter ESS within a factor ~2.5 once both chains mix
+        ok = (essS > 0.4 * ess0) & (essS < 2.5 * ess0)
+        assert np.mean(ok) > 0.7, (ess0, essS)
+        # and the two evaluators agree on the target itself
+        theta = _theta_for(like0.param_names)
+        assert float(like0.loglike(theta)) == pytest.approx(
+            float(likeS.loglike(theta)), rel=1e-9, abs=1e-6)
+
+    @pytest.mark.slow
     def test_pulsar_sampling_and_resume(self, tmp_path, fake_psr):
         import copy
 

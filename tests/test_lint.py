@@ -48,6 +48,10 @@ _FIXTURE_MATRIX = {
                          "precision", 3),
     "precision_neg.py": ("enterprise_warp_tpu/ops/precision_neg.py",
                          "precision", 0),
+    "collective_pos.py": ("enterprise_warp_tpu/parallel/collective_pos.py",
+                          "collective-safety", 5),
+    "collective_neg.py": ("enterprise_warp_tpu/parallel/collective_neg.py",
+                          "collective-safety", 0),
 }
 
 _STYLE_EXPECT = {"no-print": 1, "no-bare-jit": 1,

@@ -113,11 +113,36 @@ KNOWN_HEARTBEAT_FIELDS = frozenset({
 })
 
 
+def _load_distributed():
+    """``parallel/distributed.py`` loaded by FILE PATH, not through the
+    package (whose ``__init__`` pulls in jax — this CLI's no-jax
+    contract). The module itself is import-time jax-free, and its
+    single-process fast path resolves the primary check without ever
+    touching jax."""
+    import importlib.util
+    mod_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "enterprise_warp_tpu", "parallel", "distributed.py")
+    spec = importlib.util.spec_from_file_location("_ewt_distributed",
+                                                  mod_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+primary_only = _load_distributed().primary_only
+
+
+@primary_only
 def _atomic_write_json(path, obj):
     """Same tmp-file + rename contract as
     ``enterprise_warp_tpu.io.writers.atomic_write_json``, inlined so
     this standalone CLI never imports the package (whose ``__init__``
-    pulls in jax) just to write one file."""
+    pulls in jax) just to write one file. ``primary_only``: on a
+    multi-host run every process folds its own report, but only
+    process 0 may write the committed artifact (single-writer
+    convention — racing renames tear nothing, but last-writer-wins
+    would silently keep an arbitrary host's view)."""
     tmp = path + ".tmp"
     try:
         with open(tmp, "w") as fh:

@@ -25,6 +25,11 @@ telemetry stream) into ``TRENDS.json`` and applies threshold gates:
   must keep its cold/warm first-result amortization, its batched
   dispatch reduction, a warm p50 latency ceiling, zero dropped
   requests, and packed-vs-single-job bit-equality;
+- ``scale``             — BENCH_SCALE.json's pulsar-axis scaling
+  curves must hold the strong-scaling cost-model efficiency floor at
+  the widest mesh, show exactly one all-reduce per sharded
+  evaluation, agree with the single-host value, and carry the device
+  stamp that keeps emulated-CPU figures from racing real meshes;
 - ``retraces`` / ``nonfinite`` / ``bubble`` (with ``--run <run_dir>``)
   — a fresh run's events.jsonl must show a bounded retrace count per
   traced fn, zero non-finite evals, and a sane bubble fraction;
@@ -526,6 +531,98 @@ def gate_integrity(bench_dir):
         "bit-equal")
 
 
+def gate_scale(bench_dir, min_strong_eff=0.6, min_npsr=64,
+               max_parity=1e-5):
+    """Pulsar-axis scaling gates from BENCH_SCALE.json (``bench.py
+    --scale``; docs/scaling.md):
+
+    - **like-for-like only** — the record must carry its provenance
+      stamp (platform + emulated host count) and declare the
+      cost-model timing basis; a stamp-less or wall-clock-basis record
+      fails rather than racing numbers measured under different rules
+      (emulated CPU shards timeshare one core — their wall-clock says
+      nothing a real mesh would honor);
+    - **strong-scaling floor** — cost-model efficiency at the widest
+      mesh must hold ``min_strong_eff`` on a problem of at least
+      ``min_npsr`` pulsars (the committed acceptance bar: >= 0.6 at
+      8-way for >= 64 pulsars);
+    - **one collective per evaluation** — every sharded width's
+      compiled HLO census must show exactly one all-reduce and zero
+      gathers / all-to-alls / collective-permutes (the Schur psum
+      contract; health words ride the same collective);
+    - **parity** — the sharded evaluations across the strong curve
+      must agree with the single-host value to f64 tolerance.
+    """
+    doc = _load_json(os.path.join(bench_dir, "BENCH_SCALE.json"))
+    if not doc:
+        return _gate("scale", "warn", "no BENCH_SCALE.json record")
+    problems = []
+    stamp = doc.get("stamp")
+    if not isinstance(stamp, dict) or not stamp.get("platform"):
+        problems.append(
+            "record lacks the device stamp (platform/emulated_hosts) "
+            "— like-for-like comparison impossible")
+        stamp = {}
+    basis = doc.get("timing_basis")
+    if basis != "xla_cost_model_flops_per_partition":
+        problems.append(
+            f"timing basis {basis!r} is not the cost-model basis this "
+            "gate's thresholds are calibrated for (like-for-like "
+            "only)")
+    strong = doc.get("strong") or {}
+    npsr = strong.get("npsr")
+    eff = strong.get("efficiency") or {}
+    widest = max((int(w) for w in eff), default=0)
+    e_widest = eff.get(str(widest))
+    if npsr is None or npsr < min_npsr:
+        problems.append(f"strong curve ran {npsr} pulsars < the "
+                        f"{min_npsr} the committed bar requires")
+    if widest < 2 or e_widest is None:
+        problems.append("strong curve carries no multi-shard "
+                        "efficiency figure")
+    elif e_widest < min_strong_eff:
+        problems.append(
+            f"strong-scaling efficiency {e_widest} at {widest}-way < "
+            f"floor {min_strong_eff} (cost-model basis)")
+    for curve in ("strong", "weak"):
+        for w, entry in ((doc.get(curve) or {}).get("per_width")
+                         or {}).items():
+            if int(w) < 2:
+                continue
+            c = entry.get("collectives") or {}
+            if c.get("all_reduce") != 1 or any(
+                    c.get(k) for k in ("all_gather", "all_to_all",
+                                       "collective_permute")):
+                problems.append(
+                    f"{curve} width {w}: collective census {c} breaks "
+                    "the one-psum-per-evaluation contract")
+    parity = doc.get("parity_max_abs_diff")
+    if parity is None:
+        problems.append("record lacks parity_max_abs_diff")
+    elif parity > max_parity:
+        problems.append(f"sharded-vs-single lnl drift {parity} > "
+                        f"{max_parity}")
+    if problems:
+        return _gate("scale", "fail", "; ".join(problems),
+                     strong_efficiency=eff,
+                     npsr=npsr, stamp=stamp or None)
+    ess = doc.get("ess") or {}
+    ess_note = ""
+    legs = [k for k in ess if isinstance(ess.get(k), dict)
+            and ess[k].get("ess_per_s") is not None]
+    if legs:
+        ess_note = "; ESS/s " + ", ".join(
+            f"{k}={ess[k]['ess_per_s']}" for k in sorted(legs))
+    return _gate(
+        "scale", "pass",
+        f"strong efficiency {e_widest} at {widest}-way on {npsr} psrs "
+        f"(floor {min_strong_eff}, cost-model basis, "
+        f"emulated_hosts={stamp.get('emulated_hosts')}), one "
+        f"all-reduce per sharded evaluation, parity {parity}"
+        + ess_note, strong_efficiency=eff, npsr=npsr,
+        weak_efficiency=(doc.get("weak") or {}).get("efficiency"))
+
+
 def gate_staleness(series, stale_days, now=None):
     """The "device leg went stale unnoticed" alarm: the newest
     headline must be a device measurement young enough to trust."""
@@ -676,6 +773,13 @@ def main(argv=None):
                     default=250.0,
                     help="serve warm p50 request-latency ceiling in "
                          "ms (default 250, CPU-honest)")
+    ap.add_argument("--min-scale-eff", type=float, default=0.6,
+                    help="strong-scaling cost-model efficiency floor "
+                         "at the widest mesh (default 0.6, the "
+                         "committed contract)")
+    ap.add_argument("--min-scale-npsr", type=int, default=64,
+                    help="minimum pulsar count the strong-scaling "
+                         "curve must have raced (default 64)")
     ap.add_argument("--max-retraces", type=int, default=8,
                     help="per-fn retrace cap for --run (default 8)")
     ap.add_argument("--max-bubble", type=float, default=0.6,
@@ -708,6 +812,9 @@ def main(argv=None):
                    min_dispatch_red=opts.min_serve_dispatch_red,
                    max_warm_p50_ms=opts.max_serve_warm_p50_ms),
         gate_integrity(opts.bench_dir),
+        gate_scale(opts.bench_dir,
+                   min_strong_eff=opts.min_scale_eff,
+                   min_npsr=opts.min_scale_npsr),
         gate_staleness(series, opts.stale_days),
     ]
     if opts.run is not None:
@@ -733,6 +840,8 @@ def main(argv=None):
             "min_serve_warm_speedup": opts.min_serve_warm_speedup,
             "min_serve_dispatch_red": opts.min_serve_dispatch_red,
             "max_serve_warm_p50_ms": opts.max_serve_warm_p50_ms,
+            "min_scale_eff": opts.min_scale_eff,
+            "min_scale_npsr": opts.min_scale_npsr,
             "max_retraces": opts.max_retraces,
             "max_bubble": opts.max_bubble,
             "stale_days": opts.stale_days,
