@@ -1257,6 +1257,10 @@ def serve_bench():
         "posteriors_per_hour": round(
             3600.0 * sum_b["requests_done"] / wall_b, 1),
         "latency_ms": sum_b["latency_ms"],
+        # request-level stage decomposition (queue/pack/dispatch/
+        # harvest + explicit residual) — the sentinel slo gate holds
+        # its reconciliation slack near zero (docs/observability.md)
+        "decomposition": sum_b["decomposition"],
         "mean_batch_fill": sum_b["mean_batch_fill"],
         "mean_jobs_per_batch": round(jobs_per_batch, 2),
         "dispatches": sum_b["dispatches"],

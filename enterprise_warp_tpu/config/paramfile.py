@@ -141,10 +141,14 @@ class Params:
             "SCAMweight:": ["SCAMweight", int],
             "tm:": ["tm", str],
             "fref:": ["fref", float],
-            # serving-layer admission config (docs/serving.md):
+            # serving-layer admission + SLO config (docs/serving.md):
             # whitespace-separated key=value tokens, parsed by
             # serve.admission.parse_serve_config — e.g.
-            # ``serve: max_queue=64 tenant_quota=8 weight.gold=4``
+            # ``serve: max_queue=64 tenant_quota=8 weight.gold=4
+            # slo_p95_ms=250 slo_success=0.99 slo_p95_ms.gold=100
+            # slo_window=256`` (the slo_* keys declare per-tenant
+            # objectives for serve/slo.py:SLOEngine;
+            # docs/serving.md#slo)
             "serve:": ["serve", str],
             # numerical-integrity plane (docs/resilience.md): the
             # ingestion-gate repair policy ('none' quarantines on hard

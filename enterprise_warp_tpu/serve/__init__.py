@@ -25,6 +25,11 @@ amortizes both compilation and dispatch:
   :class:`~admission.Rejection` at submit (shape/dtype/finite/prior-
   support validation, bounded queue, per-tenant quotas) and weighted
   tenant fair-share drain ordering;
+- :mod:`slo` — the per-tenant SLO engine
+  (:class:`~slo.SLOEngine`): windowed burn-rate/error-budget
+  accounting over terminal request outcomes, fed by the driver and
+  exported through the OpenMetrics endpoint
+  (docs/serving.md#slo);
 - :mod:`cli` — ``ewt-run serve ...`` / ``python tools/serve.py``.
 
 See ``docs/serving.md``.
@@ -36,9 +41,10 @@ from .aot import (DEFAULT_BUCKETS, AOTExecutableCache, batch_buckets,
                   bucket_for)
 from .driver import Request, ServeDriver
 from .packer import PackedBatch, pack_requests, split_batch
+from .slo import SLOEngine
 
 __all__ = ["AOTExecutableCache", "DEFAULT_BUCKETS", "batch_buckets",
            "bucket_for", "ServeDriver", "Request", "PackedBatch",
            "pack_requests", "split_batch", "Rejection",
            "UnknownModel", "validate_thetas", "fair_share_order",
-           "parse_serve_config"]
+           "parse_serve_config", "SLOEngine"]

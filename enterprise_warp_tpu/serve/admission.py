@@ -186,10 +186,17 @@ def parse_serve_config(value):
     list)::
 
         serve: max_queue=64 tenant_quota=8 default_deadline_ms=5000 \
-               weight.gold=4 weight.bronze=1
+               weight.gold=4 weight.bronze=1 \
+               slo_p95_ms=250 slo_success=0.99 slo_p95_ms.gold=100 \
+               slo_window=256
 
     ``weight.<tenant>=<w>`` tokens collect into ``tenant_weights``.
-    Returns ``{}`` for None/empty."""
+    The SLO surface (docs/serving.md#slo): ``slo_p95_ms=`` /
+    ``slo_success=`` declare the default per-tenant objectives, a
+    ``.<tenant>`` suffix overrides them for one tenant, and
+    ``slo_window=`` sizes the outcome ring — all collected into the
+    driver's ``slo`` kwarg (``serve/slo.py:SLOEngine``). Returns
+    ``{}`` for None/empty."""
     if value is None:
         return {}
     tokens = (list(value) if isinstance(value, (list, tuple))
@@ -203,6 +210,7 @@ def parse_serve_config(value):
             raise ValueError(
                 f"serve config token {tok!r} is not key=value")
         key, val = tok.split("=", 1)
+        base, _, tenant = key.partition(".")
         if key.startswith("weight."):
             out.setdefault("tenant_weights", {})[
                 key[len("weight."):]] = float(val)
@@ -210,8 +218,17 @@ def parse_serve_config(value):
             out[key] = int(val)
         elif key == "default_deadline_ms":
             out[key] = float(val)
+        elif key == "slo_window":
+            out.setdefault("slo", {})["window"] = int(val)
+        elif base in ("slo_p95_ms", "slo_success"):
+            objective = base[len("slo_"):]
+            out.setdefault("slo", {}).setdefault(
+                "objectives", {}).setdefault(
+                tenant or "default", {})[objective] = float(val)
         else:
             raise ValueError(
                 f"unknown serve config key {key!r} (one of max_queue, "
-                "tenant_quota, default_deadline_ms, weight.<tenant>)")
+                "tenant_quota, default_deadline_ms, weight.<tenant>, "
+                "slo_p95_ms[.<tenant>], slo_success[.<tenant>], "
+                "slo_window)")
     return out

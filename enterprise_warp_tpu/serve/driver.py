@@ -56,15 +56,35 @@ takes the same bisection path instead of failing every passenger.
 Results: ``driver.results[rid]`` (host f64 lnl per job row), a typed
 ``serve_result`` event on the tenant's ``events.jsonl`` (latency,
 batch provenance), and ``serve_latency_ms`` histograms in the metrics
-registry. Driver heartbeats carry ``queue_depth`` / ``batch_fill`` /
-``requests_done`` — folded by ``tools/report.py`` and the
-``tools/campaign.py`` fleet console.
+registry. Driver heartbeats carry ``queue_depth`` /
+``queue_depth_max`` / ``queue_age_ms`` / ``shed_per_s`` /
+``batch_fill`` / ``requests_done`` — folded by ``tools/report.py``,
+the ``tools/campaign.py`` fleet console, and the
+``tools/observatory.py`` serve console.
+
+**Request tracing + SLO plane**
+(docs/observability.md#request-tracing): ``submit()`` mints a
+``trace_id`` threaded through every stage — admission verdict, queue
+wait, fair-share/pack, supervised dispatch (including demotion
+retries and bisect re-dispatches), harvest, result — as ``serve_*``
+typed events plus ``serve.order``/``serve.pack``/``serve.dispatch``/
+``serve.harvest`` spans, so a request's whole lifecycle is
+reconstructable from ``events.jsonl`` alone, across the queue
+checkpoint. ``serve_result`` carries the full latency decomposition
+(``queue_ms + pack_ms + dispatch_ms + harvest_ms + other_ms ==
+latency_ms``). Tracing is host-side wall arithmetic only — zero
+added dispatches/syncs on the hot path, fully inert under
+``EWT_TELEMETRY=0``, results bit-equal either way. Declared
+per-tenant objectives (paramfile ``serve:`` ``slo_*`` keys) feed the
+windowed ``serve/slo.py:SLOEngine`` — burn-rate/budget gauges +
+edge-triggered ``slo_breach`` events.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import uuid
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -86,6 +106,7 @@ from .admission import (Rejection, UnknownModel, fair_share_order,
                         validate_thetas)
 from .aot import AOTExecutableCache
 from .packer import pack_requests, split_batch
+from .slo import SLOEngine
 
 __all__ = ["Request", "ServeDriver"]
 
@@ -96,6 +117,11 @@ log = get_logger("ewt.serve")
 #: caller still has the full array via ``driver.results``)
 _INLINE_LNL_ROWS = 32
 
+#: ``serve_stage`` events inline at most this many request/trace ids
+#: (``n_requests`` always carries the true count) — a capacity-bucket
+#: batch must not turn every stage event into a kilobyte of ids
+_INLINE_STAGE_IDS = 32
+
 
 @dataclass
 class Request:
@@ -103,7 +129,18 @@ class Request:
     ``model`` for ``tenant``. ``deadline`` is an absolute
     ``profiling.monotonic()`` instant (None = no deadline);
     ``deadline_ms`` keeps the requested relative budget for latency
-    reporting."""
+    reporting.
+
+    Trace context (docs/observability.md#request-tracing):
+    ``trace_id`` is minted at submit and survives the queue
+    checkpoint; the ``*_ms`` stage accumulators attribute the
+    request's host wall to queue wait / pack / dispatch / harvest
+    (plain float adds — never a device sync), summing to at most
+    ``latency_ms`` with the remainder reported as ``other_ms`` in
+    ``serve_result``. ``t_enqueue`` is the instant the request last
+    entered the queue (submit, demotion requeue, or restore) — the
+    queue-wait accrual point; ``requeues`` counts demotion requeues
+    across sessions."""
 
     rid: str
     tenant: str
@@ -113,10 +150,58 @@ class Request:
     meta: dict = field(default_factory=dict)
     deadline: float | None = None
     deadline_ms: float | None = None
+    trace_id: str = ""
+    t_enqueue: float = 0.0
+    t_mark: float = 0.0
+    requeues: int = 0
+    queue_ms: float = 0.0
+    pack_ms: float = 0.0
+    dispatch_ms: float = 0.0
+    harvest_ms: float = 0.0
 
     @property
     def n(self) -> int:
         return int(self.thetas.shape[0])
+
+    def accrue(self, st: dict, attr: str,
+               gap_attr: str = "queue_ms"):
+        """Fold one stage window (a ``profiling.stage`` box with
+        ``t0``/``t1``/``dur_ms``) into the decomposition: the window
+        wall goes to ``attr``, and the un-attributed gap between this
+        request's previous stage boundary (``t_mark``) and the
+        window's start goes to ``gap_attr`` — queue wait by default
+        (head-of-line blocking behind other batches' dispatches is
+        queueing from the request's point of view); the harvest
+        accrual routes its gap to ``harvest_ms`` instead (that gap IS
+        the device computing + the pipeline's deferred window). The
+        gap-filling keeps ``other_ms`` a rounding residual rather
+        than a bucket of unexplained wall."""
+        gap_ms = (st["t0"] - self.t_mark) * 1e3
+        if gap_ms > 0.0:
+            setattr(self, gap_attr, getattr(self, gap_attr) + gap_ms)
+        setattr(self, attr, getattr(self, attr) + st["dur_ms"])
+        self.t_mark = max(st["t1"], self.t_mark)
+
+    def stage_fields(self, latency_ms: float | None = None) -> dict:
+        """The latency-decomposition event fields. With
+        ``latency_ms``, the explicit residual ``other_ms`` =
+        latency - (queue+pack+dispatch+harvest) is included — with
+        gap-filling accrual it is bounded by the driver bookkeeping
+        between the last stage boundary and the terminal event, so
+        the five fields reconcile against ``latency_ms`` to rounding
+        slack (docs/observability.md, the decomposition
+        reconciliation rule)."""
+        out = {"queue_ms": round(self.queue_ms, 3),
+               "pack_ms": round(self.pack_ms, 3),
+               "dispatch_ms": round(self.dispatch_ms, 3),
+               "harvest_ms": round(self.harvest_ms, 3)}
+        if latency_ms is not None:
+            staged = (self.queue_ms + self.pack_ms
+                      + self.dispatch_ms + self.harvest_ms)
+            out["other_ms"] = round(max(latency_ms - staged, 0.0), 3)
+        if self.requeues:
+            out["requeues"] = self.requeues
+        return out
 
 
 class ServeDriver:
@@ -126,7 +211,7 @@ class ServeDriver:
     def __init__(self, root, buckets=None, pipeline=True,
                  donate=True, max_queue=None, tenant_quota=None,
                  tenant_weights=None, default_deadline_ms=None,
-                 **start_fields):
+                 slo=None, **start_fields):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.cache = AOTExecutableCache(buckets, donate=donate)
@@ -165,6 +250,18 @@ class ServeDriver:
             else os.environ.get("EWT_SERVE_TENANT_QUOTA", 0) or 0)
         self.tenant_weights = dict(tenant_weights or {})
         self.default_deadline_ms = default_deadline_ms
+        # per-tenant SLO engine (serve/slo.py) — None unless the
+        # paramfile `serve:` line declared objectives
+        self.slo = slo if isinstance(slo, SLOEngine) \
+            else SLOEngine.from_config(slo)
+        # heartbeat-interval aggregates (anti-aliasing satellites): a
+        # poller sampling point-in-time queue_depth at drain would
+        # miss any burst between beats, so each beat also reports the
+        # interval's depth high-water mark and the shed rate since
+        # the previous beat
+        self._hb_depth_max = 0
+        self._hb_expired_last = 0
+        self._hb_t_last = profiling.monotonic()
         self.n_dispatch = 0
         self.n_sequential_equiv = 0   # dispatches a one-per-request
         #                               loop would have issued
@@ -194,6 +291,13 @@ class ServeDriver:
         self._c_req = reg.counter("serve_requests")
         self._c_disp = reg.counter("serve_dispatches")
         self._h_latency = reg.histogram("serve_latency_ms")
+        if self.slo is not None:
+            # declare the objectives on the stream so events.jsonl is
+            # self-describing: tools/observatory.py recounts burn
+            # rates from the stream alone without the paramfile
+            self.rec.event("slo_config",
+                           objectives=self.slo.objectives,
+                           window=self.slo.window)
 
     # ------------------------- registry ---------------------------- #
     def register(self, name, like, width=None):
@@ -255,6 +359,12 @@ class ServeDriver:
         the packed dispatch path."""
         self._seq += 1
         rid = rid or f"{tenant}-{self._seq:06d}"
+        # trace context minted at the door — BEFORE admission, so
+        # even a rejection verdict is a traced lifecycle stage. A
+        # plain host string: minting is unconditional (cheap) so the
+        # queue checkpoint carries it uniformly whatever the
+        # telemetry state.
+        trace_id = uuid.uuid4().hex[:16]
         # injection site serve.admit BEFORE the accounting bump: an
         # injected error must leave the shed-accounting identity
         # untouched (the request entered no bucket)
@@ -290,7 +400,7 @@ class ServeDriver:
                     f"(quota {self.tenant_quota})")
         except Rejection as rej:
             rej.rid = rid
-            self._reject(rid, tenant, model, rej)
+            self._reject(rid, tenant, model, rej, trace_id=trace_id)
             raise
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
@@ -301,7 +411,9 @@ class ServeDriver:
                                 else t_submit + float(deadline_ms)
                                 / 1e3),
                       deadline_ms=(None if deadline_ms is None
-                                   else float(deadline_ms)))
+                                   else float(deadline_ms)),
+                      trace_id=trace_id, t_enqueue=t_submit,
+                      t_mark=t_submit)
         self.queue.append(req)
         self._pending[rid] = [np.empty(req.n, dtype=np.float64), 0,
                               req]
@@ -309,12 +421,15 @@ class ServeDriver:
         self.requests_seen += 1
         self._c_req.inc()
         self._g_depth.set(len(self.queue))
+        if len(self.queue) > self._hb_depth_max:
+            self._hb_depth_max = len(self.queue)
         self._tenant(tenant).event("serve_request", request_id=rid,
+                                   trace_id=trace_id,
                                    model=model, n_theta=req.n,
                                    deadline_ms=req.deadline_ms)
         return rid
 
-    def _reject(self, rid, tenant, model, rej):
+    def _reject(self, rid, tenant, model, rej, trace_id=None):
         """Record one typed admission rejection (the request never
         entered the queue)."""
         self.rejected[rid] = rej.reason
@@ -324,8 +439,8 @@ class ServeDriver:
         log.warning("rejected %s (%s): %s", rid, rej.reason,
                     rej.detail)
         self._tenant(tenant).event(
-            "serve_rejected", request_id=rid, model=str(model),
-            reason=rej.reason, detail=rej.detail)
+            "serve_rejected", request_id=rid, trace_id=trace_id,
+            model=str(model), reason=rej.reason, detail=rej.detail)
 
     def _dec_inflight(self, tenant):
         n = self._inflight.get(tenant, 0) - 1
@@ -363,15 +478,32 @@ class ServeDriver:
         # weighted tenant fair-share drain order (admission.py): safe
         # to reorder — at a fixed serve width a row's result is
         # bit-independent of co-batched content
-        snapshot = fair_share_order(snapshot, self.tenant_weights)
+        with profiling.stage("serve.order") as st_order:
+            snapshot = fair_share_order(snapshot, self.tenant_weights)
+            for req in snapshot:
+                by_model.setdefault(req.model, []).append(req)
+        # the fair-share reorder wall is pack-stage time every
+        # snapshot request sat through; the gap since each request's
+        # last accounted instant (submit/requeue/restore) is its
+        # queue wait
         for req in snapshot:
-            by_model.setdefault(req.model, []).append(req)
+            req.accrue(st_order, "pack_ms")
         n_batches = 0
         fills = []
         try:
             for model, reqs in by_model.items():
                 self.n_sequential_equiv += len(reqs)
-                for batch in pack_requests(reqs, self.widths[model]):
+                with profiling.stage("serve.pack",
+                                     model=str(model)) as st_pack:
+                    batches = pack_requests(reqs, self.widths[model])
+                for req in reqs:
+                    req.accrue(st_pack, "pack_ms")
+                self._stage_event(
+                    "pack", str(model), None, st_pack["dur_ms"],
+                    [r.rid for r in reqs],
+                    [r.trace_id for r in reqs],
+                    n_batches=len(batches))
+                for batch in batches:
                     out = self._dispatch(model, batch)
                     n_batches += 1
                     if out is None:
@@ -397,11 +529,67 @@ class ServeDriver:
         self._g_depth.set(len(self.queue))
         if fills:
             self._g_fill.set(sum(fills) / len(fills))
-        self.rec.heartbeat(
+        self._beat(fills)
+        return n_batches
+
+    # ------------------------- stage attribution ------------------- #
+    def _accrue(self, batch, attr):
+        """Fold one batch-stage window (deferred — returns an
+        applier taking the closed ``profiling.stage`` box) into every
+        still-pending request with rows in ``batch``; returns the
+        (rids, trace_ids) attributed. The gap since each request's
+        last accounted instant goes to ``queue_ms`` (head-of-line
+        wait behind earlier batches) — except for harvest windows,
+        where the gap IS the device compute plus pipeline defer and
+        belongs to ``harvest_ms``. Host float adds only — the
+        zero-dispatch tracing contract."""
+        gap_attr = "harvest_ms" if attr == "harvest_ms" else "queue_ms"
+        rids, trace_ids, seen = [], [], set()
+        for req, _, _, _ in batch.segments:
+            if req.rid in seen or req.rid not in self._pending:
+                continue
+            seen.add(req.rid)
+            rids.append(req.rid)
+            trace_ids.append(req.trace_id)
+        def apply(st):
+            for req, _, _, _ in batch.segments:
+                if req.rid in seen:
+                    seen.discard(req.rid)
+                    req.accrue(st, attr, gap_attr)
+        return rids, trace_ids, apply
+
+    def _stage_event(self, stage, model, bucket, dur_ms, rids,
+                     trace_ids, **extra):
+        """One typed ``serve_stage`` event on the driver stream: the
+        per-batch (or per-pack) stage wall plus the requests it
+        covers. Always emitted when telemetry is on (reconstruction
+        must not depend on EWT_SPANS); id lists are capped at
+        ``_INLINE_STAGE_IDS`` with ``n_requests`` carrying the true
+        count."""
+        self.rec.event(
+            "serve_stage", stage=stage, model=model, bucket=bucket,
+            dur_ms=(None if dur_ms is None else round(dur_ms, 3)),
+            n_requests=len(rids),
+            request_ids=rids[:_INLINE_STAGE_IDS],
+            trace_ids=trace_ids[:_INLINE_STAGE_IDS], **extra)
+
+    def _beat(self, fills=None):
+        """One driver heartbeat with the interval aggregates: the
+        depth high-water mark since the last beat (submit/requeue/
+        restore peaks a drain-time sample aliases over), the oldest
+        queued request's age, and the shed rate over the interval."""
+        now = profiling.monotonic()
+        dt = max(now - self._hb_t_last, 1e-9)
+        sheds = self.expired_requests - self._hb_expired_last
+        oldest = max(((now - r.t_enqueue) for r in self.queue),
+                     default=None)
+        fields = dict(
             phase="serve", step=self.requests_done,
             nsamp=self.requests_seen, queue_depth=len(self.queue),
-            batch_fill=(round(sum(fills) / len(fills), 4)
-                        if fills else None),
+            queue_depth_max=max(self._hb_depth_max, len(self.queue)),
+            queue_age_ms=(None if oldest is None
+                          else round(oldest * 1e3, 3)),
+            shed_per_s=round(sheds / dt, 4),
             dispatches=self.n_dispatch,
             requests_done=self.requests_done,
             requests_rejected=self.rejected_requests,
@@ -409,11 +597,21 @@ class ServeDriver:
             requests_quarantined=self.quarantined_requests,
             evals_per_s=round(self.meter.rate(), 1),
             evals_total=self.meter.total)
-        return n_batches
+        if fills is not None:
+            fields["batch_fill"] = (round(sum(fills) / len(fills), 4)
+                                    if fills else None)
+        self.rec.heartbeat(**fields)
+        self._hb_t_last = now
+        self._hb_expired_last = self.expired_requests
+        self._hb_depth_max = len(self.queue)
 
     def _expire(self, req, now):
         """Shed one deadline-expired request at pack time."""
         waited_ms = (now - req.t_submit) * 1e3
+        # close the open queue-wait window: everything since the last
+        # accounted instant was spent waiting to be packed
+        req.queue_ms += max(now - req.t_mark, 0.0) * 1e3
+        req.t_mark = now
         self._pending.pop(req.rid, None)
         self._dec_inflight(req.tenant)
         self.expired[req.rid] = round(waited_ms, 3)
@@ -421,9 +619,11 @@ class ServeDriver:
         telemetry.registry().counter("serve_expired",
                                      tenant=str(req.tenant)).inc()
         self._tenant(req.tenant).event(
-            "serve_expired", request_id=req.rid, model=req.model,
+            "serve_expired", request_id=req.rid,
+            trace_id=req.trace_id, model=req.model,
             n_theta=req.n, deadline_ms=req.deadline_ms,
-            waited_ms=round(waited_ms, 3))
+            waited_ms=round(waited_ms, 3), **req.stage_fields())
+        self._slo_observe(req, waited_ms, ok=False)
 
     def run(self):
         """Step until the queue is idle (or a graceful preemption is
@@ -461,31 +661,30 @@ class ServeDriver:
         self._g_depth.set(len(self.queue))
         # the in-loop heartbeats fire before their cycle's harvest has
         # committed; one post-flush beat carries the settled figures
-        self.rec.heartbeat(
-            phase="serve", step=self.requests_done,
-            nsamp=self.requests_seen, queue_depth=len(self.queue),
-            dispatches=self.n_dispatch,
-            requests_done=self.requests_done,
-            requests_rejected=self.rejected_requests,
-            requests_expired=self.expired_requests,
-            requests_quarantined=self.quarantined_requests,
-            evals_per_s=round(self.meter.rate(), 1),
-            evals_total=self.meter.total)
+        self._beat()
         return self.summary()
 
     # ------------------------- dispatch ---------------------------- #
-    def _dispatch(self, model, batch):
+    def _dispatch(self, model, batch, bisect=False):
         """Dispatch one packed batch; returns the device result array
         or None after recording a failure. A classic-route demotion is
         applied in place (cache flush + one re-dispatch of the same
         host rows); a cpu-rung demotion re-raises with the batch's
-        requests requeued."""
+        requests requeued.
+
+        Every attempt — including demotion retries and bisect
+        re-dispatches — is a traced ``serve_stage`` dispatch event
+        whose wall accrues to each live passenger's ``dispatch_ms``
+        (the request waited through it whatever the outcome). The
+        wall is the host-side submission window — including the AOT
+        executable acquisition, so a cold replica's compile wall
+        shows up as dispatch time, not unattributed residual; device
+        completion lands in the harvest stage (the pipeline's
+        ``host_pull``)."""
         like = self.models[model]
         consts = self._consts[model]
         placement = self._placement[model]
         for attempt in (0, 1):
-            compiled = self.cache.executable(like, batch.bucket)
-
             def thunk():
                 # injection site serve.dispatch (resilience harness):
                 # error = the supervisor's retry path, hang = the
@@ -501,9 +700,27 @@ class ServeDriver:
                 return compiled(place_resident(batch.rows, placement),
                                 consts)
 
+            rids, trace_ids, accrue = self._accrue(batch,
+                                                   "dispatch_ms")
+            extra = {"attempt": attempt}
+            if bisect:
+                extra["bisect"] = True
             try:
-                return self.sup.call(thunk)
+                with profiling.stage("serve.dispatch",
+                                     model=str(model),
+                                     bucket=batch.bucket) as st:
+                    # executable acquisition INSIDE the measured
+                    # window: a cold compile is dispatch wall the
+                    # passengers really waited through
+                    compiled = self.cache.executable(like,
+                                                     batch.bucket)
+                    out = self.sup.call(thunk)
             except PlatformDemotion as d:
+                accrue(st)
+                self._stage_event("dispatch", str(model),
+                                  batch.bucket, st["dur_ms"], rids,
+                                  trace_ids,
+                                  demotion=str(d.to_level), **extra)
                 telemetry.registry().counter(
                     "serve_demotion", to=str(d.to_level)).inc()
                 if attempt == 0 and apply_demotion(d):
@@ -518,10 +735,19 @@ class ServeDriver:
                 # the exception crosses the process boundary
                 raise
             except Exception as exc:   # noqa: BLE001 — per-batch fail
+                accrue(st)
+                self._stage_event("dispatch", str(model),
+                                  batch.bucket, st["dur_ms"], rids,
+                                  trace_ids,
+                                  error=type(exc).__name__, **extra)
                 # a non-demotion batch failure is POISON-SUSPECT:
                 # isolate the offending request by bisection instead
                 # of failing every passenger (docs/serving.md)
                 return self._bisect_failed(model, batch, exc)
+            accrue(st)
+            self._stage_event("dispatch", str(model), batch.bucket,
+                              st["dur_ms"], rids, trace_ids, **extra)
+            return out
         return None
 
     def _requeue_unfinished(self, snapshot):
@@ -535,10 +761,23 @@ class ServeDriver:
         finish."""
         self.pipe.flush()
         unfinished = [r for r in snapshot if r.rid in self._pending]
+        now = profiling.monotonic()
         for req in unfinished:
             self._pending[req.rid][1] = 0
+            # a requeued request re-enters the queue-wait stage NOW;
+            # the work it already sat through (pack/dispatch walls of
+            # the demoted cycle) stays on its accumulators
+            req.t_enqueue = now
+            req.requeues += 1
+            self.rec.event("serve_requeue", request_id=req.rid,
+                           trace_id=req.trace_id,
+                           tenant=str(req.tenant),
+                           model=str(req.model),
+                           requeues=req.requeues, reason="demotion")
         self.queue.extendleft(reversed(unfinished))
         self._g_depth.set(len(self.queue))
+        if len(self.queue) > self._hb_depth_max:
+            self._hb_depth_max = len(self.queue)
         # the process is about to re-enter one platform rung down:
         # persist the rebuilt queue (integrity generations) so the
         # restarted replica resumes it with restore()
@@ -603,7 +842,7 @@ class ServeDriver:
             # rows before anyone is condemned; if it is still
             # contaminated, the recursion re-enters here with nothing
             # left to compact away.
-            out = self._dispatch(model, sub)
+            out = self._dispatch(model, sub, bisect=True)
             if out is not None:
                 self.n_dispatch += 1
                 self.bisect_dispatches += 1
@@ -618,7 +857,7 @@ class ServeDriver:
         telemetry.registry().counter("serve_bisect",
                                      model=str(model)).inc()
         for half in split_batch(sub):
-            out = self._dispatch(model, half)
+            out = self._dispatch(model, half, bisect=True)
             if out is not None:
                 self.n_dispatch += 1
                 self.bisect_dispatches += 1
@@ -626,19 +865,34 @@ class ServeDriver:
 
     # ------------------------- harvest ----------------------------- #
     def _harvest(self, batch, out):
-        lnl = host_pull(out)
-        # injection site serve.harvest: kind ``nonfinite`` poisons
-        # the harvested batch (whole-batch contamination — the
-        # quarantine-bisection vector; a ``where`` filter against the
-        # rid list scopes it to batches carrying a chosen request)
-        spec = faults.fire(
-            "serve.harvest", model=str(batch.model),
-            rids=",".join(sorted({req.rid for req, _, _, _
-                                  in batch.segments})))
-        if spec is not None and spec.kind == "nonfinite":
-            lnl = np.array(lnl, copy=True)
-            lnl[:batch.n_real] = np.nan
-        finite = np.isfinite(np.asarray(lnl[:batch.n_real]))
+        """Pull + check + apply one batch. The harvest stage wall
+        (the D2H pull — where an async dispatch's device completion
+        actually lands — plus the isfinite gate) accrues to every
+        live passenger BEFORE completions fire, so a request
+        finishing from this very batch sees its own harvest time in
+        its ``serve_result`` decomposition (row assembly is host
+        bookkeeping after the accrual and lands in ``other_ms``)."""
+        rids, trace_ids, accrue = self._accrue(batch, "harvest_ms")
+        with profiling.stage("serve.harvest",
+                             model=str(batch.model),
+                             bucket=batch.bucket) as st:
+            lnl = host_pull(out)
+            # injection site serve.harvest: kind ``nonfinite``
+            # poisons the harvested batch (whole-batch contamination
+            # — the quarantine-bisection vector; a ``where`` filter
+            # against the rid list scopes it to batches carrying a
+            # chosen request)
+            spec = faults.fire(
+                "serve.harvest", model=str(batch.model),
+                rids=",".join(sorted({req.rid for req, _, _, _
+                                      in batch.segments})))
+            if spec is not None and spec.kind == "nonfinite":
+                lnl = np.array(lnl, copy=True)
+                lnl[:batch.n_real] = np.nan
+            finite = np.isfinite(np.asarray(lnl[:batch.n_real]))
+        accrue(st)
+        self._stage_event("harvest", str(batch.model), batch.bucket,
+                          st["dur_ms"], rids, trace_ids)
         if not finite.all():
             self._isolate(batch, lnl, finite)
             return
@@ -719,19 +973,34 @@ class ServeDriver:
                                      tenant=str(req.tenant)).inc()
         log.error("quarantined request %s (%s): %s", req.rid,
                   req.tenant, reason)
+        elapsed_ms = (profiling.monotonic() - req.t_submit) * 1e3
         from ..utils.flightrec import flight_recorder
         # forensics: the offending theta head, non-finite-safe (the
         # ring's dump encoder preserves NaN/Inf as strings)
         theta_head = [[float(v) if np.isfinite(v) else str(v)
                        for v in row] for row in req.thetas[:4]]
         flight_recorder().record(
-            "serve_quarantined", rid=req.rid, tenant=req.tenant,
+            "serve_quarantined", rid=req.rid,
+            trace_id=req.trace_id, tenant=req.tenant,
             model=str(req.model), reason=reason,
             theta_head=theta_head)
         self._tenant(req.tenant).event(
             "serve_quarantined", request_id=req.rid,
-            model=str(req.model), n_theta=req.n, reason=reason,
-            bucket=(batch.bucket if batch is not None else None))
+            trace_id=req.trace_id, model=str(req.model),
+            n_theta=req.n, reason=reason,
+            elapsed_ms=round(elapsed_ms, 3),
+            bucket=(batch.bucket if batch is not None else None),
+            **req.stage_fields())
+        self._slo_observe(req, elapsed_ms, ok=False)
+
+    def _slo_observe(self, req, elapsed_ms, ok):
+        """Fold one terminal outcome into the SLO engine (no-op
+        without declared objectives). Breach events land on the
+        DRIVER stream — objectives are an operator contract, not a
+        per-tenant payload."""
+        if self.slo is not None:
+            self.slo.observe(req.tenant, elapsed_ms, ok,
+                             emit=self.rec.event)
 
     def _finish(self, req, lnl, batch):
         del self._pending[req.rid]
@@ -740,24 +1009,31 @@ class ServeDriver:
         self.requests_done += 1
         latency_ms = (profiling.monotonic() - req.t_submit) * 1e3
         self._h_latency.observe(latency_ms)
-        ev = dict(request_id=req.rid, model=req.model, n_theta=req.n,
+        ev = dict(request_id=req.rid, trace_id=req.trace_id,
+                  model=req.model, n_theta=req.n,
                   latency_ms=round(latency_ms, 3),
                   bucket=batch.bucket,
                   batch_fill=round(batch.fill, 4),
-                  lnl_max=float(np.max(lnl)))
+                  lnl_max=float(np.max(lnl)),
+                  **req.stage_fields(latency_ms))
+        deadline_ok = True
         if req.deadline_ms is not None:
             # deadline accounting: the requested budget and whether
             # the result beat it (a completion can still miss — the
             # shed only happens at pack time)
+            deadline_ok = bool(latency_ms <= req.deadline_ms)
             ev["deadline_ms"] = req.deadline_ms
-            ev["deadline_met"] = bool(latency_ms <= req.deadline_ms)
+            ev["deadline_met"] = deadline_ok
         if req.n <= _INLINE_LNL_ROWS:
             ev["lnl"] = [float(v) for v in lnl]
         self._tenant(req.tenant).event("serve_result", **ev)
         self.request_log.append(
             {"rid": req.rid, "tenant": req.tenant, "model": req.model,
              "n": req.n, "latency_ms": round(latency_ms, 3),
-             "bucket": batch.bucket, "fill": round(batch.fill, 4)})
+             "bucket": batch.bucket, "fill": round(batch.fill, 4),
+             "trace_id": req.trace_id,
+             **req.stage_fields(latency_ms)})
+        self._slo_observe(req, latency_ms, ok=deadline_ok)
 
     # ------------------------- queue checkpoint -------------------- #
     @property
@@ -770,8 +1046,13 @@ class ServeDriver:
         (``io/writers.py:checkpoint_replace``): sha256 sidecar +
         last-good ``state.prev.npz`` rotation. Deadlines are stored
         as REMAINING budget so a restore re-arms them relative to the
-        restore instant. Model names must be strings (the CLI's
-        registry contract)."""
+        restore instant. Trace context is persisted too — the
+        request's ``trace_id``, already-elapsed wall, per-stage
+        accumulators, and requeue count — so a request's trace stays
+        ONE connected story across a kill/resume (the restoring
+        session back-dates ``t_submit`` by the elapsed wall;
+        docs/observability.md#request-tracing). Model names must be
+        strings (the CLI's registry contract)."""
         self._ckpt_touched = True
         reqs = [slot[2] for slot in self._pending.values()]
         if not reqs:
@@ -790,7 +1071,20 @@ class ServeDriver:
             rids=np.array([r.rid for r in reqs]),
             tenants=np.array([str(r.tenant) for r in reqs]),
             models=np.array([str(r.model) for r in reqs]),
-            deadline_rem_ms=rem, seq=self._seq)
+            deadline_rem_ms=rem, seq=self._seq,
+            trace_ids=np.array([r.trace_id for r in reqs]),
+            elapsed_ms=np.array([(now - r.t_submit) * 1e3
+                                 for r in reqs]),
+            # fold each request's still-open queue-wait window (the
+            # gap since its last accounted instant) into the
+            # persisted queue_ms WITHOUT mutating the live request —
+            # a checkpoint is an observation, not a stage boundary
+            stage_ms=np.array(
+                [[r.queue_ms + max(now - r.t_mark, 0.0) * 1e3,
+                  r.pack_ms, r.dispatch_ms, r.harvest_ms]
+                 for r in reqs]),
+            requeues=np.array([r.requeues for r in reqs],
+                              dtype=np.int64))
         checkpoint_replace(tmp, self._ckpt_path)
         self.rec.event("checkpoint", phase="serve_queue",
                        n=len(reqs))
@@ -801,9 +1095,16 @@ class ServeDriver:
         (digest-verified, last-good generation fallback). Call AFTER
         registering the models. Returns the number restored (0 when
         no restorable checkpoint exists). Restored requests keep
-        their rids (no new ``serve_request`` events — they were
-        announced by the session that accepted them); a request whose
-        model is no longer registered is recorded as rejected."""
+        their rids AND trace ids (no new ``serve_request`` events —
+        they were announced by the session that accepted them); a
+        request whose model is no longer registered is recorded as
+        rejected. ``t_submit`` is back-dated by the checkpointed
+        elapsed wall so the eventual ``latency_ms`` spans sessions
+        (inter-process downtime is excluded — the monotonic clock
+        does not cross processes); stage accumulators and the requeue
+        count carry over so the final decomposition still reconciles.
+        Pre-tracing checkpoints (no ``trace_ids`` key) restore with
+        fresh trace ids and zeroed accumulators."""
         self._ckpt_touched = True
         path = resolve_checkpoint(self._ckpt_path,
                                   what="serve queue checkpoint")
@@ -815,6 +1116,7 @@ class ServeDriver:
             self._seq = max(self._seq, int(z["seq"]))
             flat, shapes = z["flat"], z["shapes"]
             rem = z["deadline_rem_ms"]
+            has_trace = "trace_ids" in z.files
             offset = 0
             for i, rid in enumerate(str(x) for x in z["rids"]):
                 rows, ndim = int(shapes[i][0]), int(shapes[i][1])
@@ -853,6 +1155,21 @@ class ServeDriver:
                               else now + max(rem_ms, 0.0) / 1e3),
                     deadline_ms=(None if np.isnan(rem_ms)
                                  else rem_ms))
+                if has_trace:
+                    req.trace_id = str(z["trace_ids"][i])
+                    req.t_submit = \
+                        now - max(float(z["elapsed_ms"][i]), 0.0) / 1e3
+                    (req.queue_ms, req.pack_ms, req.dispatch_ms,
+                     req.harvest_ms) = [float(v)
+                                        for v in z["stage_ms"][i]]
+                    req.requeues = int(z["requeues"][i])
+                else:
+                    req.trace_id = uuid.uuid4().hex[:16]
+                req.t_enqueue = now
+                # attribution restarts here: inter-process downtime
+                # is excluded from every stage (monotonic clocks do
+                # not cross processes)
+                req.t_mark = now
                 self.queue.append(req)
                 self._pending[rid] = [np.empty(req.n,
                                                dtype=np.float64), 0,
@@ -863,6 +1180,7 @@ class ServeDriver:
         self.requests_seen += n
         self.restored_requests += n
         self._g_depth.set(len(self.queue))
+        self._hb_depth_max = max(self._hb_depth_max, len(self.queue))
         self.rec.event("checkpoint", phase="serve_restore", n=n)
         log.info("restored %d unfinished request(s) from %s", n,
                  path)
@@ -930,9 +1248,40 @@ class ServeDriver:
                            "p99": q(0.99),
                            "max": lat_sorted[-1] if lat_sorted
                            else None},
+            "decomposition": self._decomposition(),
+            "slo": (self.slo.summary() if self.slo is not None
+                    else None),
             "evals_per_s": round(self.meter.rate(), 1),
             "aot": self.cache.stats(),
         }
+
+    def _decomposition(self):
+        """Stage-latency decomposition over every completed request
+        (from ``request_log``): per-stage mean/p50/p95 plus the worst
+        reconciliation residual. ``other_ms`` is an EXPLICIT residual
+        (clamped at 0), so ``unaccounted_ms_max`` measures only the
+        rounding slack of the recorded fields — the sentinel ``slo``
+        gate holds it near zero. None before the first completion."""
+        if not self.request_log:
+            return None
+        stages = ("queue_ms", "pack_ms", "dispatch_ms", "harvest_ms",
+                  "other_ms")
+
+        def stats(vals):
+            vs = sorted(vals)
+            n = len(vs)
+            return {"mean": round(sum(vs) / n, 3),
+                    "p50": round(vs[min(n // 2, n - 1)], 3),
+                    "p95": round(vs[min(int(0.95 * n), n - 1)], 3)}
+
+        out = {s: stats([r.get(s, 0.0) for r in self.request_log])
+               for s in stages}
+        out["unaccounted_ms_max"] = round(
+            max(abs(r["latency_ms"]
+                    - sum(r.get(s, 0.0) for s in stages))
+                for r in self.request_log), 3)
+        out["n"] = len(self.request_log)
+        return out
 
     def close(self):
         """Flush the pipeline, close every tenant stream, and leave

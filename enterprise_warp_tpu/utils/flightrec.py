@@ -132,6 +132,19 @@ class FlightRecorder:
         items = list(self._ring)
         return items if n is None else items[-int(n):]
 
+    def trace_tail(self, trace_id: str, n: int | None = None):
+        """The ring records touching ONE request trace
+        (docs/observability.md#request-tracing): records carrying the
+        ``trace_id`` field directly (``serve_request`` /
+        ``serve_result`` / quarantine forensics) or listing it among a
+        stage event's ``trace_ids`` members — a quarantine postmortem
+        can pull the poisoned request's own lifecycle out of the ring
+        without replaying the whole stream."""
+        items = [r for r in self._ring
+                 if r.get("trace_id") == trace_id
+                 or trace_id in (r.get("trace_ids") or ())]
+        return items if n is None else items[-int(n):]
+
     # ---------------- lifecycle ------------------------------------- #
     def bind(self, run_dir: str):
         self.run_dir = run_dir
@@ -280,6 +293,9 @@ class _NoopFlightRecorder:
     record_event = note_state = record
 
     def tail(self, n=None):
+        return []
+
+    def trace_tail(self, trace_id, n=None):
         return []
 
     def bind(self, run_dir):

@@ -105,9 +105,34 @@ def _fmt(v) -> str:
     return repr(f)
 
 
+#: curated ``# HELP`` lines for the serve/SLO families — the metrics a
+#: scraping operator alerts on deserve self-describing expositions
+#: (docs/serving.md#slo); families not listed here export TYPE-only,
+#: as before
+_HELP = {
+    "ewt_slo_burn_rate":
+        "per-tenant SLO burn rate over the outcome window "
+        "(>1 = consuming error budget faster than the objective "
+        "allows)",
+    "ewt_slo_budget_remaining":
+        "per-tenant SLO error budget remaining (1 - burn rate; "
+        "negative = window already violates the objective)",
+    "ewt_slo_observed_p95_ms":
+        "observed p95 request latency over the tenant's SLO window",
+    "ewt_slo_observed_success":
+        "observed success fraction over the tenant's SLO window",
+    "ewt_serve_queue_depth":
+        "serve driver queue depth (requests waiting to pack)",
+    "ewt_serve_latency_ms":
+        "end-to-end serve request latency (submit to result)",
+}
+
+
 def openmetrics(snapshot: dict | None = None) -> str:
     """The registry snapshot as one OpenMetrics exposition (see module
-    docstring). ``snapshot`` defaults to the live registry."""
+    docstring). ``snapshot`` defaults to the live registry. Families
+    with a curated ``_HELP`` entry carry a ``# HELP`` line before
+    their ``# TYPE`` line."""
     snap = snapshot if snapshot is not None \
         else telemetry.registry().snapshot()
     # group samples per metric family so each family gets exactly one
@@ -149,6 +174,8 @@ def openmetrics(snapshot: dict | None = None) -> str:
 
     out = []
     for mname in sorted(families):
+        if mname in _HELP:
+            out.append(f"# HELP {mname} {_HELP[mname]}")
         out.append(f"# TYPE {mname} {families[mname]['type']}")
         out.extend(families[mname]["lines"])
     out.append("# EOF")

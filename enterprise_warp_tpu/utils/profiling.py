@@ -49,6 +49,7 @@ through :func:`monotonic`/:func:`walltime`/:func:`span`.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -56,11 +57,11 @@ import time
 
 from . import telemetry
 
-__all__ = ["spans_enabled", "span", "span_records", "reset_spans",
-           "flush_trace", "export_chrome_trace", "monotonic",
-           "walltime", "timeit", "memory_watermark", "host_rss_bytes",
-           "live_buffer_report", "capture_dir", "capture_arm",
-           "capture_tick", "capture_stop"]
+__all__ = ["spans_enabled", "span", "stage", "span_records",
+           "reset_spans", "flush_trace", "export_chrome_trace",
+           "monotonic", "walltime", "timeit", "memory_watermark",
+           "host_rss_bytes", "live_buffer_report", "capture_dir",
+           "capture_arm", "capture_tick", "capture_stop"]
 
 #: re-exported clocks — the package-wide timing primitives (see module
 #: docstring; everything outside telemetry.py/profiling.py uses these)
@@ -221,6 +222,36 @@ def span(name, device_sync=None, **attrs):
     if not spans_enabled():
         return _NOOP_SPAN
     return Span(name, device_sync=device_sync, **attrs)
+
+
+@contextlib.contextmanager
+def stage(name, **attrs):
+    """Measured stage window: always times the enclosed block
+    (host-side ``monotonic`` only — no device sync, no dispatch) and
+    ALSO opens a real :func:`span` when spans are enabled, so stage
+    walls land in the Chrome trace / ``span_ms`` histograms without
+    the caller timing twice.
+
+    Yields a ``{"name", "dur_ms", "t0", "t1"}`` box; ``dur_ms`` and
+    the window endpoints (``monotonic`` instants) are filled in
+    before the exception (if any) propagates to the caller, so an
+    except-clause around the ``with`` can still read the stage wall —
+    the serve driver's dispatch attribution relies on this, and its
+    gap-filling latency decomposition uses ``t0``/``t1`` to attribute
+    the wall BETWEEN a request's stage windows::
+
+        with profiling.stage("serve.dispatch", bucket=16) as st:
+            out = sup.call(thunk)
+        dur_ms = st["dur_ms"]
+    """
+    box = {"name": name, "dur_ms": None, "t0": monotonic(),
+           "t1": None}
+    try:
+        with span(name, **attrs):
+            yield box
+    finally:
+        box["t1"] = monotonic()
+        box["dur_ms"] = (box["t1"] - box["t0"]) * 1e3
 
 
 def span_records():

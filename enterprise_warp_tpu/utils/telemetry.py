@@ -61,10 +61,11 @@ import time
 import uuid
 
 __all__ = ["enabled", "registry", "MetricsRegistry", "Counter", "Gauge",
-           "Histogram", "traced", "RunRecorder", "run_scope",
-           "active_recorder", "dispatch_stats", "pallas_path_summary",
-           "cost_analysis_enabled", "set_flight_hook", "last_lineage",
-           "LINEAGE_REASONS", "compile_cache_stats", "watch_compile"]
+           "Histogram", "RingWindow", "traced", "RunRecorder",
+           "run_scope", "active_recorder", "dispatch_stats",
+           "pallas_path_summary", "cost_analysis_enabled",
+           "set_flight_hook", "last_lineage", "LINEAGE_REASONS",
+           "compile_cache_stats", "watch_compile"]
 
 
 def enabled() -> bool:
@@ -165,6 +166,60 @@ class Histogram:
                 "p50": self.quantile(0.5), "p90": self.quantile(0.9),
                 "p99": self.quantile(0.99),
                 "samples_dropped": self.samples_dropped}
+
+
+class RingWindow:
+    """Fixed-shape sliding window: a preallocated float64 ring buffer
+    of the last ``cap`` observations (the PR 10 host-side fixed-shape
+    accumulator discipline — push is one array store + cursor bump,
+    never an allocation, so a per-request observer adds no growing
+    host state to a multi-day serve run).
+
+    Unlike :class:`Histogram` (whole-run reservoir), a ring answers
+    *recent-window* questions — the SLO engine's burn rates are
+    defined over the last-N outcomes, not the lifetime distribution.
+    Quantiles over ≤ ``cap`` values are exact order statistics."""
+
+    __slots__ = ("_buf", "_cap", "_i", "count")
+
+    def __init__(self, cap: int = 256):
+        import numpy as np
+
+        self._cap = max(int(cap), 1)
+        self._buf = np.zeros(self._cap, dtype=np.float64)
+        self._i = 0
+        self.count = 0          # lifetime observations (>= window n)
+
+    @property
+    def n(self) -> int:
+        """Observations currently held (== cap once warmed up)."""
+        return min(self.count, self._cap)
+
+    def push(self, v):
+        self._buf[self._i] = float(v)
+        self._i = (self._i + 1) % self._cap
+        self.count += 1
+
+    def values(self):
+        """The held window as an array (oldest-first not guaranteed —
+        window statistics are order-free)."""
+        return self._buf[:self.n]
+
+    def mean(self):
+        import numpy as np
+
+        return float(np.mean(self.values())) if self.n else None
+
+    def quantile(self, q: float):
+        """Exact order-statistic quantile of the window (None when
+        empty) — same index convention as :class:`Histogram`."""
+        import numpy as np
+
+        if not self.n:
+            return None
+        s = np.sort(self.values())
+        q = min(max(float(q), 0.0), 1.0)
+        return float(s[min(int(q * self.n), self.n - 1)])
 
 
 class _NoopMetric:

@@ -137,6 +137,7 @@ def fold_campaign(root, now=None, stale_s=300.0):
             "queue_depth": term["queue_depth"],
             "batch_fill": term["batch_fill"],
             "requests_done": term["requests_done"],
+            "queue_age_ms": term["queue_age_ms"],
             "faults": counts["fault"],
             "retries": counts["retry"],
             "demotions": counts["demotion"],
@@ -235,10 +236,15 @@ def render(report, out=sys.stdout):
             rhat = f"~{r['rhat_stream']:.3f}"
         elif r.get("queue_depth") is not None:
             # serve-mode run_dir: the mixing column carries queue
-            # pressure instead (q<depth>/<fill>)
+            # pressure instead — q<depth>/<fill>, plus the oldest
+            # queued request's age when the queue is non-empty (the
+            # head-of-line starvation signal)
             fill = r.get("batch_fill")
+            age = r.get("queue_age_ms")
             rhat = f"q{r['queue_depth']}" + (
-                f"/{fill:.2f}" if fill is not None else "")
+                f"/{fill:.2f}" if fill is not None else "") + (
+                f"+{age / 1e3:.0f}s" if age is not None
+                and age >= 1000.0 else "")
         else:
             rhat = "-"
         flags = ("!" if r.get("anomaly") else "") \

@@ -1,0 +1,510 @@
+#!/usr/bin/env python
+# ewt: allow-no-print module — the serve console IS this tool's
+# product: it renders the per-tenant SLO table to stdout (report.py
+# contract); diagnostics go to stderr
+"""Serve observatory: the live per-tenant console for one serve root.
+
+``tools/campaign.py`` answers "how is the fleet?"; this tool answers
+"how is ONE serve driver treating its tenants?" — folding the driver
+stream (``<root>/events.jsonl``) and every tenant stream
+(``<root>/tenants/<tenant>/events.jsonl``) into:
+
+- queue pressure from the driver heartbeats (depth, interval
+  high-water, oldest-request age, shed rate, batch fill);
+- stage-latency quantiles from the ``serve_stage`` events (pack /
+  dispatch / harvest walls per batch) and the per-request
+  decomposition carried on ``serve_result``
+  (docs/observability.md#request-tracing);
+- per-tenant SLO burn rates **recounted host-side from the event
+  stream alone** (:func:`recount_burn` mirrors
+  ``serve/slo.py:SLOEngine`` exactly — same windowing, same order
+  statistics — so the console needs no live registry and the
+  acceptance test can pin the recount against the gauges). The
+  objectives come from the driver's ``slo_config`` announcement on
+  its own stream;
+- adversity annotations: quarantined requests, demotion requeues,
+  SLO breach episodes.
+
+Usage::
+
+    python tools/observatory.py out/serve              # one-shot
+    python tools/observatory.py out/serve --watch      # live console
+    python tools/observatory.py out/serve --check      # CI gate
+
+``--check`` exits non-zero unless the root passes the tracing
+contract: every stream is schema-clean (``report.py --check``
+vocabulary), every terminal event's ``trace_id`` connects back to a
+``serve_request`` on the same tenant stream (across sessions — the
+queue checkpoint carries trace ids), and every traced
+``serve_result`` decomposition reconciles
+(``queue+pack+dispatch+harvest+other == latency_ms`` within rounding
+slack).
+
+The JSON fold lands in ``<root>/observatory_report.json`` (atomic
+write, same discipline as the campaign report).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+# report.py owns event-stream parsing, the schema vocabulary, and the
+# package-free atomic JSON writer; this tool adds the per-tenant SLO
+# fold on top
+from report import (STAGE_FIELDS, _atomic_write_json,  # noqa: E402
+                    check_stream, load_events)
+
+#: default SLO window when the stream carries no ``slo_config``
+#: (mirrors serve/slo.py:DEFAULT_WINDOW without importing the —
+#: jax-adjacent — package)
+DEFAULT_WINDOW = 256
+
+#: allowed per-field rounding slack for the decomposition
+#: reconciliation check: six fields each rounded to 3 decimals
+RECONCILE_TOL_MS = 0.02
+
+
+# ------------------------------------------------------------------ #
+#  the host-side SLO recount (mirror of serve/slo.py)                  #
+# ------------------------------------------------------------------ #
+
+def effective_objective(objectives, tenant):
+    """Tenant's objective layered over ``default`` — the same merge
+    ``SLOEngine.objective_for`` applies."""
+    eff = dict((objectives or {}).get("default", {}))
+    eff.update((objectives or {}).get(str(tenant), {}))
+    return eff
+
+
+def _quantile(sorted_vals, q):
+    """The repo's exact order-statistic convention
+    (``telemetry.RingWindow.quantile`` / ``Histogram``)."""
+    n = len(sorted_vals)
+    if not n:
+        return None
+    return sorted_vals[min(int(q * n), n - 1)]
+
+
+def tenant_outcomes(events):
+    """One tenant stream's terminal outcomes ``(elapsed_ms, ok)`` in
+    stream order — the exact sequence the driver fed the live
+    engine: completions at ``latency_ms`` (ok iff they met their
+    deadline, when they carried one), deadline sheds at ``waited_ms``
+    and quarantines at ``elapsed_ms`` (both failures). Admission
+    rejections never count."""
+    out = []
+    for ev in events:
+        t = ev.get("type")
+        if t == "serve_result" and ev.get("latency_ms") is not None:
+            out.append((float(ev["latency_ms"]),
+                        ev.get("deadline_met") is not False))
+        elif t == "serve_expired" \
+                and ev.get("waited_ms") is not None:
+            out.append((float(ev["waited_ms"]), False))
+        elif t == "serve_quarantined" \
+                and ev.get("elapsed_ms") is not None:
+            out.append((float(ev["elapsed_ms"]), False))
+    return out
+
+
+def recount_burn(outcomes, objectives, window=DEFAULT_WINDOW):
+    """Recompute one tenant's burn rates from its outcome sequence —
+    the independent arithmetic the acceptance test pins against the
+    live ``slo_burn_rate`` gauges. ``outcomes`` is the
+    :func:`tenant_outcomes` list; only the last ``window`` entries
+    count (the ring). Returns ``{slo: {objective, observed,
+    burn_rate, budget_remaining}}`` (empty without objectives or
+    outcomes)."""
+    if not objectives or not outcomes:
+        return {}
+    win = outcomes[-max(int(window), 1):]
+    n = len(win)
+    lats = sorted(e for e, _ in win)
+    out = {}
+    if "p95_ms" in objectives:
+        thr = float(objectives["p95_ms"])
+        bad = sum(1 for e, _ in win if e > thr)
+        b = (bad / n) / 0.05
+        out["p95_ms"] = {"objective": thr,
+                         "observed": _quantile(lats, 0.95),
+                         "burn_rate": b,
+                         "budget_remaining": 1.0 - b}
+    if "success" in objectives:
+        target = float(objectives["success"])
+        bad = sum(1 for _, ok in win if not ok)
+        b = (bad / n) / max(1.0 - target, 1e-9)
+        out["success"] = {"objective": target,
+                          "observed": sum(1 for _, ok in win
+                                          if ok) / n,
+                          "burn_rate": b,
+                          "budget_remaining": 1.0 - b}
+    return out
+
+
+# ------------------------------------------------------------------ #
+#  the fold                                                            #
+# ------------------------------------------------------------------ #
+
+def _tenant_streams(root):
+    """``(tenant, stream path)`` pairs under ``<root>/tenants/``."""
+    tdir = os.path.join(root, "tenants")
+    if not os.path.isdir(tdir):
+        return []
+    out = []
+    for name in sorted(os.listdir(tdir)):
+        path = os.path.join(tdir, name, "events.jsonl")
+        if os.path.isfile(path):
+            out.append((name, path))
+    return out
+
+
+def _stage_quantiles(stage_events):
+    """Per-stage batch-wall quantiles from the driver's
+    ``serve_stage`` events."""
+    by_stage: dict = {}
+    for ev in stage_events:
+        if ev.get("dur_ms") is not None:
+            by_stage.setdefault(str(ev.get("stage", "?")),
+                                []).append(float(ev["dur_ms"]))
+    return {s: {"n": len(vs),
+                "p50": round(_quantile(sorted(vs), 0.5), 3),
+                "p95": round(_quantile(sorted(vs), 0.95), 3)}
+            for s, vs in sorted(by_stage.items())}
+
+
+def _fold_tenant(name, events, objectives, window):
+    """One tenant stream into its console row."""
+    by_type: dict = {}
+    for ev in events:
+        by_type.setdefault(ev.get("type"), []).append(ev)
+    results = by_type.get("serve_result", [])
+    lats = sorted(float(ev["latency_ms"]) for ev in results
+                  if ev.get("latency_ms") is not None)
+    staged = [ev for ev in results if ev.get("queue_ms") is not None]
+    stage_means = {
+        s: round(sum(float(ev.get(s) or 0.0) for ev in staged)
+                 / len(staged), 3)
+        for s in STAGE_FIELDS} if staged else None
+    obj = effective_objective(objectives, name)
+    outcomes = tenant_outcomes(events)
+    return {
+        "tenant": name,
+        "requests": len(by_type.get("serve_request", [])),
+        "results": len(results),
+        "rejected": len(by_type.get("serve_rejected", [])),
+        "expired": len(by_type.get("serve_expired", [])),
+        "quarantined": len(by_type.get("serve_quarantined", [])),
+        "quarantined_requests": sorted(
+            str(ev.get("request_id"))
+            for ev in by_type.get("serve_quarantined", [])) or None,
+        "deadline_missed": sum(
+            1 for ev in results
+            if ev.get("deadline_met") is False),
+        "latency_ms": {"p50": _quantile(lats, 0.5),
+                       "p95": _quantile(lats, 0.95),
+                       "max": lats[-1] if lats else None},
+        "stage_means_ms": stage_means,
+        "objectives": obj or None,
+        "slo": recount_burn(outcomes, obj, window) or None,
+        "outcomes": len(outcomes),
+    }
+
+
+def fold_observatory(root, now=None, stale_s=300.0):
+    """Fold one serve root (driver + tenant streams) into the
+    observatory report structure (see module docstring)."""
+    # ewt: allow-no-raw-timing — staleness is judged against the
+    # streams' unix-epoch 't' fields; this standalone console never
+    # loads the (jax-importing) profiling clocks
+    now = time.time() if now is None else now
+    driver_path = os.path.join(root, "events.jsonl")
+    devents, ddropped = ([], 0)
+    if os.path.isfile(driver_path):
+        devents, ddropped = load_events(driver_path)
+    by_type: dict = {}
+    for ev in devents:
+        by_type.setdefault(ev.get("type"), []).append(ev)
+    hbs = by_type.get("heartbeat", [])
+    hb = hbs[-1] if hbs else {}
+    cfg = (by_type.get("slo_config") or [{}])[-1]
+    objectives = cfg.get("objectives") or {}
+    window = int(cfg.get("window") or DEFAULT_WINDOW)
+    ended = bool(by_type.get("run_end"))
+    t_last = max((ev.get("t") or 0.0 for ev in devents),
+                 default=None)
+    status = ("done" if ended
+              else "running" if t_last is not None
+              and now - t_last <= stale_s
+              else "dead" if devents else "empty")
+    tenants = []
+    for name, path in _tenant_streams(root):
+        tevents, tdropped = load_events(path)
+        row = _fold_tenant(name, tevents, objectives, window)
+        row["dropped_lines"] = tdropped
+        tenants.append(row)
+    breaches = by_type.get("slo_breach", [])
+    requeues = by_type.get("serve_requeue", [])
+    summary = (by_type.get("serve_summary") or [None])[-1]
+    return {
+        "root": os.path.abspath(root),
+        "generated_unix": round(now, 3),
+        "status": status,
+        "driver": {
+            "queue_depth": hb.get("queue_depth"),
+            "queue_depth_max": hb.get("queue_depth_max"),
+            "queue_age_ms": hb.get("queue_age_ms"),
+            "shed_per_s": hb.get("shed_per_s"),
+            "batch_fill": hb.get("batch_fill"),
+            "requests_done": hb.get("requests_done"),
+            "evals_per_s": hb.get("evals_per_s"),
+            "heartbeats": len(hbs),
+            "dropped_lines": ddropped,
+            "summary": summary,
+        },
+        "stages": _stage_quantiles(by_type.get("serve_stage", [])),
+        "slo_config": ({"objectives": objectives, "window": window}
+                       if objectives else None),
+        "breaches": {
+            "episodes": len(breaches),
+            "last": breaches[-1] if breaches else None,
+        },
+        "requeues": {
+            "count": len(requeues),
+            "traces": sorted({str(ev.get("trace_id"))
+                              for ev in requeues}) or None,
+        },
+        "tenants": tenants,
+    }
+
+
+# ------------------------------------------------------------------ #
+#  the CI gate (--check)                                               #
+# ------------------------------------------------------------------ #
+
+def trace_problems(root, tol_ms=RECONCILE_TOL_MS):
+    """The tracing-contract violations in one serve root (empty list
+    = clean): schema-unclean streams, terminal events whose
+    ``trace_id`` no ``serve_request`` on the same tenant stream ever
+    announced (a broken trace — the checkpoint must carry ids across
+    sessions precisely so this cannot happen), and traced
+    ``serve_result`` decompositions that fail to reconcile against
+    ``latency_ms``."""
+    problems = []
+    streams = []
+    driver_path = os.path.join(root, "events.jsonl")
+    if os.path.isfile(driver_path):
+        streams.append(("driver", driver_path))
+    streams.extend(_tenant_streams(root))
+    for label, path in streams:
+        sink = io.StringIO()
+        n = check_stream(path, out=sink)
+        if n:
+            problems.append(
+                f"{label}: {n} schema problem(s) in {path}:\n"
+                + sink.getvalue().rstrip())
+    for label, path in streams:
+        if label == "driver":
+            continue
+        events, _ = load_events(path)
+        minted = {str(ev["trace_id"]) for ev in events
+                  if ev.get("type") == "serve_request"
+                  and ev.get("trace_id")}
+        for ev in events:
+            t = ev.get("type")
+            if t not in ("serve_result", "serve_expired",
+                         "serve_quarantined"):
+                continue
+            tid = ev.get("trace_id")
+            if not tid:
+                problems.append(
+                    f"{label}: {t} for {ev.get('request_id')} "
+                    "carries no trace_id")
+                continue
+            if str(tid) not in minted:
+                problems.append(
+                    f"{label}: {t} trace {tid} never announced by a "
+                    "serve_request on this stream (broken trace)")
+            if t == "serve_result" \
+                    and ev.get("queue_ms") is not None \
+                    and ev.get("latency_ms") is not None:
+                total = sum(float(ev.get(s) or 0.0)
+                            for s in STAGE_FIELDS)
+                resid = abs(float(ev["latency_ms"]) - total)
+                if resid > tol_ms:
+                    problems.append(
+                        f"{label}: trace {tid} decomposition off by "
+                        f"{resid:.3f}ms (latency "
+                        f"{ev['latency_ms']}ms vs stages "
+                        f"{total:.3f}ms)")
+    return problems
+
+
+# ------------------------------------------------------------------ #
+#  console rendering                                                   #
+# ------------------------------------------------------------------ #
+
+def _ms(v):
+    return f"{v:.1f}" if isinstance(v, (int, float)) else "-"
+
+
+def render(report, out=sys.stdout):
+    """The tenant table: queue pressure up top, one row per tenant,
+    adversity annotations below."""
+    def p(msg=""):
+        print(msg, file=out)
+
+    d = report["driver"]
+    p(f"serve root: {report['root']}  [{report['status']}]")
+    line = (f"queue: depth={d['queue_depth']}"
+            f" (max {d['queue_depth_max']})")
+    if d.get("queue_age_ms") is not None:
+        line += f" oldest {_ms(d['queue_age_ms'])}ms"
+    if d.get("shed_per_s") is not None:
+        line += f" shed {d['shed_per_s']}/s"
+    if d.get("batch_fill") is not None:
+        line += f" fill {d['batch_fill']}"
+    line += f" | done {d.get('requests_done')}"
+    br = report["breaches"]
+    if br["episodes"]:
+        line += f" | SLO BREACHES {br['episodes']}"
+    rq = report["requeues"]
+    if rq["count"]:
+        line += f" | requeues {rq['count']}"
+    p(line)
+    if report["stages"]:
+        p("stage walls (ms, p50/p95 per batch): "
+          + "  ".join(f"{s} {v['p50']}/{v['p95']}"
+                      for s, v in report["stages"].items()))
+    cfg = report.get("slo_config")
+    if cfg:
+        p("objectives (window "
+          + str(cfg["window"]) + "): "
+          + "; ".join(
+              f"{t}: " + ",".join(f"{k}={v}"
+                                  for k, v in sorted(o.items()))
+              for t, o in sorted(cfg["objectives"].items())))
+    p()
+    hdr = (f"{'tenant':12s} {'req':>5s} {'done':>5s} {'shed':>4s} "
+           f"{'quar':>4s} {'rej':>4s} {'p50ms':>8s} {'p95ms':>8s} "
+           f"{'q/p/d/h mean ms':>22s} {'burn:p95':>9s} "
+           f"{'burn:ok':>8s}")
+    p(hdr)
+    p("-" * len(hdr))
+    for t in report["tenants"]:
+        lat = t["latency_ms"]
+        sm = t.get("stage_means_ms")
+        stages = ("/".join(_ms(sm[s]) for s in
+                           ("queue_ms", "pack_ms", "dispatch_ms",
+                            "harvest_ms"))
+                  if sm else "-")
+        slo = t.get("slo") or {}
+
+        def burn(key):
+            v = slo.get(key)
+            if v is None:
+                return "-"
+            mark = "!" if v["burn_rate"] > 1.0 else ""
+            return f"{v['burn_rate']:.2f}{mark}"
+
+        p(f"{t['tenant'][:12]:12s} {t['requests']:>5d} "
+          f"{t['results']:>5d} {t['expired']:>4d} "
+          f"{t['quarantined']:>4d} {t['rejected']:>4d} "
+          f"{_ms(lat['p50']):>8s} {_ms(lat['p95']):>8s} "
+          f"{stages:>22s} {burn('p95_ms'):>9s} "
+          f"{burn('success'):>8s}")
+    notes = []
+    for t in report["tenants"]:
+        if t.get("quarantined_requests"):
+            notes.append(f"quarantined [{t['tenant']}]: "
+                         + ", ".join(t["quarantined_requests"]))
+    if rq.get("traces"):
+        notes.append("requeued traces (demotion): "
+                     + ", ".join(rq["traces"]))
+    if br.get("last"):
+        ev = br["last"]
+        notes.append(f"last breach: tenant={ev.get('tenant')} "
+                     f"slo={ev.get('slo')} "
+                     f"burn={ev.get('burn_rate')}")
+    if notes:
+        p()
+        for n in notes:
+            p(f"  ! {n}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fold one serve root's driver + tenant streams "
+                    "into observatory_report.json + a tenant console")
+    ap.add_argument("root", help="serve run directory (the driver's "
+                                 "root)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="report path (default "
+                         "<root>/observatory_report.json)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="write the JSON report only, no console")
+    ap.add_argument("--watch", action="store_true",
+                    help="live mode: re-scan and re-render until "
+                         "interrupted")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="watch refresh seconds (default 5)")
+    ap.add_argument("--stale-s", type=float, default=300.0,
+                    help="seconds without driver events before a "
+                         "run with no run_end counts as dead")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: exit non-zero unless every stream "
+                         "is schema-clean, every trace connects, and "
+                         "every decomposition reconciles")
+    ap.add_argument("--tol-ms", type=float,
+                    default=RECONCILE_TOL_MS,
+                    help="decomposition reconciliation tolerance "
+                         f"(default {RECONCILE_TOL_MS}ms)")
+    opts = ap.parse_args(argv)
+
+    if not os.path.isdir(opts.root):
+        print(f"no serve root at {opts.root}", file=sys.stderr)
+        return 2
+    out_path = opts.output or os.path.join(opts.root,
+                                           "observatory_report.json")
+    while True:
+        report = fold_observatory(opts.root, stale_s=opts.stale_s)
+        _atomic_write_json(out_path, report)
+        if not opts.quiet:
+            if opts.watch:
+                # cursor home, overdraw in place, erase the previous
+                # frame's remainder — no blank-flicker (campaign.py
+                # convention)
+                sys.stdout.write("\x1b[H")
+            render(report)
+            print(f"report: {out_path}"
+                  + (f"  (refresh {opts.interval}s, ctrl-c to stop)"
+                     if opts.watch else ""))
+            if opts.watch:
+                sys.stdout.write("\x1b[0J")
+                sys.stdout.flush()
+        if not opts.watch:
+            break
+        try:
+            time.sleep(max(opts.interval, 0.2))
+        except KeyboardInterrupt:
+            break
+    if opts.check:
+        problems = trace_problems(opts.root, tol_ms=opts.tol_ms)
+        for prob in problems:
+            print(f"CHECK: {prob}", file=sys.stderr)
+        if problems:
+            print(f"{len(problems)} tracing-contract problem(s)",
+                  file=sys.stderr)
+            return 1
+        print("tracing contract: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

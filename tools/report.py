@@ -68,6 +68,13 @@ KNOWN_EVENT_TYPES = frozenset({
     # deadline sheds, and poison quarantines
     "serve_request", "serve_result", "serve_summary",
     "serve_rejected", "serve_expired", "serve_quarantined",
+    # request-tracing + SLO plane (docs/observability.md
+    # #request-tracing): per-stage batch events (pack/dispatch/
+    # harvest with the member trace ids), demotion requeues,
+    # edge-triggered per-tenant SLO breach episodes, and the driver's
+    # declared-objective announcement (makes the stream
+    # self-describing for the observatory's burn recount)
+    "serve_stage", "serve_requeue", "slo_breach", "slo_config",
     # checkpoint integrity generations (io/writers.py,
     # docs/resilience.md): a digest-verification failure at restore
     "ckpt_corrupt",
@@ -101,8 +108,11 @@ KNOWN_HEARTBEAT_FIELDS = frozenset({
     "scale_min", "scale_max", "budget_exhaust_frac",
     "first_accept_frac",
     # serving layer (queue pressure + packing efficiency + shed
-    # accounting)
-    "queue_depth", "batch_fill", "dispatches", "requests_done",
+    # accounting; ``queue_depth_max`` is the interval high-water,
+    # ``queue_age_ms`` the oldest queued request's wait,
+    # ``shed_per_s`` the interval deadline-shed rate)
+    "queue_depth", "queue_depth_max", "queue_age_ms", "shed_per_s",
+    "batch_fill", "dispatches", "requests_done",
     "requests_rejected", "requests_expired", "requests_quarantined",
     # VI / CEM drivers
     "elbo", "best_lnpost", "is_ess",
@@ -196,7 +206,7 @@ def fold_segments(events, stream=None):
                 "evals_total": None, "rhat": None, "ess": None,
                 "rhat_stream": None, "ess_stream": None,
                 "queue_depth": None, "batch_fill": None,
-                "requests_done": None}
+                "requests_done": None, "queue_age_ms": None}
 
     for ev in events:
         t = ev.get("type")
@@ -223,7 +233,8 @@ def fold_segments(events, stream=None):
             c["heartbeat"] += 1
             for k in ("step", "nsamp", "evals_per_s", "evals_total",
                       "rhat", "ess", "rhat_stream", "ess_stream",
-                      "queue_depth", "batch_fill", "requests_done"):
+                      "queue_depth", "batch_fill", "requests_done",
+                      "queue_age_ms"):
                 if ev.get(k) is not None:
                     cur[k] = ev[k]
             # nested heartbeats carry 'iteration', never 'step' — the
@@ -534,19 +545,29 @@ def _fold_integrity(by_type):
     }
 
 
+#: the ``serve_result`` latency-decomposition vocabulary
+#: (docs/observability.md#request-tracing): host-wall stage
+#: accumulators plus the explicit residual, summing to ``latency_ms``
+STAGE_FIELDS = ("queue_ms", "pack_ms", "dispatch_ms", "harvest_ms",
+                "other_ms")
+
+
 def _fold_serve(by_type):
     """Serving-layer fold: per-request ``serve_result`` events (a
-    tenant stream, or a driver stream's roll-up) into request counts
-    and a latency profile. None when the stream carries no serve
-    traffic."""
+    tenant stream, or a driver stream's roll-up) into request counts,
+    a latency profile, the stage-latency decomposition, trace
+    coverage, and the SLO-breach episode roll-up. None when the
+    stream carries no serve traffic."""
     results = by_type.get("serve_result", [])
     requests = by_type.get("serve_request", [])
     summaries = by_type.get("serve_summary", [])
     rejected = by_type.get("serve_rejected", [])
     expired = by_type.get("serve_expired", [])
     quarantined = by_type.get("serve_quarantined", [])
+    breaches = by_type.get("slo_breach", [])
+    requeues = by_type.get("serve_requeue", [])
     if not (results or requests or summaries or rejected or expired
-            or quarantined):
+            or quarantined or breaches):
         return None
     lats = sorted(float(ev["latency_ms"]) for ev in results
                   if ev.get("latency_ms") is not None)
@@ -584,6 +605,9 @@ def _fold_serve(by_type):
             1 for ev in results if ev.get("deadline_met") is False),
         "latency_ms": {"p50": q(0.5), "p90": q(0.9), "p99": q(0.99),
                        "max": lats[-1] if lats else None},
+        "decomposition": _fold_decomposition(results),
+        "trace": _fold_trace(requests, results, requeues),
+        "slo": _fold_slo(breaches),
     }
     if summaries:
         s = summaries[-1]
@@ -598,6 +622,76 @@ def _fold_serve(by_type):
                                   "dispatch_reduction",
                                   "mean_batch_fill")}
     return out
+
+
+def _fold_decomposition(results):
+    """Stage-latency decomposition over the stream's ``serve_result``
+    events: per-stage mean/p95 plus the worst reconciliation residual
+    (``|latency_ms - sum(stages)|`` — held near zero by the explicit
+    ``other_ms`` residual; the sentinel ``slo`` gate ceilings it).
+    None when no result carries stage fields (pre-tracing stream)."""
+    staged = [ev for ev in results if ev.get("queue_ms") is not None]
+    if not staged:
+        return None
+
+    def stats(vals):
+        vs = sorted(vals)
+        n = len(vs)
+        return {"mean": round(sum(vs) / n, 3),
+                "p95": round(vs[min(int(0.95 * n), n - 1)], 3)}
+
+    out = {s: stats([float(ev.get(s) or 0.0) for ev in staged])
+           for s in STAGE_FIELDS}
+    out["unaccounted_ms_max"] = round(
+        max(abs(float(ev["latency_ms"])
+                - sum(float(ev.get(s) or 0.0) for s in STAGE_FIELDS))
+            for ev in staged if ev.get("latency_ms") is not None),
+        3)
+    out["n"] = len(staged)
+    return out
+
+
+def _fold_trace(requests, results, requeues):
+    """Trace-coverage fold: every ``serve_result`` should carry a
+    ``trace_id`` that some ``serve_request`` announced (possibly in a
+    PREVIOUS session — cross-session orphans are expected on a
+    resumed tenant stream, so orphans are reported, not failed
+    here; ``tools/observatory.py --check`` does the strict
+    whole-campaign connectivity check). None on a pre-tracing
+    stream."""
+    minted = {str(ev["trace_id"]) for ev in requests
+              if ev.get("trace_id")}
+    finished = [str(ev["trace_id"]) for ev in results
+                if ev.get("trace_id")]
+    if not minted and not finished and not requeues:
+        return None
+    return {
+        "minted": len(minted),
+        "finished": len(finished),
+        "orphan_results": sorted(
+            {t for t in finished if t not in minted}) or None,
+        "requeues": len(requeues),
+        "requeued_traces": sorted(
+            {str(ev.get("trace_id")) for ev in requeues}) or None,
+    }
+
+
+def _fold_slo(breaches):
+    """SLO-breach fold: edge-triggered ``slo_breach`` events grouped
+    ``tenant -> slo -> episode count`` with the worst observed burn
+    rate. None when the stream carries no breaches."""
+    if not breaches:
+        return None
+    tenants: dict = {}
+    worst = 0.0
+    for ev in breaches:
+        t = str(ev.get("tenant", "?"))
+        slo = str(ev.get("slo", "?"))
+        tenants.setdefault(t, {})[slo] = \
+            tenants.get(t, {}).get(slo, 0) + 1
+        worst = max(worst, float(ev.get("burn_rate") or 0.0))
+    return {"episodes": len(breaches), "tenants": tenants,
+            "worst_burn_rate": round(worst, 4)}
 
 
 def load_postmortem(run_dir):
@@ -702,6 +796,21 @@ def _human_summary(report, out=sys.stdout):
                      f"{ds['dispatch_reduction']}x vs sequential, "
                      f"fill {ds['mean_batch_fill']}")
         p(line)
+        dec = sv.get("decomposition")
+        if dec:
+            p("  stage means: " + " + ".join(
+                f"{s.replace('_ms', '')} {dec[s]['mean']}ms"
+                for s in STAGE_FIELDS)
+                + f" (worst unaccounted {dec['unaccounted_ms_max']}ms"
+                  f" over {dec['n']} traced)")
+        slo = sv.get("slo")
+        if slo:
+            p(f"  SLO: {slo['episodes']} breach episode(s), worst "
+              f"burn {slo['worst_burn_rate']} ["
+              + "; ".join(
+                  f"{t}: " + ",".join(f"{s}x{n}"
+                                      for s, n in sorted(d.items()))
+                  for t, d in sorted(slo["tenants"].items())) + "]")
     integ = report.get("integrity")
     if integ:
         bits = []

@@ -25,6 +25,11 @@ telemetry stream) into ``TRENDS.json`` and applies threshold gates:
   must keep its cold/warm first-result amortization, its batched
   dispatch reduction, a warm p50 latency ceiling, zero dropped
   requests, and packed-vs-single-job bit-equality;
+- ``slo``               — BENCH_SERVE.json's request-level latency
+  decomposition (docs/observability.md) must be present, reconcile
+  against ``latency_ms`` with near-zero unaccounted slack, keep the
+  explicit ``other_ms`` residual a rounding artifact, and hold a
+  dispatch-stage p50 ceiling on the warm batched trace;
 - ``scale``             — BENCH_SCALE.json's pulsar-axis scaling
   curves must hold the strong-scaling cost-model efficiency floor at
   the widest mesh, show exactly one all-reduce per sharded
@@ -466,6 +471,94 @@ def gate_serve(bench_dir, min_warm_speedup=10.0, min_dispatch_red=8.0,
         dispatch_reduction=red, p50_ms=p50)
 
 
+_SLO_STAGES = ("queue_ms", "pack_ms", "dispatch_ms", "harvest_ms",
+               "other_ms")
+
+
+def gate_slo(bench_dir, max_unaccounted_ms=1.0, max_other_p95_ms=50.0,
+             max_dispatch_p50_ms=250.0):
+    """Latency-attribution gates from BENCH_SERVE.json's
+    ``trace.decomposition`` (the request-tracing plane,
+    docs/observability.md):
+
+    - **decomposition present** — the batched trace must carry the
+      per-stage (queue/pack/dispatch/harvest + explicit ``other_ms``
+      residual) mean/p50/p95 record; a BENCH_SERVE.json without it
+      predates the tracing plane and fails (rerun ``bench.py
+      --serve``);
+    - **zero unaccounted latency** — ``unaccounted_ms_max`` (the
+      worst per-request |latency - sum(stages)| residual AFTER the
+      explicit ``other_ms`` bucket) must stay under
+      ``max_unaccounted_ms``: every measured millisecond is
+      attributed to a named stage or the declared residual;
+    - **residual stays a rounding artifact** — ``other_ms`` p95 must
+      hold ``max_other_p95_ms``; growth here means a new wall
+      (compile, head-of-line, pipeline defer) opened up that the
+      stage windows no longer cover;
+    - **dispatch p50 ceiling** — warm batched-trace dispatch-stage
+      p50 must hold ``max_dispatch_p50_ms`` (the stage-level
+      counterpart of the serve gate's end-to-end warm p50 ceiling);
+    - **coverage** — the decomposition's ``n`` must equal the
+      trace's ``requests_done`` (every completed request is in the
+      sample, not a survivor subset).
+    """
+    doc = _load_json(os.path.join(bench_dir, "BENCH_SERVE.json"))
+    if not doc:
+        return _gate("slo", "warn", "no BENCH_SERVE.json record")
+    trace = doc.get("trace") or {}
+    dec = trace.get("decomposition")
+    if not isinstance(dec, dict):
+        return _gate(
+            "slo", "fail",
+            "BENCH_SERVE.json trace lacks the stage decomposition — "
+            "the record predates the tracing plane; rerun "
+            "bench.py --serve")
+    problems = []
+    for stage in _SLO_STAGES:
+        rec = dec.get(stage)
+        if not isinstance(rec, dict) or any(
+                rec.get(k) is None for k in ("mean", "p50", "p95")):
+            problems.append(f"decomposition lacks {stage} "
+                            "mean/p50/p95")
+    unacc = dec.get("unaccounted_ms_max")
+    if unacc is None:
+        problems.append("decomposition lacks unaccounted_ms_max")
+    elif unacc > max_unaccounted_ms:
+        problems.append(
+            f"unaccounted_ms_max {unacc} ms > ceiling "
+            f"{max_unaccounted_ms} ms (stage spans no longer "
+            "reconcile against latency_ms)")
+    other_p95 = (dec.get("other_ms") or {}).get("p95")
+    if other_p95 is not None and other_p95 > max_other_p95_ms:
+        problems.append(
+            f"other_ms p95 {other_p95} ms > ceiling "
+            f"{max_other_p95_ms} ms (an unattributed wall opened "
+            "between the stage windows)")
+    disp_p50 = (dec.get("dispatch_ms") or {}).get("p50")
+    if disp_p50 is not None and disp_p50 > max_dispatch_p50_ms:
+        problems.append(
+            f"dispatch_ms p50 {disp_p50} ms > ceiling "
+            f"{max_dispatch_p50_ms} ms on the warm batched trace")
+    n = dec.get("n")
+    done = trace.get("requests_done")
+    if n is not None and done is not None and n != done:
+        problems.append(
+            f"decomposition covers {n} request(s) but the trace "
+            f"completed {done} — the sample is a survivor subset")
+    if problems:
+        return _gate("slo", "fail", "; ".join(problems),
+                     unaccounted_ms_max=unacc, other_p95_ms=other_p95,
+                     dispatch_p50_ms=disp_p50)
+    return _gate(
+        "slo", "pass",
+        f"unaccounted {unacc} ms (ceiling {max_unaccounted_ms}), "
+        f"other_ms p95 {other_p95} ms (ceiling {max_other_p95_ms}), "
+        f"dispatch p50 {disp_p50} ms (ceiling {max_dispatch_p50_ms}),"
+        f" {n} request(s) fully attributed",
+        unaccounted_ms_max=unacc, other_p95_ms=other_p95,
+        dispatch_p50_ms=disp_p50)
+
+
 def gate_integrity(bench_dir):
     """Numerical-integrity gates from CHAOS.json's ``integrity``
     section (written by ``tools/chaos.py --integrity`` —
@@ -773,6 +866,18 @@ def main(argv=None):
                     default=250.0,
                     help="serve warm p50 request-latency ceiling in "
                          "ms (default 250, CPU-honest)")
+    ap.add_argument("--max-unaccounted-ms", type=float, default=1.0,
+                    help="ceiling on the serve trace's worst "
+                         "per-request latency-reconciliation "
+                         "residual in ms (default 1.0)")
+    ap.add_argument("--max-other-p95-ms", type=float, default=50.0,
+                    help="ceiling on the serve trace's other_ms "
+                         "(explicit unattributed residual) p95 in ms "
+                         "(default 50)")
+    ap.add_argument("--max-slo-dispatch-p50-ms", type=float,
+                    default=250.0,
+                    help="warm batched-trace dispatch-stage p50 "
+                         "ceiling in ms (default 250, CPU-honest)")
     ap.add_argument("--min-scale-eff", type=float, default=0.6,
                     help="strong-scaling cost-model efficiency floor "
                          "at the widest mesh (default 0.6, the "
@@ -811,6 +916,10 @@ def main(argv=None):
                    min_warm_speedup=opts.min_serve_warm_speedup,
                    min_dispatch_red=opts.min_serve_dispatch_red,
                    max_warm_p50_ms=opts.max_serve_warm_p50_ms),
+        gate_slo(opts.bench_dir,
+                 max_unaccounted_ms=opts.max_unaccounted_ms,
+                 max_other_p95_ms=opts.max_other_p95_ms,
+                 max_dispatch_p50_ms=opts.max_slo_dispatch_p50_ms),
         gate_integrity(opts.bench_dir),
         gate_scale(opts.bench_dir,
                    min_strong_eff=opts.min_scale_eff,
@@ -840,6 +949,9 @@ def main(argv=None):
             "min_serve_warm_speedup": opts.min_serve_warm_speedup,
             "min_serve_dispatch_red": opts.min_serve_dispatch_red,
             "max_serve_warm_p50_ms": opts.max_serve_warm_p50_ms,
+            "max_unaccounted_ms": opts.max_unaccounted_ms,
+            "max_other_p95_ms": opts.max_other_p95_ms,
+            "max_slo_dispatch_p50_ms": opts.max_slo_dispatch_p50_ms,
             "min_scale_eff": opts.min_scale_eff,
             "min_scale_npsr": opts.min_scale_npsr,
             "max_retraces": opts.max_retraces,
