@@ -150,6 +150,11 @@ class Params:
             # objectives for serve/slo.py:SLOEngine;
             # docs/serving.md#slo)
             "serve:": ["serve", str],
+            # amortized-posterior serving (docs/flows.md): trained
+            # flow artifacts registered as first-class serve models —
+            # whitespace-separated NAME=PATH[:MODE] tokens, MODE in
+            # {sample, log_prob} (default sample)
+            "flow_models:": ["flow_models", str],
             # numerical-integrity plane (docs/resilience.md): the
             # ingestion-gate repair policy ('none' quarantines on hard
             # findings, 'drop' drops offending rows with provenance)

@@ -1,0 +1,22 @@
+"""Amortized posteriors: pure-JAX normalizing-flow surrogates.
+
+A coupling flow (`flows.coupling`) trained by maximum likelihood on
+sampler draws (`flows.train`) becomes a durable artifact
+(`flows.model.FlowPosterior`) that serves posterior queries as AOT
+forward passes behind `ServeDriver`, ships with an exact-likelihood
+importance-sampling audit (`flows.rescore`), and powers the
+MH-corrected ``flow`` proposal family in `samplers/ptmcmc.py`. See
+docs/flows.md for the full contract.
+"""
+
+from .coupling import (FlowSpec, flow_forward, flow_inverse, flow_log_prob,
+                       flow_sample_logq, init_flow)
+from .model import FlowPosterior, FlowServeModel
+from .rescore import rescore_flow
+from .train import fit_flow
+
+__all__ = [
+    "FlowSpec", "init_flow", "flow_forward", "flow_inverse",
+    "flow_log_prob", "flow_sample_logq", "fit_flow",
+    "FlowPosterior", "FlowServeModel", "rescore_flow",
+]

@@ -1,0 +1,351 @@
+"""Pure-JAX conditional coupling flows (RealNVP affine + RQ-spline).
+
+The amortized-posterior surrogate (PAPERS.md: flow-based PTA inference,
+arXiv:2310.12209; VI for PTA parameter estimation, arXiv:2405.08857)
+is a stack of coupling layers with fixed permutations mapping a
+standard-normal latent ``u`` to parameter space ``x = T(u)``. Every
+transform here is a pure function over an explicit params pytree — no
+framework state, no external dependencies — so the same code path
+serves training (`flows.train`), serving (`flows.model` behind
+`ServeDriver`) and the MH-corrected proposal family in
+`samplers/ptmcmc.py`.
+
+Two coupling kinds:
+
+- ``affine`` — RealNVP shift-and-scale with a tanh-bounded log-scale
+  (``s = s_cap * tanh(raw / s_cap)``) so a half-trained conditioner
+  cannot blow the Jacobian up.
+- ``rqs`` — monotonic rational-quadratic splines (Durkan et al.,
+  arXiv:1906.04032) on ``[-tail_bound, tail_bound]`` with identity
+  tails; analytic forward AND inverse, so ``log_prob`` and ``sample``
+  are both one pass.
+
+Conditioners are small tanh MLPs whose final layer is zero-initialized:
+an untrained flow is exactly the standardization affine layer, which
+keeps early training steps and identity-init tests well behaved. An
+optional context vector is concatenated onto the conditioner input for
+amortization across data sets.
+
+All functions take a single parameter vector; batch with ``jax.vmap``
+(that is what `samplers/evalproto.py:install_protocol` does for the
+serve wrappers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "FlowSpec", "init_flow", "set_standardization",
+    "flow_forward", "flow_inverse", "flow_log_prob", "flow_sample_logq",
+    "spec_to_json", "spec_from_json", "base_logpdf",
+]
+
+# softplus(raw + _DERIV_SHIFT) == 1 at raw == 0: zero-initialized
+# conditioners yield unit interior derivatives, i.e. an identity spline
+_DERIV_SHIFT = float(np.log(np.e - 1.0))
+_MIN_BIN = 1e-3
+_MIN_DERIV = 1e-4
+
+
+@dataclasses.dataclass(frozen=True)
+class FlowSpec:
+    """Static architecture of a coupling flow (hashable, JSON-round-trippable).
+
+    ``perms`` holds one fixed permutation per layer as a tuple of ints;
+    under jit they become constants, so no params-pytree leaf is ever an
+    integer array (Adam only sees float leaves).
+    """
+
+    ndim: int
+    n_layers: int
+    hidden: int
+    kind: str = "affine"          # "affine" | "rqs"
+    context_dim: int = 0
+    n_bins: int = 8
+    tail_bound: float = 5.0
+    s_cap: float = 4.0
+    perms: tuple = ()
+
+    @property
+    def d1(self) -> int:
+        return self.ndim // 2
+
+    @property
+    def d2(self) -> int:
+        return self.ndim - self.d1
+
+    @property
+    def arch_token(self) -> str:
+        """Stable architecture digest input (order-sensitive, versioned)."""
+        return ("cflow-v1;ndim=%d;layers=%d;hidden=%d;kind=%s;ctx=%d;"
+                "bins=%d;tail=%g;scap=%g;perms=%s"
+                % (self.ndim, self.n_layers, self.hidden, self.kind,
+                   self.context_dim, self.n_bins, self.tail_bound,
+                   self.s_cap, self.perms))
+
+
+def spec_to_json(spec: FlowSpec) -> str:
+    return json.dumps(dataclasses.asdict(spec))
+
+
+def spec_from_json(text: str) -> FlowSpec:
+    d = json.loads(text)
+    d["perms"] = tuple(tuple(int(i) for i in p) for p in d["perms"])
+    return FlowSpec(**d)
+
+
+def _conditioner_out_dim(spec: FlowSpec) -> int:
+    if spec.kind == "affine":
+        return 2 * spec.d2
+    if spec.kind == "rqs":
+        return spec.d2 * (3 * spec.n_bins - 1)
+    raise ValueError(f"unknown coupling kind {spec.kind!r}")
+
+
+def init_flow(key, ndim, n_layers=6, hidden=64, context_dim=0,
+              kind="affine", n_bins=8, tail_bound=5.0, s_cap=4.0):
+    """Build a flow: returns ``(spec, params)``.
+
+    ``params`` is a pytree of float64 leaves only (loc/log_scale
+    standardization plus per-layer MLP weights); ``spec`` carries every
+    static choice including the fixed permutations.
+    """
+    ndim = int(ndim)
+    if ndim < 2:
+        raise ValueError("coupling flows need ndim >= 2 "
+                         f"(got {ndim}); use a KDE/analytic surrogate "
+                         "for 1-D posteriors")
+    rng = np.random.default_rng(
+        int(jax.random.randint(key, (), 0, np.iinfo(np.int32).max)))
+    perms = []
+    for i in range(n_layers):
+        if i % 2 == 0:
+            perms.append(tuple(range(ndim - 1, -1, -1)))   # reversal
+        else:
+            perms.append(tuple(int(v) for v in rng.permutation(ndim)))
+    spec = FlowSpec(ndim=ndim, n_layers=int(n_layers), hidden=int(hidden),
+                    kind=str(kind), context_dim=int(context_dim),
+                    n_bins=int(n_bins), tail_bound=float(tail_bound),
+                    s_cap=float(s_cap), perms=tuple(perms))
+    out_dim = _conditioner_out_dim(spec)
+    in_dim = spec.d1 + spec.context_dim
+    layers = []
+    for _ in range(n_layers):
+        # He-ish init for the tanh trunk; zero final layer => identity
+        w1 = rng.standard_normal((in_dim, hidden)) / np.sqrt(max(in_dim, 1))
+        w2 = rng.standard_normal((hidden, hidden)) / np.sqrt(hidden)
+        layers.append({
+            "w1": jnp.asarray(w1, dtype=jnp.float64),
+            "b1": jnp.zeros(hidden, dtype=jnp.float64),
+            "w2": jnp.asarray(w2, dtype=jnp.float64),
+            "b2": jnp.zeros(hidden, dtype=jnp.float64),
+            "w3": jnp.zeros((hidden, out_dim), dtype=jnp.float64),
+            "b3": jnp.zeros(out_dim, dtype=jnp.float64),
+        })
+    params = {
+        "loc": jnp.zeros(ndim, dtype=jnp.float64),
+        "log_scale": jnp.zeros(ndim, dtype=jnp.float64),
+        "layers": tuple(layers),
+    }
+    return spec, params
+
+
+def set_standardization(params, mean, std):
+    """Fold data moments into the outermost affine layer.
+
+    ``x = loc + exp(log_scale) * y`` is the last forward step, so a
+    freshly initialized flow already maps N(0, I) onto the training
+    corpus' per-dimension moments.
+    """
+    std = np.maximum(np.asarray(std, dtype=np.float64), 1e-12)
+    return dict(params,
+                loc=jnp.asarray(np.asarray(mean, dtype=np.float64)),
+                log_scale=jnp.asarray(np.log(std)))
+
+
+def _mlp(lp, inp):
+    h = jnp.tanh(inp @ lp["w1"] + lp["b1"])
+    h = jnp.tanh(h @ lp["w2"] + lp["b2"])
+    return h @ lp["w3"] + lp["b3"]
+
+
+def _cond_input(spec, va, context):
+    if spec.context_dim:
+        if context is None:
+            raise ValueError("flow was built with context_dim="
+                             f"{spec.context_dim} but no context given")
+        return jnp.concatenate([va, context])
+    return va
+
+
+# ---------------------------------------------------------------- affine
+
+def _affine_split(spec, raw):
+    raw_s, t = raw[:spec.d2], raw[spec.d2:]
+    s = spec.s_cap * jnp.tanh(raw_s / spec.s_cap)
+    return s, t
+
+
+# ------------------------------------------------------------ RQ splines
+
+def _rqs_knots(spec, raw):
+    """Per-dim spline knots from raw conditioner output.
+
+    raw: (d2 * (3K - 1),) -> xk, yk: (d2, K+1); dk: (d2, K+1) with
+    boundary derivatives pinned to 1 (C1 match with the identity tails).
+    """
+    k = spec.n_bins
+    b = spec.tail_bound
+    raw = raw.reshape(spec.d2, 3 * k - 1)
+    rw, rh, rd = raw[:, :k], raw[:, k:2 * k], raw[:, 2 * k:]
+    w = jax.nn.softmax(rw, axis=-1)
+    w = _MIN_BIN + (1.0 - _MIN_BIN * k) * w
+    h = jax.nn.softmax(rh, axis=-1)
+    h = _MIN_BIN + (1.0 - _MIN_BIN * k) * h
+    xk = -b + 2.0 * b * jnp.concatenate(
+        [jnp.zeros((spec.d2, 1)), jnp.cumsum(w, axis=-1)], axis=-1)
+    yk = -b + 2.0 * b * jnp.concatenate(
+        [jnp.zeros((spec.d2, 1)), jnp.cumsum(h, axis=-1)], axis=-1)
+    d_int = _MIN_DERIV + jax.nn.softplus(rd + _DERIV_SHIFT)
+    ones = jnp.ones((spec.d2, 1))
+    dk = jnp.concatenate([ones, d_int, ones], axis=-1)
+    return xk, yk, dk
+
+
+def _rqs_fwd_scalar(x, xk, yk, dk, b):
+    """Monotone RQ spline y(x) and log dy/dx for one scalar, one dim."""
+    inside = (x > -b) & (x < b)
+    xc = jnp.clip(x, -b, b)
+    k = jnp.clip(jnp.searchsorted(xk, xc, side="right") - 1, 0, xk.shape[0] - 2)
+    x0, x1 = xk[k], xk[k + 1]
+    y0, y1 = yk[k], yk[k + 1]
+    d0, d1 = dk[k], dk[k + 1]
+    wid = x1 - x0
+    hei = y1 - y0
+    sk = hei / wid
+    xi = (xc - x0) / wid
+    om = 1.0 - xi
+    den = sk + (d1 + d0 - 2.0 * sk) * xi * om
+    y = y0 + hei * (sk * xi * xi + d0 * xi * om) / den
+    ld = (2.0 * jnp.log(sk)
+          + jnp.log(d1 * xi * xi + 2.0 * sk * xi * om + d0 * om * om)
+          - 2.0 * jnp.log(den))
+    return jnp.where(inside, y, x), jnp.where(inside, ld, 0.0)
+
+
+def _rqs_inv_scalar(y, xk, yk, dk, b):
+    """Analytic spline inverse x(y) and log dx/dy (Durkan et al. eq. 6-8)."""
+    inside = (y > -b) & (y < b)
+    yc = jnp.clip(y, -b, b)
+    k = jnp.clip(jnp.searchsorted(yk, yc, side="right") - 1, 0, yk.shape[0] - 2)
+    x0, x1 = xk[k], xk[k + 1]
+    y0, y1 = yk[k], yk[k + 1]
+    d0, d1 = dk[k], dk[k + 1]
+    wid = x1 - x0
+    hei = y1 - y0
+    sk = hei / wid
+    dy = yc - y0
+    a = hei * (sk - d0) + dy * (d1 + d0 - 2.0 * sk)
+    bq = hei * d0 - dy * (d1 + d0 - 2.0 * sk)
+    c = -sk * dy
+    disc = jnp.maximum(bq * bq - 4.0 * a * c, 0.0)
+    xi = 2.0 * c / (-bq - jnp.sqrt(disc))
+    xi = jnp.clip(xi, 0.0, 1.0)
+    om = 1.0 - xi
+    x = x0 + xi * wid
+    den = sk + (d1 + d0 - 2.0 * sk) * xi * om
+    # log dx/dy = -log dy/dx evaluated at the recovered xi
+    ld = -(2.0 * jnp.log(sk)
+           + jnp.log(d1 * xi * xi + 2.0 * sk * xi * om + d0 * om * om)
+           - 2.0 * jnp.log(den))
+    return jnp.where(inside, x, y), jnp.where(inside, ld, 0.0)
+
+
+_rqs_fwd = jax.vmap(_rqs_fwd_scalar, in_axes=(0, 0, 0, 0, None))
+_rqs_inv = jax.vmap(_rqs_inv_scalar, in_axes=(0, 0, 0, 0, None))
+
+
+# ------------------------------------------------------------- transforms
+
+def _layer_forward(spec, lp, perm, v, context):
+    vp = v[jnp.asarray(perm)]
+    va, vb = vp[:spec.d1], vp[spec.d1:]
+    raw = _mlp(lp, _cond_input(spec, va, context))
+    if spec.kind == "affine":
+        s, t = _affine_split(spec, raw)
+        yb = vb * jnp.exp(s) + t
+        ld = jnp.sum(s)
+    else:
+        xk, yk, dk = _rqs_knots(spec, raw)
+        yb, lds = _rqs_fwd(vb, xk, yk, dk, spec.tail_bound)
+        ld = jnp.sum(lds)
+    out = jnp.concatenate([va, yb])
+    inv_perm = tuple(int(i) for i in np.argsort(np.asarray(perm)))
+    return out[jnp.asarray(inv_perm)], ld
+
+
+def _layer_inverse(spec, lp, perm, v, context):
+    vp = v[jnp.asarray(perm)]
+    va, vb = vp[:spec.d1], vp[spec.d1:]
+    raw = _mlp(lp, _cond_input(spec, va, context))
+    if spec.kind == "affine":
+        s, t = _affine_split(spec, raw)
+        ub = (vb - t) * jnp.exp(-s)
+        ld = -jnp.sum(s)
+    else:
+        xk, yk, dk = _rqs_knots(spec, raw)
+        ub, lds = _rqs_inv(vb, xk, yk, dk, spec.tail_bound)
+        ld = jnp.sum(lds)
+    out = jnp.concatenate([va, ub])
+    inv_perm = tuple(int(i) for i in np.argsort(np.asarray(perm)))
+    return out[jnp.asarray(inv_perm)], ld
+
+
+def flow_forward(spec, params, u, context=None):
+    """Latent -> data: ``x = T(u)``; returns ``(x, log|det dT/du|)``."""
+    v = u
+    logdet = jnp.zeros(())
+    for lp, perm in zip(params["layers"], spec.perms):
+        v, ld = _layer_forward(spec, lp, perm, v, context)
+        logdet = logdet + ld
+    x = params["loc"] + jnp.exp(params["log_scale"]) * v
+    return x, logdet + jnp.sum(params["log_scale"])
+
+
+def flow_inverse(spec, params, x, context=None):
+    """Data -> latent: ``u = T^{-1}(x)``; returns ``(u, log|det dT^{-1}/dx|)``."""
+    v = (x - params["loc"]) * jnp.exp(-params["log_scale"])
+    logdet = -jnp.sum(params["log_scale"])
+    for lp, perm in zip(reversed(params["layers"]), reversed(spec.perms)):
+        v, ld = _layer_inverse(spec, lp, perm, v, context)
+        logdet = logdet + ld
+    return v, logdet
+
+
+def base_logpdf(u):
+    """Standard-normal log-density of a latent vector."""
+    return (-0.5 * jnp.sum(u * u)
+            - 0.5 * u.shape[-1] * jnp.log(2.0 * jnp.pi))
+
+
+def flow_log_prob(spec, params, x, context=None):
+    """Exact flow log-density ``log q(x)`` of one parameter vector."""
+    u, ld = flow_inverse(spec, params, x, context)
+    return base_logpdf(u) + ld
+
+
+def flow_sample_logq(spec, params, u, context=None):
+    """Push one base draw through the flow: ``(x, log q(x))``.
+
+    ``log q(x) = log N(u; 0, I) - log|det dT/du|`` — the density of the
+    sample under the flow itself, used by the IS honesty rescoring and
+    the MH-corrected independence proposal.
+    """
+    x, ld = flow_forward(spec, params, u, context)
+    return x, base_logpdf(u) - ld

@@ -1,0 +1,223 @@
+"""Trained-flow artifact + serve-facing model wrappers.
+
+`FlowPosterior` is the durable product of `flows.train.fit_flow`: the
+architecture spec, the weight pytree, the parameter names it models,
+and the digests (weights + training corpus) that pin its identity. It
+saves/loads as a single ``.npz`` through the digest-verified
+`checkpoint_replace` path and exposes traced ``sample``/``log_prob``
+conveniences.
+
+`FlowServeModel` adapts a posterior to the `ServeDriver` model
+contract (`samplers/evalproto.py` protocol + `serve/admission.py`
+expectations) in one of two modes:
+
+- ``sample`` — a request row is a *base draw* ``u`` (standard normal,
+  same width as ``ndim``, so it packs into the existing width
+  buckets); the executable returns ``concat([T(u), log q(T(u))])``,
+  i.e. one dispatch turns a bucket of seeds into posterior draws WITH
+  their flow densities — exactly what the IS honesty rescoring needs.
+  ``serve_out_dim = ndim + 1`` rides the driver's vector-result lane.
+- ``log_prob`` — a request row is a parameter vector; the executable
+  returns the scalar flow log-density (posterior-density queries).
+
+Both wrappers expose ``params = []`` (a flow row is not box-bounded —
+admission keeps its finiteness/width gates but skips the prior box)
+and a ``topology_token`` so `models/build.py:topology_fingerprint`
+keys the AOT cache on architecture + weights + corpus instead of the
+per-instance fallback: re-loading the same artifact reuses compiled
+executables; retraining keys fresh ones.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.writers import checkpoint_replace, resolve_checkpoint
+from ..samplers.evalproto import install_protocol
+from ..utils import telemetry
+from .coupling import (FlowSpec, base_logpdf, flow_forward, flow_log_prob,
+                       flow_sample_logq, spec_from_json, spec_to_json)
+
+__all__ = ["FlowPosterior", "FlowServeModel", "weights_digest"]
+
+
+def weights_digest(params) -> str:
+    """Order-stable digest of a flow's weight pytree."""
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(params):
+        arr = np.ascontiguousarray(np.asarray(leaf, dtype=np.float64))
+        h.update(repr(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+class FlowPosterior:
+    """A trained normalizing-flow posterior surrogate.
+
+    Parameters
+    ----------
+    spec : `flows.coupling.FlowSpec` (static architecture).
+    params : weight pytree (host or device arrays).
+    param_names : names of the modeled dimensions, in order.
+    data_digest : digest of the training corpus (from ``fit_flow``).
+    """
+
+    def __init__(self, spec: FlowSpec, params, param_names=None,
+                 data_digest: str = "", meta: dict | None = None):
+        self.spec = spec
+        self.params = params
+        self.param_names = list(param_names or
+                                [f"x{i}" for i in range(spec.ndim)])
+        if len(self.param_names) != spec.ndim:
+            raise ValueError("param_names length "
+                             f"{len(self.param_names)} != ndim {spec.ndim}")
+        self.data_digest = str(data_digest)
+        self.meta = dict(meta or {})
+        self._wd = None
+        sp = self.spec
+
+        def _sample_one(u, p):
+            return flow_sample_logq(sp, p, u)
+
+        def _logq_one(x, p):
+            return flow_log_prob(sp, p, x)
+
+        self._sample_batch = telemetry.traced(
+            jax.vmap(_sample_one, in_axes=(0, None)), name="flow.sample")
+        self._logq_batch = telemetry.traced(
+            jax.vmap(_logq_one, in_axes=(0, None)), name="flow.log_prob")
+
+    @property
+    def ndim(self) -> int:
+        return self.spec.ndim
+
+    @property
+    def weights_digest(self) -> str:
+        if self._wd is None:
+            self._wd = weights_digest(self.params)
+        return self._wd
+
+    @property
+    def topology_token(self) -> str:
+        """Identity for the serve AOT cache: architecture + weights +
+        training corpus. Changing any of the three keys fresh
+        executables; reloading the same artifact shares them."""
+        return (f"{self.spec.arch_token};w={self.weights_digest};"
+                f"d={self.data_digest}")
+
+    def device_params(self):
+        return jax.tree_util.tree_map(jnp.asarray, self.params)
+
+    def sample(self, key, n, context=None):
+        """Draw ``n`` posterior samples; returns ``(thetas, logq)``."""
+        if context is not None:
+            raise NotImplementedError(
+                "context-conditioned batch sampling: vmap "
+                "flow_sample_logq with a per-row context")
+        u = jax.random.normal(key, (int(n), self.ndim), dtype=jnp.float64)
+        return self._sample_batch(u, self.device_params())
+
+    def log_prob(self, thetas, context=None):
+        """Exact flow log-density of each row of ``thetas``."""
+        if context is not None:
+            raise NotImplementedError(
+                "context-conditioned log_prob: vmap flow_log_prob")
+        thetas = jnp.atleast_2d(jnp.asarray(thetas, dtype=jnp.float64))
+        return self._logq_batch(thetas, self.device_params())
+
+    # ------------------------------------------------------- persistence
+
+    def save(self, path: str) -> str:
+        """Atomically persist the artifact; returns the content digest."""
+        leaves, _ = jax.tree_util.tree_flatten(self.params)
+        meta = {"spec": json.loads(spec_to_json(self.spec)),
+                "param_names": self.param_names,
+                "data_digest": self.data_digest,
+                "meta": self.meta}
+        payload = {"meta": np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8)}
+        for i, leaf in enumerate(leaves):
+            payload[f"p{i}"] = np.asarray(leaf)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **payload)
+        return checkpoint_replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "FlowPosterior":
+        usable = resolve_checkpoint(path, "flow posterior artifact")
+        if usable is None:
+            raise FileNotFoundError(f"no usable flow artifact at {path}")
+        with np.load(usable) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            spec = spec_from_json(json.dumps(meta["spec"]))
+            # rebuild the pytree structure from a skeleton of the same
+            # architecture, then substitute the stored leaves
+            from .coupling import init_flow
+            _, skel = init_flow(jax.random.PRNGKey(0), spec.ndim,
+                                n_layers=spec.n_layers, hidden=spec.hidden,
+                                context_dim=spec.context_dim,
+                                kind=spec.kind, n_bins=spec.n_bins,
+                                tail_bound=spec.tail_bound,
+                                s_cap=spec.s_cap)
+            treedef = jax.tree_util.tree_structure(skel)
+            n_leaves = len(jax.tree_util.tree_leaves(skel))
+            params = jax.tree_util.tree_unflatten(
+                treedef, [np.asarray(z[f"p{i}"]) for i in range(n_leaves)])
+        return cls(spec, params, param_names=meta["param_names"],
+                   data_digest=meta["data_digest"], meta=meta["meta"])
+
+    # ------------------------------------------------------------- serve
+
+    def serve_view(self, mode: str = "sample",
+                   name: str | None = None) -> "FlowServeModel":
+        """A `ServeDriver`-registrable model for this flow."""
+        return FlowServeModel(self, mode=mode, name=name)
+
+
+class FlowServeModel:
+    """`ServeDriver` adapter for a trained flow (see module docstring)."""
+
+    def __init__(self, flow: FlowPosterior, mode: str = "sample",
+                 name: str | None = None):
+        if mode not in ("sample", "log_prob"):
+            raise ValueError(f"mode must be 'sample' or 'log_prob', "
+                             f"got {mode!r}")
+        self.flow = flow
+        self.mode = mode
+        self.name = name or f"flow_{mode}"
+        self.ndim = flow.ndim
+        self.param_names = list(flow.param_names)
+        # no prior box: admission skips the bounds gate but keeps the
+        # width/finiteness gates (a base draw is unbounded by design)
+        self.params = []
+        sp = flow.spec
+        consts = flow.device_params()
+        if mode == "sample":
+            self.serve_out_dim = flow.ndim + 1
+
+            def eval_fn(u, p):
+                x, ld = flow_forward(sp, p, u)
+                return jnp.concatenate([x, (base_logpdf(u) - ld)[None]])
+        else:
+            self.serve_out_dim = 1
+
+            def eval_fn(x, p):
+                return flow_log_prob(sp, p, x)
+
+        install_protocol(self, eval_fn, consts, public=True,
+                         name=f"flow.{self.name}")
+
+    @property
+    def topology_token(self) -> str:
+        return f"{self.flow.topology_token};mode={self.mode}"
+
+    def sample_prior(self, rng, n=1):
+        """Request rows for synthetic traces: base draws in sample
+        mode (the natural input), standard-normal probes otherwise."""
+        return rng.standard_normal((int(n), self.ndim))

@@ -1,0 +1,225 @@
+"""Maximum-likelihood flow training: hand-rolled Adam, scan-blocked.
+
+Trains a `flows.coupling` flow on posterior draws from the existing
+samplers (PTMCMC/HMC/nested chains are the corpus). Deliberately
+``optax``-free per the subsystem contract — the optimizer is ~15 lines
+of pytree math — and dispatch-blocked: a ``lax.scan`` runs ``block``
+Adam steps per jit call, so the host loop wakes up once per block (the
+same one-dispatch-per-block shape as the PT sampler core).
+
+Telemetry rides the PR 2/5 plane: a ``flow_train`` event opens and
+closes the fit, heartbeats carry ``phase="flow_train"`` with the
+running loss, and training state (params + Adam moments + RNG key)
+checkpoints through `io/writers.py:checkpoint_replace` with digest
+verification on resume.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.writers import checkpoint_replace, resolve_checkpoint
+from ..utils import telemetry
+from ..utils.logging import get_logger
+from ..utils.profiling import span
+from .coupling import (flow_log_prob, init_flow, set_standardization,
+                       spec_from_json, spec_to_json)
+
+__all__ = ["fit_flow", "data_digest"]
+
+_log = get_logger("ewt.flows.train")
+
+_B1, _B2, _EPS = 0.9, 0.999, 1e-8
+
+
+def data_digest(samples) -> str:
+    """Stable digest of a training corpus (shape + float64 bytes)."""
+    arr = np.ascontiguousarray(np.asarray(samples, dtype=np.float64))
+    h = hashlib.sha256()
+    h.update(repr(arr.shape).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+def _adam_init(params):
+    zeros = lambda a: jnp.zeros_like(a)
+    return (jax.tree_util.tree_map(zeros, params),
+            jax.tree_util.tree_map(zeros, params))
+
+
+def _adam_step(params, m, v, grads, t, lr):
+    """One Adam update over a pytree; ``t`` is the 1-based step count."""
+    m = jax.tree_util.tree_map(
+        lambda mi, gi: _B1 * mi + (1.0 - _B1) * gi, m, grads)
+    v = jax.tree_util.tree_map(
+        lambda vi, gi: _B2 * vi + (1.0 - _B2) * gi * gi, v, grads)
+    c1 = 1.0 - _B1 ** t
+    c2 = 1.0 - _B2 ** t
+    params = jax.tree_util.tree_map(
+        lambda pi, mi, vi: pi - lr * (mi / c1) / (jnp.sqrt(vi / c2) + _EPS),
+        params, m, v)
+    return params, m, v
+
+
+def _save_state(path, spec, params, m, v, key, step, dd):
+    leaves_p, _ = jax.tree_util.tree_flatten(params)
+    leaves_m, _ = jax.tree_util.tree_flatten(m)
+    leaves_v, _ = jax.tree_util.tree_flatten(v)
+    payload = {"key": np.asarray(key), "step": np.asarray(step),
+               "spec": np.frombuffer(spec_to_json(spec).encode(),
+                                     dtype=np.uint8),
+               "data_digest": np.frombuffer(dd.encode(), dtype=np.uint8)}
+    for tag, leaves in (("p", leaves_p), ("m", leaves_m), ("v", leaves_v)):
+        for i, leaf in enumerate(leaves):
+            payload[f"{tag}{i}"] = np.asarray(leaf)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **payload)
+    return checkpoint_replace(tmp, path)
+
+
+def _load_state(path, spec, treedef, n_leaves, dd):
+    usable = resolve_checkpoint(path, "flow training state")
+    if usable is None:
+        return None
+    with np.load(usable) as z:
+        saved_spec = bytes(z["spec"]).decode()
+        saved_dd = bytes(z["data_digest"]).decode()
+        if saved_spec != spec_to_json(spec) or saved_dd != dd:
+            _log.warning("flow checkpoint %s is for a different "
+                         "architecture or corpus; restarting", usable)
+            return None
+        unflat = lambda tag: jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(z[f"{tag}{i}"]) for i in range(n_leaves)])
+        return (unflat("p"), unflat("m"), unflat("v"),
+                jnp.asarray(z["key"]), int(z["step"]))
+
+
+def fit_flow(samples, *, context=None, n_layers=6, hidden=64,
+             kind="affine", n_bins=8, tail_bound=5.0, s_cap=4.0,
+             steps=2000, batch=256, lr=1e-3, seed=0, block=100,
+             checkpoint_path=None, ckpt_every_blocks=5, resume=True):
+    """Fit a flow to posterior draws by maximum likelihood.
+
+    Parameters
+    ----------
+    samples : (n, ndim) array of posterior draws (chain rows).
+    context : optional (n, context_dim) per-row conditioning vectors;
+        enables one amortized flow across data sets.
+    steps/batch/lr : Adam schedule; ``block`` steps run per jit
+        dispatch inside a ``lax.scan``.
+    checkpoint_path : optional ``.npz`` path; training state rotates
+        through `checkpoint_replace` every ``ckpt_every_blocks`` blocks
+        and resumes from it when ``resume`` and the digest verifies.
+
+    Returns ``(spec, params, info)`` with host-side ``params`` and an
+    ``info`` dict carrying the loss curve, wall time, and the corpus
+    ``data_digest`` that feeds the serve topology fingerprint.
+    """
+    data = jnp.asarray(np.asarray(samples, dtype=np.float64))
+    n, ndim = data.shape
+    ctx = None
+    context_dim = 0
+    if context is not None:
+        ctx = jnp.asarray(np.asarray(context, dtype=np.float64))
+        context_dim = int(ctx.shape[1])
+    dd = data_digest(samples)
+
+    key = jax.random.PRNGKey(seed)
+    key, k_init = jax.random.split(key)
+    spec, params = init_flow(k_init, ndim, n_layers=n_layers, hidden=hidden,
+                             context_dim=context_dim, kind=kind,
+                             n_bins=n_bins, tail_bound=tail_bound,
+                             s_cap=s_cap)
+    # ewt: allow-host-sync — one-time corpus moments at fit entry
+    params = set_standardization(params, np.asarray(data).mean(0),
+                                 np.asarray(data).std(0))
+    m, v = _adam_init(params)
+    treedef = jax.tree_util.tree_structure(params)
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+
+    def _loss(p, xb, cb):
+        if ctx is None:
+            lp = jax.vmap(lambda r: flow_log_prob(spec, p, r))(xb)
+        else:
+            lp = jax.vmap(lambda r, c: flow_log_prob(spec, p, r, c))(xb, cb)
+        return -jnp.mean(lp)
+
+    loss_grad = jax.value_and_grad(_loss)
+    cb_all = ctx if ctx is not None else jnp.zeros((n, 0))
+
+    def _block(p, mm, vv, kk, t0, xdata, cdata):
+        def body(carry, i):
+            p, mm, vv, kk = carry
+            kk, kb = jax.random.split(kk)
+            idx = jax.random.randint(kb, (batch,), 0, n)
+            loss, g = loss_grad(p, xdata[idx], cdata[idx])
+            p, mm, vv = _adam_step(p, mm, vv, g, t0 + i + 1.0, lr)
+            return (p, mm, vv, kk), loss
+        (p, mm, vv, kk), losses = jax.lax.scan(
+            body, (p, mm, vv, kk), jnp.arange(block, dtype=jnp.float64))
+        return p, mm, vv, kk, losses
+
+    blk = telemetry.traced(_block, name="flow.train_block",
+                           donate_argnums=(0, 1, 2, 3))
+
+    step0 = 0
+    if checkpoint_path and resume:
+        state = _load_state(checkpoint_path, spec, treedef, n_leaves, dd)
+        if state is not None:
+            params, m, v, key, step0 = state
+            _log.info("flow training resumed at step %d from %s",
+                      step0, checkpoint_path)
+
+    rec = telemetry.active_recorder()
+    if rec:
+        rec.event("flow_train", phase="start", ndim=int(ndim),
+                  n_samples=int(n), kind=spec.kind,
+                  n_layers=spec.n_layers, hidden=spec.hidden,
+                  steps=int(steps), batch=int(batch), lr=float(lr),
+                  resumed_at=int(step0), data_digest=dd)
+
+    n_blocks = max((steps - step0) + block - 1, 0) // block
+    loss_curve = []
+    with span("flow.fit", steps=steps, blocks=n_blocks) as sp:
+        done = step0
+        for bi in range(n_blocks):
+            # ewt: allow-rng-key-reuse — the key is functionally
+            # threaded: blk returns the post-scan key and the loop
+            # rebinds it, so no draw ever sees the same key twice
+            params, m, v, key, losses = blk(
+                params, m, v, key, jnp.asarray(float(done)), data, cb_all)
+            done += block
+            # ewt: allow-host-sync — once-per-block loss pull at the
+            # dispatch boundary (heartbeat + curve; matches PT blocks)
+            bl = float(jnp.mean(losses))
+            loss_curve.append(bl)
+            if rec:
+                rec.heartbeat(phase="flow_train", step=int(done),
+                              steps=int(steps), loss=round(bl, 4))
+            if (checkpoint_path
+                    and ((bi + 1) % max(ckpt_every_blocks, 1) == 0
+                         or bi == n_blocks - 1)):
+                _save_state(checkpoint_path, spec, params, m, v, key,
+                            done, dd)
+        sp.annotate(final_loss=loss_curve[-1] if loss_curve else None)
+
+    # ewt: allow-host-sync — final params pulled once at the run boundary
+    params_host = jax.device_get(params)
+    info = {
+        "steps": int(done if n_blocks else step0),
+        "final_loss": loss_curve[-1] if loss_curve else None,
+        "loss_curve": loss_curve,
+        "data_digest": dd,
+        "n_samples": int(n),
+        "resumed_at": int(step0),
+    }
+    if rec:
+        rec.event("flow_train", phase="end", **{
+            k: info[k] for k in ("steps", "final_loss", "data_digest",
+                                 "n_samples")})
+    return spec, params_host, info

@@ -726,10 +726,12 @@ def topology_fingerprint(like):
       executables instead of reusing megakernel ones).
 
     Likelihoods without both a ``psr`` and a ``build_fingerprint``
-    (analytic targets, joint-PTA builds) get a per-instance identity
-    token instead — their baked closure constants cannot be
-    enumerated generically, so sharing executables across instances
-    would be unsound.
+    may declare their own ``topology_token`` (trained flow surrogates
+    do: architecture + weights digest + training-data digest); those
+    without one (analytic targets, joint-PTA builds) get a
+    per-instance identity token instead — their baked closure
+    constants cannot be enumerated generically, so sharing
+    executables across instances would be unsound.
     """
     import hashlib
 
@@ -755,7 +757,15 @@ def topology_fingerprint(like):
         h.update(f"dq={dq.token() if dq is not None else 'unaudited'};"
                  .encode())
     else:
-        h.update(f"instance={id(like)};".encode())
+        token = getattr(like, "topology_token", None)
+        if token is not None:
+            # self-describing executables (trained flows: architecture
+            # + weights digest + training-data digest) — equal tokens
+            # really do lower to the same program, so reloading the
+            # same artifact shares AOT executables across instances
+            h.update(f"token={token};".encode())
+        else:
+            h.update(f"instance={id(like)};".encode())
     import os as _os2
     for knob in ("EWT_PALLAS", "EWT_PALLAS_MEGA", "EWT_PALLAS_CHOL",
                  "EWT_REFINE", "EWT_BLOCKED_CHOL", "EWT_PAIR_PROGRAM"):

@@ -58,7 +58,8 @@ _HISTORY = 1000     # DE history ring length (per walker)
 #: the proposal-family order of every per-family counter in this
 #: module (jump_probs, fam_accept/fam_propose, the per-rung
 #: attribution matrices, and the mixing telemetry they feed)
-_FAM_NAMES = ("scam", "am", "de", "pd", "ind", "cg", "kde", "ns")
+_FAM_NAMES = ("scam", "am", "de", "pd", "ind", "cg", "kde", "ns",
+              "flow")
 _NFAM = len(_FAM_NAMES)
 
 
@@ -106,6 +107,8 @@ class PTSampler:
                  ind_weight=0, ind_inflate=1.4,
                  cg_weight=0, cg_k=3, cg_group_frac=0.5,
                  kde_weight=0, kde_bw=None, ns_weight=0,
+                 flow=None, flow_weight=0, flow_sigma=0.1,
+                 flow_ind_frac=0.5,
                  device_state=None, mesh=None, chain_axis="chain",
                  eval_chunk=None):
         self.like = like
@@ -193,9 +196,26 @@ class PTSampler:
             pr = like.params[iq].prior
             self._ns_qb.append((float(getattr(pr, "lo", -10.0)),
                                 float(getattr(pr, "hi", -5.0))))
+        # flow-guided proposals (family 8, flows/ subsystem): a trained
+        # `flows.model.FlowPosterior` over THIS likelihood's parameter
+        # space supplies per-walker independence draws and
+        # latent-preconditioned walks, both exactly MH-corrected with
+        # the flow's tractable density — amortized training cost
+        # converted into ESS/s on the exact chain. Auto-disabled (and
+        # compiled out) when no flow is configured, so the default
+        # block program and RNG stream are bit-identical to before.
+        self.flow = flow
+        self.flow_sigma = float(flow_sigma)
+        self.flow_ind_frac = float(flow_ind_frac)
+        if flow is None:
+            flow_weight = 0
+        elif int(getattr(flow, "ndim", -1)) != int(self.ndim):
+            raise ValueError(
+                f"flow models {getattr(flow, 'ndim', None)} dims but "
+                f"the likelihood has {self.ndim}")
         weights = np.array([scam_weight, am_weight, de_weight,
                             prior_weight, ind_weight, cg_weight,
-                            kde_weight, ns_weight], float)
+                            kde_weight, ns_weight, flow_weight], float)
         self.jump_probs = weights / weights.sum()
         # ensemble-KDE subspace independence: propose a (structured)
         # subset's values from a kernel-density estimate over the
@@ -250,11 +270,10 @@ class PTSampler:
         self._lnprior_batch = prior_protocol(like)
         self._compiled_block = None
         self._block_steps = -1
-        # per-family (scam, am, de, prior, ind, cgibbs, kde, ns)
-        # cold-rung counters — session-local tuning observability, not
-        # checkpointed
-        self.fam_accept = np.zeros(8)
-        self.fam_propose = np.zeros(8)
+        # per-family (see _FAM_NAMES) cold-rung counters —
+        # session-local tuning observability, not checkpointed
+        self.fam_accept = np.zeros(_NFAM)
+        self.fam_propose = np.zeros(_NFAM)
         # update_mask emission (evaluation-structure layer): when the
         # likelihood classifies its parameters into blocks
         # (``like.param_blocks``, samplers/evalproto.py), every proposal
@@ -540,6 +559,19 @@ class PTSampler:
         use_cg = bool(self.jump_probs[5] > 0)
         use_kde = bool(self.jump_probs[6] > 0)
         use_ns = bool(self.jump_probs[7] > 0)
+        use_flow = bool(self.jump_probs[8] > 0)
+        if use_flow:
+            # the flow's weights close over the block as jnp constants
+            # (the ns-family pair-table precedent): they are fixed for
+            # the life of the compiled block, exactly like the spec
+            from ..flows.coupling import (base_logpdf as _flow_lpdf,
+                                          flow_forward as _flow_fwd,
+                                          flow_inverse as _flow_inv)
+            flow_spec = self.flow.spec
+            flow_params = jax.tree_util.tree_map(jnp.asarray,
+                                                 self.flow.params)
+            flow_sigma = self.flow_sigma
+            flow_ind_frac = self.flow_ind_frac
         kdims = self.cg_k
         group_frac = self.cg_group_frac
         if use_ns:
@@ -752,6 +784,42 @@ class PTSampler:
                     x, jax.random.split(kb, W),
                     jax.random.split(kf, W))
                 prop = jnp.where((choice == 7)[:, None], ns_prop, prop)
+            if use_flow:
+                # flow-guided proposals: per walker, either an
+                # INDEPENDENCE draw from the flow (u' ~ N(0,I),
+                # x' = T(u'); teleports between posterior modes the
+                # random-walk families cannot cross) or a
+                # LATENT-PRECONDITIONED walk (u = T^-1(x),
+                # x' = T(u + sigma z); a random walk in the flow's
+                # whitened geometry, so correlated/curved directions
+                # cost the same as axis-aligned ones). Both corrections
+                # are exact: the independence ratio is
+                # log q(x) - log q(x'), and the Gaussian latent kernel
+                # is symmetric in u, leaving only the Jacobian ratio
+                # log|det dT^-1/dx|(x) - log|det dT^-1/dx|(x') — MH
+                # exactness untouched (keys split inside this branch,
+                # so the flow-off RNG stream is bit-identical)
+                key, kfd = jax.random.split(key)
+
+                def flow_one(x_w, dkey):
+                    ku, kz = jax.random.split(dkey)
+                    zf = jax.random.normal(kz, (nd,))
+                    u_w, ld_inv_old = _flow_inv(flow_spec, flow_params,
+                                                x_w)
+                    is_ind = jax.random.uniform(ku) < flow_ind_frac
+                    u_new = jnp.where(is_ind, zf,
+                                      u_w + flow_sigma * zf)
+                    x_new, ld_fwd_new = _flow_fwd(flow_spec,
+                                                  flow_params, u_new)
+                    logq_old = _flow_lpdf(u_w) + ld_inv_old
+                    logq_new = _flow_lpdf(u_new) - ld_fwd_new
+                    qc_ind = logq_old - logq_new
+                    qc_pre = ld_inv_old + ld_fwd_new
+                    return x_new, jnp.where(is_ind, qc_ind, qc_pre)
+                flow_prop, flow_qc = jax.vmap(flow_one)(
+                    x, jax.random.split(kfd, W))
+                prop = jnp.where((choice == 8)[:, None], flow_prop,
+                                 prop)
 
             key, ka = jax.random.split(key)
             with jax.named_scope("pt.eval"):
@@ -802,6 +870,8 @@ class PTSampler:
                 qcorr = jnp.where(choice == 6, kde_qc, qcorr)
             if use_ns:
                 qcorr = jnp.where(choice == 7, ns_qc, qcorr)
+            if use_flow:
+                qcorr = jnp.where(choice == 8, flow_qc, qcorr)
             log_ratio = (lnp_new - lnp) + (lnl_new - lnl) / temps + qcorr
             accept = jnp.log(jax.random.uniform(ka, (W,))) < log_ratio
             x = jnp.where(accept[:, None], prop, x)
@@ -812,8 +882,8 @@ class PTSampler:
             # the tuning observable — a global acceptance rate hides a
             # dead family behind a healthy one
             cold_ch = choice[:nchains]
-            fam_prop = fam_prop + jnp.zeros(8).at[cold_ch].add(1.0)
-            fam_acc = fam_acc + jnp.zeros(8).at[cold_ch].add(
+            fam_prop = fam_prop + jnp.zeros(_NFAM).at[cold_ch].add(1.0)
+            fam_acc = fam_acc + jnp.zeros(_NFAM).at[cold_ch].add(
                 accept[:nchains].astype(jnp.float32))
             if use_mask:
                 # update_mask emission: tag each walker's proposal with
@@ -1458,8 +1528,8 @@ class PTSampler:
         st.swaps_accepted = np.zeros(self.ntemps - 1)
         st.swaps_proposed = np.zeros(self.ntemps - 1)
         st.step = 0
-        self.fam_accept = np.zeros(8)
-        self.fam_propose = np.zeros(8)
+        self.fam_accept = np.zeros(_NFAM)
+        self.fam_propose = np.zeros(_NFAM)
         self.mask_counts = np.zeros(3)
         self._reset_diag()
         self._anneal_state = st

@@ -141,10 +141,34 @@ def serve_main(argv=None):
                          "before serving (fresh-replica warm start)")
     ap.add_argument("--gram_mode", default="split",
                     choices=("split", "f32", "f64"))
+    ap.add_argument("--flow", action="append", default=[],
+                    metavar="NAME=PATH[:MODE]",
+                    help="register a trained flow artifact "
+                         "(flows/model.py .npz) as serve model NAME; "
+                         "MODE is 'sample' (default: one request row "
+                         "= one base draw, result row = posterior "
+                         "draw + log q) or 'log_prob'. Repeatable; "
+                         "the paramfile key 'flow_models:' takes the "
+                         "same NAME=PATH[:MODE] tokens")
     opts = ap.parse_args(argv)
 
     models, params = build_serve_models(opts.prfile,
                                         gram_mode=opts.gram_mode)
+    flow_specs = list(opts.flow)
+    pf_flows = getattr(params, "flow_models", None)
+    if pf_flows:
+        flow_specs += ([str(t) for t in pf_flows]
+                       if isinstance(pf_flows, (list, tuple))
+                       else str(pf_flows).split())
+    for spec_str in flow_specs:
+        name, _, rhs = spec_str.partition("=")
+        if not name or not rhs:
+            raise ValueError(f"--flow expects NAME=PATH[:MODE], got "
+                             f"{spec_str!r}")
+        path, _, mode = rhs.partition(":")
+        from ..flows.model import FlowPosterior
+        models[name] = FlowPosterior.load(path).serve_view(
+            mode or "sample", name=name)
     root = opts.out or os.path.join(params.output_dir, "serve")
     buckets = None
     if opts.buckets:

@@ -220,6 +220,7 @@ class ServeDriver:
         self._consts: dict = {}
         self._placement: dict = {}
         self._bounds: dict = {}     # model -> (lo, hi) prior box
+        self._outdim: dict = {}     # model -> per-row result width
         self.queue: deque = deque()
         self.results: dict = {}
         self.rejected: dict = {}    # rid -> admission reason
@@ -331,6 +332,9 @@ class ServeDriver:
         # prior support box, resolved once per model: admission-time
         # theta validation is host numpy against these bounds
         self._bounds[name] = prior_bounds(like)
+        # vector-result lane: a model may return a row of values per
+        # theta (flow surrogates: draw + log q) instead of a scalar
+        self._outdim[name] = int(getattr(like, "serve_out_dim", 1) or 1)
         return self.cache.fingerprint(like)
 
     def warm(self, name=None, buckets=None):
@@ -415,8 +419,7 @@ class ServeDriver:
                       trace_id=trace_id, t_enqueue=t_submit,
                       t_mark=t_submit)
         self.queue.append(req)
-        self._pending[rid] = [np.empty(req.n, dtype=np.float64), 0,
-                              req]
+        self._pending[rid] = [self._result_buf(model, req.n), 0, req]
         self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
         self.requests_seen += 1
         self._c_req.inc()
@@ -890,6 +893,11 @@ class ServeDriver:
                 lnl = np.array(lnl, copy=True)
                 lnl[:batch.n_real] = np.nan
             finite = np.isfinite(np.asarray(lnl[:batch.n_real]))
+            if finite.ndim > 1:
+                # vector-result lane: a row is poisoned if ANY of its
+                # components is non-finite — per-row verdicts keep the
+                # isolation/bisection machinery model-shape-agnostic
+                finite = finite.all(axis=tuple(range(1, finite.ndim)))
         accrue(st)
         self._stage_event("harvest", str(batch.model), batch.bucket,
                           st["dur_ms"], rids, trace_ids)
@@ -1002,6 +1010,15 @@ class ServeDriver:
             self.slo.observe(req.tenant, elapsed_ms, ok,
                              emit=self.rec.event)
 
+    def _result_buf(self, model, n):
+        """Result buffer for one request: ``(n,)`` scalars for
+        likelihood models, ``(n, out_dim)`` rows for vector-result
+        models (flow surrogates)."""
+        out_dim = self._outdim.get(model, 1)
+        if out_dim == 1:
+            return np.empty(n, dtype=np.float64)
+        return np.empty((n, out_dim), dtype=np.float64)
+
     def _finish(self, req, lnl, batch):
         del self._pending[req.rid]
         self._dec_inflight(req.tenant)
@@ -1025,7 +1042,8 @@ class ServeDriver:
             ev["deadline_ms"] = req.deadline_ms
             ev["deadline_met"] = deadline_ok
         if req.n <= _INLINE_LNL_ROWS:
-            ev["lnl"] = [float(v) for v in lnl]
+            ev["lnl"] = (np.asarray(lnl).tolist() if np.ndim(lnl) > 1
+                         else [float(v) for v in lnl])
         self._tenant(req.tenant).event("serve_result", **ev)
         self.request_log.append(
             {"rid": req.rid, "tenant": req.tenant, "model": req.model,
@@ -1171,9 +1189,8 @@ class ServeDriver:
                 # not cross processes)
                 req.t_mark = now
                 self.queue.append(req)
-                self._pending[rid] = [np.empty(req.n,
-                                               dtype=np.float64), 0,
-                                      req]
+                self._pending[rid] = [self._result_buf(model, req.n),
+                                      0, req]
                 self._inflight[tenant] = \
                     self._inflight.get(tenant, 0) + 1
                 n += 1

@@ -1,0 +1,75 @@
+"""Tier-1 product-space (hypermodel) smoke test on a synthetic pulsar.
+
+Fast companion to the slow cross-method evidence check in
+``test_evidence.py``: two noise-model topologies on one fake pulsar,
+one PT chain over the union parameter space, and the activation
+fraction of the ``nmodel`` index folded into a log Bayes factor through
+the same histogram fold ``ewt-results`` uses.
+"""
+
+import numpy as np
+
+from enterprise_warp_tpu.models import (StandardModels, TermList,
+                                        build_pulsar_likelihood)
+from enterprise_warp_tpu.samplers import HyperModelLikelihood, PTSampler
+from enterprise_warp_tpu.sim.noise import inject_white, make_fake_pulsar
+
+
+def _pair():
+    """(white-only, white+red) likelihoods on one white-noise pulsar."""
+    psr = make_fake_pulsar(name="J0001+0001", ntoa=96,
+                           backends=("A", "B"), freqs_mhz=(1400.0,),
+                           seed=11)
+    psr.residuals = 0.0 * psr.toaerrs
+    inject_white(psr, efac=1.1, equad_log10=-7.0,
+                 rng=np.random.default_rng(5))
+
+    def like_for(with_red):
+        m = StandardModels(psr=psr)
+        terms = [m.efac("by_backend")]
+        if with_red:
+            terms.append(m.spin_noise("powerlaw_5_nfreqs"))
+        return build_pulsar_likelihood(psr, TermList(psr, terms))
+
+    return like_for(False), like_for(True)
+
+
+def test_product_space_model_selection_smoke(tmp_path):
+    la, lb = _pair()
+    hyper = HyperModelLikelihood({0: la, 1: lb})
+
+    # union parameter space: shared efac names collapse, nmodel last
+    assert hyper.param_names[-1] == "nmodel"
+    assert set(la.param_names) <= set(hyper.param_names[:-1])
+    assert set(lb.param_names) == set(hyper.param_names[:-1])
+    assert hyper.ndim == len(set(la.param_names)
+                             | set(lb.param_names)) + 1
+
+    s = PTSampler(hyper, str(tmp_path), ntemps=2, nchains=16, seed=9,
+                  cov_update=400)
+    s.sample(2500, resume=False, verbose=False)
+
+    pars = open(tmp_path / "pars.txt").read().split()
+    assert pars == hyper.param_names
+    chain = np.loadtxt(tmp_path / "chain_1.txt")
+    assert chain.shape[1] == hyper.ndim + 4
+
+    burn = len(chain) // 4
+    nmodel = chain[burn:, hyper.ndim - 1]
+    # the index must stay inside its prior box and visit both bins
+    assert nmodel.min() >= -0.5 and nmodel.max() <= 1.5
+    n0 = int(np.sum(nmodel < 0.5))
+    n1 = int(np.sum(nmodel >= 0.5))
+    assert n0 > 30 and n1 > 30, (n0, n1)
+
+    # activation fraction -> log Bayes factor, via the same histogram
+    # fold ewt-results applies to hypermodel chains (no self state)
+    from enterprise_warp_tpu.results.core import EnterpriseWarpResult
+    counts = EnterpriseWarpResult._print_logbf(
+        None, str(tmp_path), chain[burn:], pars)
+    assert set(counts) == {0, 1}
+    logbf = np.log(counts[1] / counts[0])
+    assert np.isfinite(logbf)
+    # data are white-only: the extra red-noise term must not be
+    # decisively PREFERRED (logBF for model 1 bounded above)
+    assert logbf < 1.5, (logbf, counts)

@@ -298,10 +298,10 @@ def flagship_ensemble(nsamp=20000, seed=0):
     rep["ess_per_value_eval"] = round(
         rep["ess_min"] / (nsamp * ntemps), 5)
     rep["wall_s"] = round(time.perf_counter() - t0, 1)
+    from enterprise_warp_tpu.samplers.ptmcmc import _FAM_NAMES
     rep["fam_accept"] = {
         n: round(float(a / max(p, 1)), 3) for n, a, p in zip(
-            ("scam", "am", "de", "pd", "ind", "cg", "kde", "ns"),
-            s.fam_accept, s.fam_propose)}
+            _FAM_NAMES, s.fam_accept, s.fam_propose)}
     return rep
 
 

@@ -252,6 +252,27 @@ def fold_observatory(root, now=None, stale_s=300.0):
     breaches = by_type.get("slo_breach", [])
     requeues = by_type.get("serve_requeue", [])
     summary = (by_type.get("serve_summary") or [None])[-1]
+    # amortized-flow plane (docs/flows.md): training fits on the
+    # driver stream and the honesty-rescore verdicts wherever they
+    # were emitted — the IS-ESS efficiency and match verdict are the
+    # published contract of every amortized posterior
+    rescores = list(by_type.get("flow_rescore", []))
+    flow_trains = [ev for ev in by_type.get("flow_train", [])
+                   if ev.get("phase") == "end"]
+    flows = None
+    if rescores or flow_trains:
+        last = rescores[-1] if rescores else {}
+        flows = {
+            "trainings": len(flow_trains),
+            "rescores": len(rescores),
+            "mismatches": sum(1 for ev in rescores
+                              if ev.get("match") is False),
+            "last_rescore": ({
+                "ess_efficiency": last.get("ess_efficiency"),
+                "max_weight": last.get("max_weight"),
+                "match": last.get("match"),
+            } if rescores else None),
+        }
     return {
         "root": os.path.abspath(root),
         "generated_unix": round(now, 3),
@@ -280,6 +301,7 @@ def fold_observatory(root, now=None, stale_s=300.0):
             "traces": sorted({str(ev.get("trace_id"))
                               for ev in requeues}) or None,
         },
+        "flows": flows,
         "tenants": tenants,
     }
 
@@ -382,6 +404,17 @@ def render(report, out=sys.stdout):
         p("stage walls (ms, p50/p95 per batch): "
           + "  ".join(f"{s} {v['p50']}/{v['p95']}"
                       for s, v in report["stages"].items()))
+    fl = report.get("flows")
+    if fl:
+        last = fl.get("last_rescore") or {}
+        line = (f"flows: trainings={fl['trainings']} "
+                f"rescores={fl['rescores']}")
+        if last:
+            line += (f" last ess_eff={last.get('ess_efficiency')}"
+                     f" match={last.get('match')}")
+        if fl["mismatches"]:
+            line += f" | MISMATCHES {fl['mismatches']}"
+        p(line)
     cfg = report.get("slo_config")
     if cfg:
         p("objectives (window "

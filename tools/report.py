@@ -82,6 +82,10 @@ KNOWN_EVENT_TYPES = frozenset({
     # docs/resilience.md): ingestion-audit findings, kernel health
     # escalations, and a pulsar leaving the array alone
     "data_quality", "kernel_health", "psr_quarantined",
+    # amortized-posterior flows (enterprise_warp_tpu/flows,
+    # docs/flows.md): training fit open/close markers and the
+    # exact-likelihood IS honesty rescoring verdict
+    "flow_train", "flow_rescore",
 })
 
 #: the heartbeat field vocabulary — every field any sampler/driver
@@ -116,6 +120,9 @@ KNOWN_HEARTBEAT_FIELDS = frozenset({
     "requests_rejected", "requests_expired", "requests_quarantined",
     # VI / CEM drivers
     "elbo", "best_lnpost", "is_ess",
+    # flow training (flows/train.py): negative mean log-likelihood
+    # per scan block
+    "loss",
     # kernel-health plane (numerical-integrity): run-cumulative
     # jitter-fallback engagements, refinement divergences, and the
     # worst condition proxy seen so far

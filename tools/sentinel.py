@@ -559,6 +559,75 @@ def gate_slo(bench_dir, max_unaccounted_ms=1.0, max_other_p95_ms=50.0,
         dispatch_p50_ms=disp_p50)
 
 
+def gate_flow(bench_dir, min_speedup=100.0, min_is_ess=0.1,
+              max_query_p50_ms=2000.0):
+    """Amortized-posterior gates from BENCH_FLOW.json (``bench.py
+    --flow``; docs/flows.md):
+
+    - **match verdict REQUIRED** — the flow-vs-exact moment/width
+      match (`flows/rescore.py`) must be True; a drifted surrogate
+      is not allowed to keep shipping amortized posteriors no matter
+      how fast it is;
+    - **IS-ESS efficiency floor** — the importance-rescored draws
+      must retain >= ``min_is_ess`` of their nominal sample size
+      against the exact likelihood;
+    - **amortized-query p50 ceiling** and **speedup floor** — the
+      query (draws + IS rescore) must hold ``max_query_p50_ms`` and
+      stay >= ``min_speedup`` x faster than the cold sampler run it
+      replaces (the subsystem's reason to exist);
+    - **packed-vs-alone bit-equality** for the flow model class and
+      **zero dropped requests** (the serve-layer contract extends to
+      vector-result models unchanged).
+
+    No BENCH_FLOW.json only warns (pre-flows checkouts).
+    """
+    doc = _load_json(os.path.join(bench_dir, "BENCH_FLOW.json"))
+    if not doc:
+        return _gate("flow", "warn", "no BENCH_FLOW.json record")
+    problems = []
+    rescore = doc.get("rescore") or {}
+    if rescore.get("match") is not True:
+        problems.append(
+            "flow-vs-exact match verdict is not True "
+            f"(checks: {rescore.get('checks')}) — the surrogate "
+            "drifted from the exact posterior")
+    eff = rescore.get("ess_efficiency")
+    if eff is None:
+        problems.append("record lacks rescore.ess_efficiency")
+    elif eff < min_is_ess:
+        problems.append(f"IS-ESS efficiency {eff} < floor "
+                        f"{min_is_ess} (flow draws carry too little "
+                        "exact-posterior mass)")
+    q = doc.get("query") or {}
+    p50 = q.get("p50_ms")
+    if p50 is None:
+        problems.append("record lacks query.p50_ms")
+    elif p50 > max_query_p50_ms:
+        problems.append(f"amortized query p50 {p50} ms > ceiling "
+                        f"{max_query_p50_ms} ms")
+    speedup = doc.get("amortized_vs_cold_speedup")
+    if speedup is None:
+        problems.append("record lacks amortized_vs_cold_speedup")
+    elif speedup < min_speedup:
+        problems.append(f"amortized speedup {speedup}x < floor "
+                        f"{min_speedup}x vs the cold sampler run")
+    if q.get("dropped_requests") not in (0, None):
+        problems.append(f"{q.get('dropped_requests')} dropped "
+                        "request(s) in the flow query leg")
+    if doc.get("padded_bit_equal") is not True:
+        problems.append("flow packed results not bit-equal to the "
+                        "single-job path")
+    if problems:
+        return _gate("flow", "fail", "; ".join(problems),
+                     speedup=speedup, ess_efficiency=eff, p50_ms=p50)
+    return _gate(
+        "flow", "pass",
+        f"amortized {speedup}x (floor {min_speedup}x), IS-ESS eff "
+        f"{eff} (floor {min_is_ess}), query p50 {p50} ms (ceiling "
+        f"{max_query_p50_ms}), match verdict True, packed bit-equal",
+        speedup=speedup, ess_efficiency=eff, p50_ms=p50)
+
+
 def gate_integrity(bench_dir):
     """Numerical-integrity gates from CHAOS.json's ``integrity``
     section (written by ``tools/chaos.py --integrity`` —
@@ -878,6 +947,16 @@ def main(argv=None):
                     default=250.0,
                     help="warm batched-trace dispatch-stage p50 "
                          "ceiling in ms (default 250, CPU-honest)")
+    ap.add_argument("--min-flow-speedup", type=float, default=100.0,
+                    help="amortized-query-vs-cold-sampler speedup "
+                         "floor for the flow gate (default 100)")
+    ap.add_argument("--min-flow-is-ess", type=float, default=0.1,
+                    help="IS-ESS efficiency floor for the flow "
+                         "honesty rescore (default 0.1)")
+    ap.add_argument("--max-flow-query-p50-ms", type=float,
+                    default=2000.0,
+                    help="amortized flow query p50 ceiling in ms "
+                         "(default 2000, CPU-honest)")
     ap.add_argument("--min-scale-eff", type=float, default=0.6,
                     help="strong-scaling cost-model efficiency floor "
                          "at the widest mesh (default 0.6, the "
@@ -920,6 +999,10 @@ def main(argv=None):
                  max_unaccounted_ms=opts.max_unaccounted_ms,
                  max_other_p95_ms=opts.max_other_p95_ms,
                  max_dispatch_p50_ms=opts.max_slo_dispatch_p50_ms),
+        gate_flow(opts.bench_dir,
+                  min_speedup=opts.min_flow_speedup,
+                  min_is_ess=opts.min_flow_is_ess,
+                  max_query_p50_ms=opts.max_flow_query_p50_ms),
         gate_integrity(opts.bench_dir),
         gate_scale(opts.bench_dir,
                    min_strong_eff=opts.min_scale_eff,
@@ -952,6 +1035,9 @@ def main(argv=None):
             "max_unaccounted_ms": opts.max_unaccounted_ms,
             "max_other_p95_ms": opts.max_other_p95_ms,
             "max_slo_dispatch_p50_ms": opts.max_slo_dispatch_p50_ms,
+            "min_flow_speedup": opts.min_flow_speedup,
+            "min_flow_is_ess": opts.min_flow_is_ess,
+            "max_flow_query_p50_ms": opts.max_flow_query_p50_ms,
             "min_scale_eff": opts.min_scale_eff,
             "min_scale_npsr": opts.min_scale_npsr,
             "max_retraces": opts.max_retraces,
