@@ -1806,11 +1806,31 @@ def scale_worker():
             r = compiled(th, like.consts)
         r.block_until_ready()
         wall = (time.perf_counter() - t0) / reps
-        return dict(npsr=npsr, width=width,
-                    spmd=bool(like._stages["spmd"]),
-                    flops_per_partition=flops,
-                    wall_s_per_eval=round(wall, 5),
-                    lnl=float(r), collectives=census)
+        out = dict(npsr=npsr, width=width,
+                   spmd=bool(like._stages["spmd"]),
+                   flops_per_partition=flops,
+                   wall_s_per_eval=round(wall, 5),
+                   lnl=float(r), collectives=census)
+        # mesh-attribution columns (mesh observability plane): the
+        # sharded likelihood publishes its static cost-model layout —
+        # the sentinel skew gate ceilings the geometric imbalance and
+        # the modeled collective-wall fraction from these, CPU-
+        # emulated honesty carried by cost_basis + the device stamp
+        layout = getattr(like, "mesh_layout", None)
+        if layout:
+            from enterprise_warp_tpu.utils.devicemetrics import \
+                MeshStatsLedger
+            led = MeshStatsLedger(layout)
+            out["attribution"] = dict(
+                shard_psrs=layout["shard_psrs"],
+                shard_toas=layout["shard_toas"],
+                imbalance_ratio=round(led.model_skew, 4),
+                collective_frac_model=round(led.frac_coll, 4),
+                stage3_frac_model=round(led.frac_stage3, 4),
+                psum_payload_bytes=layout["psum_payload_bytes"],
+                coll_flop_per_byte=led.coll_flop_per_byte,
+                cost_basis=layout["cost_basis"])
+        return out
 
     # strong scaling: fixed problem, growing mesh
     strong = {}

@@ -84,17 +84,34 @@ def process_index() -> int:
     # single-process runs (no process group joined, no launcher env)
     # resolve WITHOUT importing jax: the primary_only single-writer
     # guard must stay usable from the jax-free standalone CLIs
-    # (tools/report.py loads this module by file path for exactly that)
-    if not _INITIALIZED and "EWT_PROCESS_ID" not in os.environ:
-        return 0
+    # (tools/report.py loads this module by file path for exactly that).
+    # Before the group is joined the launcher env IS the identity —
+    # also jax-free, so an emulated multi-process test (or a process
+    # between launch and init_distributed) resolves its index without
+    # jax.process_index(), which would report 0 for every process
+    # until initialize() runs
+    if not _INITIALIZED:
+        pid = os.environ.get("EWT_PROCESS_ID")
+        if pid is None:
+            return 0
+        try:
+            return int(pid)
+        except ValueError:
+            return 0
     import jax
 
     return int(jax.process_index())
 
 
 def process_count() -> int:
-    if not _INITIALIZED and "EWT_NUM_PROCESSES" not in os.environ:
-        return 1
+    if not _INITIALIZED:
+        npro = os.environ.get("EWT_NUM_PROCESSES")
+        if npro is None:
+            return 1
+        try:
+            return max(1, int(npro))
+        except ValueError:
+            return 1
     import jax
 
     return int(jax.process_count())
@@ -105,18 +122,27 @@ def is_primary() -> bool:
     return process_index() == 0
 
 
-def primary_only(fn):
+def primary_only(fn=None, *, telemetry_ok=False):
     """Decorator enforcing the single-writer convention on an
     artifact-write function: on non-primary processes the call is a
     no-op returning ``None``, so a multi-process run can never tear a
     BENCH/TRENDS JSON or chain file by racing writers. Single-process
-    runs are unaffected (``is_primary()`` is always True there)."""
-    @functools.wraps(fn)
-    def wrapped(*args, **kwargs):
-        if not is_primary():
-            return None
-        return fn(*args, **kwargs)
-    return wrapped
+    runs are unaffected (``is_primary()`` is always True there).
+
+    ``telemetry_ok=True`` is the mesh-observability escape hatch: the
+    decorated writer produces TELEMETRY (a per-process stream or
+    sidecar whose filename carries the process index, so writers never
+    race on one path) and is allowed to run on every host. Committed
+    artifacts — chains, checkpoints, BENCH/TRENDS JSONs — must never
+    pass it; they stay strictly primary-only."""
+    def deco(f):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            if not telemetry_ok and not is_primary():
+                return None
+            return f(*args, **kwargs)
+        return wrapped
+    return deco if fn is None else deco(fn)
 
 
 def emulated_host_count() -> int:
@@ -139,7 +165,13 @@ def device_stamp(mesh=None) -> dict:
 
     stamp = dict(platform=jax.devices()[0].platform,
                  emulated_hosts=emulated_host_count(),
-                 process_count=process_count())
+                 process_count=process_count(),
+                 # host identity (mesh-observability plane): which
+                 # process produced this stamp and how many devices it
+                 # drives locally — the fields that let every
+                 # heartbeat/bench artifact name its host
+                 process_index=process_index(),
+                 local_device_count=len(jax.local_devices()))
     if mesh is not None:
         stamp["mesh_devices"] = int(mesh.size)
         stamp["mesh_axes"] = dict(zip(mesh.axis_names,

@@ -89,6 +89,14 @@ from .orf import is_low_rank, is_positive_definite, orf_matrix
 # silently become inf on device.
 _TM_PHI = 1.0e30
 
+#: per-shard attribution lanes riding the packed psum (mesh
+#: observability plane, docs/scaling.md#reading-the-mesh-plane):
+#: [eval count, active-TOA work proxy, jitter-engaged count,
+#: refine-diverged count] — one fixed-shape f64 row per shard,
+#: scattered at the shard's own offset exactly like the health words,
+#: so the attribution rides the evaluation's ONE collective
+MESH_ATTR_WIDTH = 4
+
 
 def _named(name, fn):
     """Wrap a trace-time function in ``jax.named_scope(name)`` so the
@@ -964,7 +972,7 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         npsr_loc = npsr // nshard
         n_ss, n_xs = npsr * n_g * n_g, npsr * n_g
 
-        def _make_spmd(with_health):
+        def _make_spmd(with_health, with_attr=False):
             def shard_fn(nw_l, phi_l, R_l, T_l, mask_l, tmpad_l):
                 # per-shard stages 1-2: identical math to _common +
                 # the _stage12_single vmap, on this shard's pulsars
@@ -999,6 +1007,29 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
                     parts.append(scatter_to_global(
                         st["hw"].astype(jnp.float64), npsr,
                         psr_axis).ravel())
+                if with_attr:
+                    # mesh-observability lanes: this shard's per-eval
+                    # cost attribution row, scattered at the shard's
+                    # own offset so the psum assembles the (nshard,
+                    # MESH_ATTR_WIDTH) table with no extra collective.
+                    # Lane 1 (active-TOA count) is the stage-1/2 wall
+                    # proxy: per-pulsar work is ~linear in TOAs at
+                    # fixed basis width, so an uneven pulsar packing
+                    # shows up as lane-1 skew across shards.
+                    # ewt: allow-precision — counters widened to ride
+                    # the packed f64 psum, exact under summation
+                    attr_row = jnp.stack([
+                        jnp.ones(()),
+                        jnp.sum(mask_l),
+                        (jnp.sum(st["hw"][:, 0] > 0.5)
+                         .astype(jnp.float64) if with_health
+                         else jnp.zeros(())),
+                        (jnp.sum(st["hw"][:, 1] > 0.5)
+                         .astype(jnp.float64) if with_health
+                         else jnp.zeros(())),
+                    ])[None, :]
+                    parts.append(scatter_to_global(
+                        attr_row, nshard, psr_axis).ravel())
                 parts.append(scalars)
                 # THE collective: the evaluation's only cross-shard op
                 return jax.lax.psum(jnp.concatenate(parts), psr_axis)
@@ -1015,8 +1046,9 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
 
         _spmd_fwd = _make_spmd(False)
         _spmd_fwd_h = _make_spmd(True)
+        _spmd_fwd_m = _make_spmd(True, with_attr=True)
 
-        def _unpack_spmd(packed, with_health):
+        def _unpack_spmd(packed, with_health, with_attr=False):
             off = 0
             cache = {}
             if n_g:
@@ -1029,12 +1061,17 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
                 hw = packed[off:off + npsr * HW_WIDTH].reshape(
                     npsr, HW_WIDTH)
                 off += npsr * HW_WIDTH
+            attr = None
+            if with_attr:
+                attr = packed[off:off + nshard * MESH_ATTR_WIDTH] \
+                    .reshape(nshard, MESH_ATTR_WIDTH)
+                off += nshard * MESH_ATTR_WIDTH
             sc = packed[off:off + 6]
             # the scalar slots arrive pre-summed; _stage3's jnp.sum
             # over them is the identity
             cache.update(q1=sc[0], ld_nn=sc[1], ld_tm=sc[2], rwr=sc[3],
                          ldn=sc[4], lphi=sc[5])
-            return cache, hw
+            return cache, hw, attr
 
         from jax.sharding import NamedSharding as _NS
 
@@ -1056,7 +1093,7 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
             nw, phi_N = _spmd_front(theta, sh)
             packed = _spmd_fwd(nw, phi_N, sh["R"], sh["T"], sh["mask"],
                                sh["tm_pad"])
-            cache, _ = _unpack_spmd(packed, False)
+            cache, _, _ = _unpack_spmd(packed, False)
             return _stage3(theta, cache)
 
         def loglike_health_spmd(theta, sh):
@@ -1067,8 +1104,22 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
             nw, phi_N = _spmd_front(theta, sh)
             packed = _spmd_fwd_h(nw, phi_N, sh["R"], sh["T"],
                                  sh["mask"], sh["tm_pad"])
-            cache, hw = _unpack_spmd(packed, True)
+            cache, hw, _ = _unpack_spmd(packed, True)
             return _stage3(theta, cache), hw[:npsr_real]
+
+        def loglike_mesh_spmd(theta, sh):
+            """Sharded mesh-instrumented eval (mesh observability
+            plane): lnl + the (npsr_real, HW_WIDTH) health words + the
+            (nshard, MESH_ATTR_WIDTH) per-shard cost-attribution table
+            — all riding the evaluation's ONE packed psum, so arming
+            the plane adds zero collectives and zero dispatches (the
+            PR 16 HLO census holds on this twin too)."""
+            nw, phi_N = _spmd_front(theta, sh)
+            packed = _spmd_fwd_m(nw, phi_N, sh["R"], sh["T"],
+                                 sh["mask"], sh["tm_pad"])
+            cache, hw, attr = _unpack_spmd(packed, True,
+                                           with_attr=True)
+            return _stage3(theta, cache), hw[:npsr_real], attr
 
     if use_spmd:
         inner = loglike_spmd
@@ -1082,6 +1133,39 @@ def build_pta_likelihood(psrs, termlists, fixed_values=None,
         like._eval_health_batch = jax.vmap(_health, in_axes=(0, None))
         # pulsar-axis attribution for the health ladder (pads excluded)
         like.health_psr_names = [p.name for p in psrs]
+    if use_spmd:
+        # mesh observability plane: the attr-instrumented twin plus the
+        # static shard layout the host-side ledger folds against. The
+        # cost figures are a STATIC model (FLOP counts from the shard
+        # packing, psum payload from the packed-vector length) — the
+        # honest basis for decomposing a measured block wall on an
+        # emulated mesh, where per-shard wall-clock carries no signal
+        # (the BENCH_SCALE timing-basis precedent).
+        like._eval_mesh = loglike_mesh_spmd
+        like._eval_mesh_batch = jax.vmap(loglike_mesh_spmd,
+                                         in_axes=(0, None))
+        shard_psrs = [int(min(max(npsr_real - s * npsr_loc, 0),
+                              npsr_loc)) for s in range(nshard)]
+        shard_toas = [int(toamask[s * npsr_loc:(s + 1) * npsr_loc]
+                          .sum()) for s in range(nshard)]
+        # per-pulsar stage-1/2 FLOPs proxy: Gram (2*ntoa*nb^2) +
+        # factor/solve (nb^3) per pulsar; stage 3 is the replicated
+        # (npsr*n_g)^2 Schur factor
+        f12 = [2.0 * t * nb_tot ** 2 + p * float(nb_tot) ** 3
+               for t, p in zip(shard_toas, shard_psrs)]
+        n_s = npsr * n_g
+        lanes = (n_ss + n_xs if n_g else 0) + npsr * HW_WIDTH \
+            + nshard * MESH_ATTR_WIDTH + 6
+        like.mesh_layout = dict(
+            nshard=nshard, npsr_loc=npsr_loc,
+            attr_width=MESH_ATTR_WIDTH,
+            shard_psrs=shard_psrs, shard_toas=shard_toas,
+            shard_process=[int(getattr(d, "process_index", 0))
+                           for d in mesh.devices.ravel()],
+            flops_stage12_per_shard=f12,
+            flops_stage3=float(n_s) ** 3,
+            psum_payload_bytes=int(lanes * 8),
+            cost_basis="static_cost_model")
     # update_mask contract (evaluation-structure layer): installed for
     # the nested-Schur path on process-local arrays with a static basis
     # (a sampled chromatic index makes T walker-dependent, and a psr

@@ -346,6 +346,23 @@ class PTSampler:
                                  "?")]
             self._health_psrs = names
             self.health = [HealthLedger(psr=n) for n in names]
+        # mesh observability plane (utils/devicemetrics.py): when the
+        # likelihood runs sharded and exposes the mesh-instrumented
+        # eval twin, per-shard attribution lanes ride the existing
+        # packed psum home (parallel/pta.py:MESH_ATTR_WIDTH), the
+        # in-scan fold is one fixed-shape add in the carry, and the
+        # host ledger turns the harvest into skew / straggler /
+        # collective-wall gauges plus a typed mesh_stats event at
+        # block-commit cadence. Master-gated by EWT_TELEMETRY,
+        # plane-gated by EWT_MESH_STATS; off = empty carry slot,
+        # bit-identical block program.
+        self.mesh_stats = None
+        self._t_dispatch = None
+        if devicemetrics.mesh_enabled() \
+                and hasattr(like, "_eval_mesh_batch") \
+                and getattr(like, "mesh_layout", None):
+            self.mesh_stats = devicemetrics.MeshStatsLedger(
+                like.mesh_layout)
         os.makedirs(outdir, exist_ok=True)
 
     # ---------------- initialization / resume -------------------------- #
@@ -555,6 +572,29 @@ class PTSampler:
                         lambda t: full_h(t, consts), tc)
                     return (lnl_c.reshape(-1),
                             hw_c.reshape((-1,) + hw_c.shape[2:]))
+        # mesh observability plane: the mesh-instrumented eval twin
+        # returns (lnl, health words, per-shard attribution) with the
+        # attribution lanes riding the SAME packed psum — still
+        # exactly one collective per evaluation (the HLO census
+        # contract); when both planes are armed this one twin serves
+        # both. The in-scan fold is one fixed-shape add in the carry.
+        emit_mesh = self.mesh_stats is not None
+        self._mesh_emitted = emit_mesh
+        if emit_mesh:
+            n_mshard = self.mesh_stats.nshard
+            m_attr_w = self.mesh_stats.attr_width
+            batch_eval_m = like._eval_mesh_batch
+            if ck > 0 and self.W > ck and self.W % ck == 0:
+                full_m, nchunks_m = batch_eval_m, self.W // ck
+
+                def batch_eval_m(thetas, consts):     # noqa: F811
+                    tc = thetas.reshape(nchunks_m, ck,
+                                        thetas.shape[-1])
+                    lnl_c, hw_c, at_c = jax.lax.map(
+                        lambda t: full_m(t, consts), tc)
+                    return (lnl_c.reshape(-1),
+                            hw_c.reshape((-1,) + hw_c.shape[2:]),
+                            at_c.reshape((-1,) + at_c.shape[2:]))
         use_ind = bool(self.jump_probs[4] > 0)
         use_cg = bool(self.jump_probs[5] > 0)
         use_kde = bool(self.jump_probs[6] > 0)
@@ -606,7 +646,7 @@ class PTSampler:
                 fam_acc, fam_prop, mask_counts, \
                 eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL, \
                 lam, cg_rows, kde_pts, kde_bw, temps, consts, \
-                dstate, hstate = carry
+                dstate, hstate, mstate = carry
             key, k1, k2, k3, k4, k5, k6, k7, k8, k9, k10, k11 = \
                 jax.random.split(key, 12)
 
@@ -824,7 +864,10 @@ class PTSampler:
             key, ka = jax.random.split(key)
             with jax.named_scope("pt.eval"):
                 lnp_new = like.log_prior(prop)
-                if emit_health:
+                if emit_mesh:
+                    lnl_new, hw_new, at_new = batch_eval_m(prop,
+                                                           consts)
+                elif emit_health:
                     lnl_new, hw_new = batch_eval_h(prop, consts)
                 else:
                     lnl_new = batch_eval(prop, consts)
@@ -842,6 +885,12 @@ class PTSampler:
                     h_div + jnp.sum(hwv[:, :, 1] > 0.5, axis=0)
                     .astype(h_div.dtype),
                     jnp.maximum(h_cond, jnp.max(hwv[:, :, 2], axis=0)))
+            if emit_mesh:
+                # in-scan mesh-attribution fold: one add of the
+                # psum-carried (nshard, attr_width) table — fixed
+                # shape, no upload, harvested at the commit snapshot
+                atv = at_new if at_new.ndim == 3 else at_new[None]
+                mstate = (mstate[0] + jnp.sum(atv, axis=0),)
             if emit_nf:
                 nf_t = jnp.sum(
                     (~jnp.isfinite(lnl_new) & ~jnp.isneginf(lnp_new))
@@ -982,7 +1031,7 @@ class PTSampler:
                      fam_acc, fam_prop, mask_counts,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
                      lam, cg_rows, kde_pts, kde_bw, temps, consts,
-                     dstate, hstate), ys)
+                     dstate, hstate, mstate), ys)
 
         def block(x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                   fam_acc, fam_prop, mask_counts,
@@ -1001,11 +1050,15 @@ class PTSampler:
                            jnp.zeros((n_hpsr,)), jnp.zeros((n_hpsr,)))
             else:
                 hstate0 = ()
+            if emit_mesh:
+                mstate0 = (jnp.zeros((n_mshard, m_attr_w)),)
+            else:
+                mstate0 = ()
             carry = (x, lnl, lnp, key, hist, hist_len, acc, sacc, sprop,
                      fam_acc, fam_prop, mask_counts,
                      eigvecs, eigvals, chol, ind_mean, ind_L, ind_iL,
                      lam, cg_rows, kde_pts, kde_bw, temps, consts,
-                     dstate0, hstate0)
+                     dstate0, hstate0, mstate0)
             # named for jax.profiler captures (EWT_PROFILE_CAPTURE):
             # the whole K-step scan shows up as one legible region
             with jax.named_scope("ptmcmc_block"):
@@ -1186,6 +1239,10 @@ class PTSampler:
         # results landing (device went idle) and this dispatch handing
         # the device new work
         now = monotonic()
+        # mesh-plane block wall anchor: dispatch-to-commit-ready is
+        # the measured wall the static cost model splits into
+        # local/collective/stage-3 shares (devicemetrics ledger)
+        self._t_dispatch = now
         if self._t_ready is not None:
             b = now - self._t_ready
             self._last_bubble_s = b
@@ -1227,7 +1284,7 @@ class PTSampler:
         # diagnostics-plane harvest rides the SAME commit snapshot —
         # the one designed sync per block, so the plane adds zero host
         # round-trips (the BENCH_MIXING zero-overhead contract)
-        dstate = carry[-2] if getattr(self, "_diag_emitted", False) \
+        dstate = carry[-3] if getattr(self, "_diag_emitted", False) \
             else ()
         if dstate:
             leaves.update(
@@ -1237,11 +1294,17 @@ class PTSampler:
                 diag_fam_a=dstate[6], diag_fam_p=dstate[7])
         # kernel-health harvest: same single designed sync — the
         # health plane adds zero dispatches and zero host round-trips
-        hstate = carry[-1] if getattr(self, "_health_emitted", False) \
+        hstate = carry[-2] if getattr(self, "_health_emitted", False) \
             else ()
         if hstate:
             leaves.update(h_n=hstate[0], h_jit=hstate[1],
                           h_div=hstate[2], h_cond=hstate[3])
+        # mesh-attribution harvest: same single designed sync — the
+        # mesh plane adds zero dispatches and zero host round-trips
+        mstate = carry[-1] if getattr(self, "_mesh_emitted", False) \
+            else ()
+        if mstate:
+            leaves["mesh_attr"] = mstate[0]
         with span("pt.commit", steps=todo):
             # the commit sync is where a dead relay actually manifests
             # (the dispatch above is async) — watchdog-supervised, but
@@ -1300,6 +1363,8 @@ class PTSampler:
             self._escalate_nonfinite(snap, st, todo)
         if hstate:
             self._fold_health(snap, st, todo)
+        if mstate:
+            self._fold_mesh(snap, st, todo)
         return snap, snap["cold"], snap["cold_lnl"], snap["cold_lnp"]
 
     # ewt: allow-host-sync — anomaly forensics: reads the committed
@@ -1444,6 +1509,37 @@ class PTSampler:
             emit_psr_quarantined(psr, cause="kernel_health",
                                  where="sampler", stats=stats)
             raise PulsarQuarantine(psr, "kernel_health", stats)
+
+    def _fold_mesh(self, snap, st, todo):
+        """Fold one block's harvested per-shard attribution table into
+        the mesh ledger (``devicemetrics.MeshStatsLedger``) and
+        publish the mesh observability surface: ``shard_skew`` /
+        ``collective_wall_ms`` / ``straggler_index{host=}`` gauges, a
+        typed ``mesh_stats`` event at block-commit cadence, and the
+        per-process ``mesh_stats.<i>.json`` sidecar (the one
+        ``telemetry_ok`` multi-writer artifact). The measured wall fed
+        to the ledger is the dispatch-to-commit-ready window; the
+        split into local/collective/stage-3 shares comes from the
+        layout's static cost model (basis tagged in every payload)."""
+        wall_s = 0.0
+        if self._t_dispatch is not None and self._t_ready is not None:
+            wall_s = max(self._t_ready - self._t_dispatch, 0.0)
+        with span("pt.mesh_fold", steps=todo):
+            gauges = self.mesh_stats.fold(snap["mesh_attr"], wall_s)
+            reg = telemetry.registry()
+            reg.gauge("shard_skew").set(gauges["shard_skew"])
+            reg.gauge("collective_wall_ms").set(
+                gauges["collective_wall_ms"])
+            reg.gauge("straggler_index",
+                      host=str(gauges["straggler_host"])).set(
+                float(gauges["straggler_index"]))
+            rec = telemetry.active_recorder()
+            if rec is not None:
+                payload = self.mesh_stats.snapshot()
+                rec.event("mesh_stats", step=int(st.step), **payload)
+                run_dir = getattr(rec, "run_dir", None)
+                if run_dir:
+                    devicemetrics.write_mesh_stats(run_dir, payload)
 
     def _run_block(self, st, todo, temps=None):
         """Advance ``st`` by ``todo`` steps (dispatch + commit in one
@@ -2013,6 +2109,16 @@ class PTSampler:
                         led.n_diverge for led in self.health)
                     hb["kernel_cond"] = round(max(
                         led.max_logcond for led in self.health), 3)
+                if self.mesh_stats is not None \
+                        and self.mesh_stats._blocks:
+                    # mesh observability plane: the run-cumulative
+                    # skew/straggler/collective gauges (full per-shard
+                    # attribution rides the typed mesh_stats event)
+                    ms = self.mesh_stats.snapshot()
+                    hb["shard_skew"] = round(ms["shard_skew"], 4)
+                    hb["collective_wall_ms"] = round(
+                        ms["collective_wall_ms"], 3)
+                    hb["straggler_index"] = ms["straggler_index"]
                 # device-memory watermark gauges (profiling layer):
                 # present only on backends exposing memory_stats()
                 mem = profiling.memory_watermark()

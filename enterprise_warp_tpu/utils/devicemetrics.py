@@ -77,7 +77,8 @@ from . import telemetry
 __all__ = ["enabled", "welford_init", "welford_add", "welford_merge",
            "welford_finalize", "minmax_init", "minmax_add",
            "hist_init", "hist_add", "hist_bounds", "MomentLedger",
-           "DEFAULT_NBINS"]
+           "DEFAULT_NBINS", "mesh_enabled", "MeshStatsLedger",
+           "write_mesh_stats"]
 
 #: fixed bin count of the per-parameter marginal histograms — fixed at
 #: build time (retrace-free), sized for a heartbeat-grade marginal
@@ -497,3 +498,168 @@ class MomentLedger:
         for i in range(counts.size):
             led.append_block(counts[i], mean[i], m2[i], mn[i], mx[i])
         return led
+
+
+# ------------------------------------------------------------------ #
+#  mesh observability plane                                           #
+# ------------------------------------------------------------------ #
+
+#: collective cost-model coefficient: model FLOP-equivalents charged
+#: per psum payload byte when splitting the block wall into
+#: local/collective/stage-3 shares. A DCN-vs-ICI knob, not a
+#: measurement — override with ``EWT_MESH_COLL_FPB`` when profiling a
+#: real pod (the basis tag in every artifact says which model ran).
+DEFAULT_COLL_FLOP_PER_BYTE = 32.0
+
+
+def mesh_enabled() -> bool:
+    """Whether the mesh observability plane is armed: master-gated by
+    ``EWT_TELEMETRY`` (off = bit-identical block program), with
+    ``EWT_MESH_STATS=0`` as the plane-only hatch."""
+    return telemetry.enabled() \
+        and os.environ.get("EWT_MESH_STATS", "1") != "0"
+
+
+class MeshStatsLedger:
+    """Host-side fold of the per-shard attribution lanes riding the
+    packed psum (``parallel/pta.py:MESH_ATTR_WIDTH`` lanes per shard:
+    eval count, active-TOA work proxy, jitter-engaged count,
+    refine-diverged count) plus the static cost-model wall split.
+
+    Built from ``like.mesh_layout`` (shard geometry + per-shard
+    stage-1/2 FLOPs + stage-3 FLOPs + psum payload bytes, basis
+    ``static_cost_model``). Per block commit, :meth:`fold` takes the
+    harvested ``(nshard, attr_width)`` table and the measured
+    dispatch-to-commit wall and returns the heartbeat gauges:
+
+    - ``shard_skew`` — max/mean of the active-TOA work proxy across
+      shards (1.0 = perfectly balanced; includes padding-only shards,
+      which really are idle);
+    - ``collective_wall_ms`` — the measured block wall times the
+      model's collective fraction ``C_coll / (max(C12) + C3 +
+      C_coll)`` with ``C_coll = psum_payload_bytes *
+      EWT_MESH_COLL_FPB`` — an attribution of real wall to the model's
+      shares, never a second timer;
+    - ``straggler_index`` / ``straggler_host`` — the argmax-work shard
+      and the process that owns it (``mesh_layout["shard_process"]``).
+
+    Pure numpy at commit cadence; never touches a device array.
+    """
+
+    def __init__(self, layout):
+        self.layout = dict(layout)
+        self.nshard = int(layout["nshard"])
+        self.attr_width = int(layout.get("attr_width", 4))
+        self._attr = np.zeros((self.nshard, self.attr_width))
+        self._wall_s = 0.0
+        self._blocks = 0
+        self._straggler_hits = np.zeros(self.nshard, dtype=np.int64)
+        self._procs = [int(p) for p in
+                       layout.get("shard_process",
+                                  [0] * self.nshard)][:self.nshard]
+        f12 = np.asarray(layout.get("flops_stage12_per_shard",
+                                    [1.0] * self.nshard),
+                         dtype=np.float64)
+        f3 = float(layout.get("flops_stage3", 0.0))
+        self.coll_flop_per_byte = float(os.environ.get(
+            "EWT_MESH_COLL_FPB", DEFAULT_COLL_FLOP_PER_BYTE))
+        c_coll = (float(layout.get("psum_payload_bytes", 0))
+                  * self.coll_flop_per_byte)
+        crit = max(float(f12.max(initial=0.0)) + f3 + c_coll, 1.0)
+        #: model share of the block wall spent in the collective /
+        #: replicated stage 3 / the slowest shard's local stages
+        self.frac_coll = c_coll / crit
+        self.frac_stage3 = f3 / crit
+        self.frac_local = float(f12.max(initial=0.0)) / crit
+        #: imbalance the cost model predicts from geometry alone
+        #: (per-shard TOA/pulsar counts) — what the measured skew
+        #: should converge to on a healthy mesh
+        mean12 = max(float(f12.mean()), 1.0)
+        self.model_skew = float(f12.max(initial=0.0)) / mean12
+
+    # -------------------------- folds ------------------------------ #
+    @staticmethod
+    def _skew(work):
+        mean = float(work.mean())
+        if mean <= 0.0:
+            return 1.0
+        return float(work.max(initial=0.0)) / mean
+
+    def fold(self, attr, wall_s):
+        """Fold one block's harvested attribution table (``(nshard,
+        attr_width)``, cumulative within the block) and the measured
+        dispatch-to-commit wall; returns the block's gauge dict."""
+        attr = np.asarray(attr, dtype=np.float64).reshape(
+            self.nshard, self.attr_width)
+        wall_s = max(float(wall_s), 0.0)
+        self._attr += attr
+        self._wall_s += wall_s
+        self._blocks += 1
+        work = attr[:, 1]
+        straggler = int(np.argmax(work))
+        self._straggler_hits[straggler] += 1
+        return {
+            "shard_skew": self._skew(work),
+            "collective_wall_ms": wall_s * 1e3 * self.frac_coll,
+            "straggler_index": straggler,
+            "straggler_host": self._procs[straggler]
+            if straggler < len(self._procs) else 0,
+        }
+
+    # -------------------------- snapshot ---------------------------- #
+    def snapshot(self):
+        """Run-cumulative payload for the typed ``mesh_stats`` event
+        and the per-process sidecar: per-shard attribution columns,
+        the skew/straggler verdict, and the model wall split with its
+        honesty basis."""
+        work = self._attr[:, 1]
+        straggler = int(np.argmax(work)) if self.nshard else 0
+        wall_ms = self._wall_s * 1e3
+        return {
+            "nshard": self.nshard,
+            "blocks": int(self._blocks),
+            "shard_evals": [float(v) for v in self._attr[:, 0]],
+            "shard_work": [float(v) for v in work],
+            "shard_jitter": [float(v) for v in self._attr[:, 2]],
+            "shard_diverged": [float(v) for v in self._attr[:, 3]],
+            "shard_process": list(self._procs),
+            "straggler_hits": [int(v) for v in self._straggler_hits],
+            "shard_skew": self._skew(work),
+            "model_skew": self.model_skew,
+            "straggler_index": straggler,
+            "straggler_host": self._procs[straggler]
+            if straggler < len(self._procs) else 0,
+            "wall_ms": wall_ms,
+            "collective_wall_ms": wall_ms * self.frac_coll,
+            "stage3_wall_ms": wall_ms * self.frac_stage3,
+            "local_wall_ms": wall_ms * self.frac_local,
+            "collective_frac_model": self.frac_coll,
+            "coll_flop_per_byte": self.coll_flop_per_byte,
+            "cost_basis": self.layout.get("cost_basis",
+                                          "static_cost_model"),
+        }
+
+
+def write_mesh_stats(run_dir, payload):
+    """Per-process mesh attribution sidecar: ``mesh_stats.json`` on
+    the primary, ``mesh_stats.<process_index>.json`` elsewhere — the
+    one genuinely multi-writer artifact, legal because every process
+    owns a distinct path (the ``telemetry_ok`` contract). Returns the
+    written path."""
+    from ..parallel.distributed import primary_only, process_index
+
+    @primary_only(telemetry_ok=True)
+    def _write():
+        import json
+
+        idx = process_index()
+        name = ("mesh_stats.json" if idx == 0
+                else "mesh_stats.%d.json" % idx)
+        path = os.path.join(run_dir, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    return _write()

@@ -873,7 +873,17 @@ class RunRecorder:
     def __init__(self, run_dir: str, flush_every: int = 20,
                  flush_interval: float = 5.0):
         self.run_dir = run_dir
-        self.path = os.path.join(run_dir, "events.jsonl")
+        # multi-host telemetry streams (mesh observability plane):
+        # every process writes its OWN suffixed stream — telemetry is
+        # exempt from the single-writer rule because the filename
+        # carries the process index, so writers never race on one
+        # path. The primary keeps the unsuffixed name every existing
+        # consumer knows; tools/report.py and tools/campaign.py
+        # stitch ``events.<i>.jsonl`` shard streams into the mesh view
+        self.process_index, self.process_count = _host_identity()
+        name = ("events.jsonl" if self.process_index == 0
+                else f"events.{self.process_index}.jsonl")
+        self.path = os.path.join(run_dir, name)
         self.enabled = enabled()
         self._buf: list[str] = []
         self._flush_every = flush_every
@@ -1023,6 +1033,12 @@ class RunRecorder:
         info = dict(fields)
         info.setdefault("run_id", self.run_id)
         info.setdefault("campaign", self.campaign)
+        # host identity is jax-free (launcher env / process group):
+        # even a stream from a host whose jax fingerprint failed still
+        # says which process wrote it
+        if self.process_count > 1 or self.process_index:
+            info.setdefault("process_index", self.process_index)
+            info.setdefault("process_count", self.process_count)
         try:
             import jax
 
@@ -1031,6 +1047,8 @@ class RunRecorder:
             devs = jax.devices()
             info.setdefault("device_count", len(devs))
             info.setdefault("devices", sorted({d.platform for d in devs}))
+            info.setdefault("local_device_count",
+                            len(jax.local_devices()))
         except Exception:   # noqa: BLE001 — fingerprint is best-effort
             pass
         self.event("run_start", **info)
@@ -1045,6 +1063,12 @@ class RunRecorder:
         self.flush()        # the header must survive an early crash
 
     def heartbeat(self, **fields):
+        # host identification (mesh observability plane): on a
+        # multi-process run every heartbeat names its host, so a
+        # stitched mesh view can attribute rates/skew per process.
+        # Single-process streams are unchanged
+        if self.process_count > 1 or self.process_index:
+            fields.setdefault("process_index", self.process_index)
         self.event("heartbeat", **fields)
         # OpenMetrics textfile export on heartbeat cadence
         # (utils/metricsexport.py) — a no-op unless
@@ -1081,8 +1105,10 @@ class RunRecorder:
 
 
 class _NoopRecorder:
-    """Inert recorder handed out when telemetry is off (or on non-primary
-    distributed processes) so call sites never need a None check."""
+    """Inert recorder handed out when telemetry is off so call sites
+    never need a None check. (Non-primary distributed processes get a
+    REAL recorder writing a suffixed per-process stream — the mesh
+    observability plane's multi-host telemetry contract.)"""
 
     enabled = False
     run_dir = None
@@ -1091,6 +1117,8 @@ class _NoopRecorder:
     campaign = None
     parent_run_id = None
     lineage_reason = None
+    process_index = 0
+    process_count = 1
 
     def event(self, *args, **fields):
         pass
@@ -1122,6 +1150,18 @@ def _is_primary() -> bool:
         return True
 
 
+def _host_identity() -> tuple:
+    """``(process_index, process_count)`` — jax-free on single-process
+    and pre-init multi-process runs (launcher env), never raising:
+    telemetry must stay usable when the distributed layer is broken."""
+    try:
+        from ..parallel.distributed import process_count, process_index
+
+        return process_index(), process_count()
+    except Exception:   # noqa: BLE001 — never let telemetry kill a run
+        return 0, 1
+
+
 def _preempted() -> bool:
     """Whether a graceful preemption (SIGTERM) was requested this
     process — lazily imported so telemetry stays standalone."""
@@ -1144,14 +1184,19 @@ def run_scope(run_dir: str | None, **start_fields):
     CLI — reuse the active recorder and emit neither, so one run
     produces exactly one ``run_start``/``run_end`` pair.
 
-    Yields a recorder (a no-op one when telemetry is disabled,
-    ``run_dir`` is None, or this is a non-primary distributed
-    process); callers use it unconditionally.
+    Yields a recorder (a no-op one when telemetry is disabled or
+    ``run_dir`` is None); callers use it unconditionally. On a
+    multi-process run EVERY process gets a real recorder — the
+    non-primary ones write suffixed ``events.<process_index>.jsonl``
+    streams (telemetry only; the flight-recorder/trace/metrics
+    ARTIFACTS below stay primary-only), so a sharded run is no longer
+    mute off process 0 and ``tools/report.py``/``tools/campaign.py``
+    can stitch the shard streams into one mesh view.
     """
     if _ACTIVE:
         yield _ACTIVE[-1]
         return
-    if not enabled() or run_dir is None or not _is_primary():
+    if not enabled() or run_dir is None:
         yield _NOOP_RECORDER
         return
     rec = RunRecorder(run_dir)
@@ -1161,21 +1206,26 @@ def run_scope(run_dir: str | None, **start_fields):
     # the flight recorder to this run (anomaly dumps land under it)
     # and export the Chrome trace when the scope closes. Both are
     # no-ops unless their knobs (EWT_FLIGHTREC / EWT_SPANS) are set.
-    try:
-        from .flightrec import flight_recorder
+    # Artifact writers stay PRIMARY-ONLY: anomaly/, trace.json and the
+    # metrics endpoints are unsuffixed paths a non-primary writer
+    # would race on
+    if _is_primary():
+        try:
+            from .flightrec import flight_recorder
 
-        flight_recorder().bind(run_dir)
-    except Exception:   # noqa: BLE001 — profiling never kills a run
-        pass
-    # metrics exporters (utils/metricsexport.py): start the /metrics
-    # endpoint (EWT_METRICS_PORT) and announce any armed exporter as a
-    # metrics_export event — both inert without their knobs
-    try:
-        from .metricsexport import autostart
+            flight_recorder().bind(run_dir)
+        except Exception:   # noqa: BLE001 — profiling never kills a run
+            pass
+        # metrics exporters (utils/metricsexport.py): start the
+        # /metrics endpoint (EWT_METRICS_PORT) and announce any armed
+        # exporter as a metrics_export event — both inert without
+        # their knobs
+        try:
+            from .metricsexport import autostart
 
-        autostart(rec)
-    except Exception:   # noqa: BLE001 — telemetry never kills a run
-        pass
+            autostart(rec)
+        except Exception:   # noqa: BLE001 — telemetry never kills a run
+            pass
     status = "ok"
     try:
         yield rec
@@ -1215,8 +1265,10 @@ def run_scope(run_dir: str | None, **start_fields):
             from . import profiling
             from .flightrec import flight_recorder
 
-            flight_recorder().unbind()
-            profiling.flush_trace(run_dir)
+            if _is_primary():
+                flight_recorder().unbind()
+                # trace.json is an unsuffixed artifact — primary-only
+                profiling.flush_trace(run_dir)
             # finalize any in-flight jax.profiler capture window: a
             # window armed near the end of the run (e.g. by an
             # anomaly on one of the last blocks) would otherwise

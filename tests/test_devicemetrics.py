@@ -245,7 +245,7 @@ def test_pt_streaming_matches_exact_and_surfaces(tmp_path):
     assert len(hb["accept_rung"]) == s.ntemps
     assert len(hb["swap_rung"]) == s.ntemps - 1
     assert set(hb["fam_accept"]) == {"scam", "am", "de", "pd", "ind",
-                                     "cg", "kde", "ns"}
+                                     "cg", "kde", "ns", "flow"}
     assert hb["rhat_stream"] is not None
     mix = [e for e in events if e["type"] == "mixing"]
     assert mix and len(mix[-1]["fam_rung_rate"]) == s.ntemps

@@ -88,7 +88,8 @@ class TestSingleWriter:
         for f in ("chain_1.txt", "pars.txt", "cov.npy", "state.npz"):
             assert os.path.exists(tmp_path / f)
 
-    def test_nested_secondary_writes_nothing(self, tmp_path, as_secondary):
+    def test_nested_secondary_writes_artifacts_nowhere(self, tmp_path,
+                                                       as_secondary):
         from test_samplers import GaussianLike
         from enterprise_warp_tpu.samplers import run_nested
 
@@ -96,7 +97,14 @@ class TestSingleWriter:
         r = run_nested(like, outdir=str(tmp_path), nlive=150, dlogz=0.5,
                        seed=0, verbose=False, checkpoint_every=5)
         assert np.isfinite(r["log_evidence"])
-        assert list(tmp_path.iterdir()) == []
+        # the mesh-observability contract: a secondary may stream its
+        # OWN suffixed telemetry (events.<i>.jsonl — needed for the
+        # multi-host stitch), but every ARTIFACT stays primary-only
+        leftovers = [p.name for p in tmp_path.iterdir()
+                     if not (p.name.startswith("events.")
+                             and p.name.endswith(".jsonl"))]
+        assert leftovers == []
+        assert not (tmp_path / "events.jsonl").exists()
 
     def test_nfreqs_secondary_writes_nothing(self, tmp_path, as_secondary):
         from enterprise_warp_tpu.models.assemble import write_nfreqs_files

@@ -1,0 +1,516 @@
+"""Mesh observability plane (ISSUE 20): per-shard attribution lanes
+riding the packed psum, the static-cost-model wall split, straggler
+detection, multi-host telemetry stream stitching, and the sentinel
+``skew`` gate.
+
+The acceptance surface: arming the plane adds ZERO dispatches/syncs
+and leaves the chains bit-equal to ``EWT_TELEMETRY=0`` (the PR 10
+contract); the armed sharded evaluation still compiles to EXACTLY one
+all-reduce (the PR 16 census); and the per-shard attribution harvested
+from the lanes sums to the unsharded totals.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from test_distributed import _gwb_termlists, _pta, _theta_for
+
+from enterprise_warp_tpu.parallel import distributed
+from enterprise_warp_tpu.utils import devicemetrics as dm
+from enterprise_warp_tpu.utils import telemetry
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"ewt_{name}_cli_mesh", str(REPO_ROOT / "tools" / f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mesh_pair():
+    """(unsharded, 8-way sharded) likelihood pair + theta (the
+    test_distributed geometry, rebuilt here so this module owns its
+    compile cache)."""
+    from enterprise_warp_tpu.parallel import (build_pta_likelihood,
+                                              make_mesh)
+
+    psrs = _pta(8)
+    like0 = build_pta_likelihood(psrs, _gwb_termlists(psrs))
+    likeS = build_pta_likelihood(psrs, _gwb_termlists(psrs),
+                                 mesh=make_mesh(8))
+    return like0, likeS, _theta_for(like0.param_names)
+
+
+# ------------------------------------------------------------------ #
+#  attribution lanes on the eval twin                                 #
+# ------------------------------------------------------------------ #
+
+class TestAttributionLanes:
+    def test_mesh_twin_parity_and_lane_totals(self, mesh_pair):
+        """The 3-output mesh twin returns the SAME likelihood as the
+        plain sharded evaluator, and its attribution lanes reconstruct
+        the unsharded totals: one eval per shard, the active-TOA work
+        column summing to the full TOA count, per shard equal to the
+        layout's shard plan."""
+        import jax.numpy as jnp
+
+        like0, likeS, theta = mesh_pair
+        l0 = float(like0._eval(jnp.asarray(theta), like0.consts))
+        lM, hw, attr = likeS._eval_mesh(jnp.asarray(theta),
+                                        likeS.consts)
+        assert abs(l0 - float(lM)) < 1e-6 * abs(l0)
+        attr = np.asarray(attr)
+        layout = likeS.mesh_layout
+        assert attr.shape == (layout["nshard"], layout["attr_width"])
+        # lane 0: exactly one evaluation counted per shard
+        np.testing.assert_array_equal(attr[:, 0],
+                                      np.ones(layout["nshard"]))
+        # lane 1: the work proxy is the shard's active TOA count —
+        # sums to the unsharded total, matches the layout plan
+        np.testing.assert_array_equal(attr[:, 1],
+                                      np.asarray(layout["shard_toas"],
+                                                 dtype=float))
+        assert attr[:, 1].sum() == sum(len(p) for p in _pta(8))
+        # lanes 2/3 mirror the health plane's escalation counters
+        assert np.all(attr[:, 2:] >= 0)
+
+    def test_mesh_twin_census_exactly_one_all_reduce(self, mesh_pair):
+        """Arming the attribution lanes must not buy a second
+        collective: the mesh twin compiles to the SAME single packed
+        all-reduce as the plain evaluator (zero gathers, all-to-alls,
+        collective-permutes)."""
+        import re as _re
+
+        import jax
+        import jax.numpy as jnp
+
+        _, likeS, theta = mesh_pair
+        txt = (jax.jit(likeS._eval_mesh)
+               .lower(jnp.asarray(theta), likeS.consts)
+               .compile().as_text())
+        counts = tuple(len(_re.findall(p, txt)) for p in (
+            r"\ball-reduce(?:-start)?\(",
+            r"\ball-gather(?:-start)?\(",
+            r"\ball-to-all\(",
+            r"\bcollective-permute(?:-start)?\("))
+        assert counts == (1, 0, 0, 0), counts
+
+    def test_mesh_layout_contract(self, mesh_pair):
+        """The layout the ledger/bench consume: shard plan sums to the
+        pulsar count, static cost columns are positive, and the basis
+        is declared (the honesty tag every artifact carries)."""
+        _, likeS, _ = mesh_pair
+        lo = likeS.mesh_layout
+        assert lo["nshard"] == 8
+        assert sum(lo["shard_psrs"]) == 8
+        assert len(lo["shard_process"]) == 8
+        assert all(f > 0 for f in lo["flops_stage12_per_shard"])
+        assert lo["flops_stage3"] > 0
+        assert lo["psum_payload_bytes"] > 0
+        assert lo["cost_basis"] == "static_cost_model"
+
+
+# ------------------------------------------------------------------ #
+#  MeshStatsLedger (host-side fold)                                   #
+# ------------------------------------------------------------------ #
+
+def _layout(nshard=4, f12=None, f3=100.0, payload=10,
+            procs=None, toas=None):
+    return {
+        "nshard": nshard,
+        "attr_width": 4,
+        "shard_psrs": [2] * nshard,
+        "shard_toas": toas or [50] * nshard,
+        "shard_process": procs or [0] * nshard,
+        "flops_stage12_per_shard": f12 or [1000.0] * nshard,
+        "flops_stage3": f3,
+        "psum_payload_bytes": payload,
+        "cost_basis": "static_cost_model",
+    }
+
+
+class TestMeshStatsLedger:
+    def test_skew_math(self):
+        assert dm.MeshStatsLedger._skew(np.ones(4)) == 1.0
+        assert dm.MeshStatsLedger._skew(
+            np.array([3.0, 1.0, 1.0, 1.0])) == 2.0
+        # a dead mesh (all-zero work) reads balanced, not NaN
+        assert dm.MeshStatsLedger._skew(np.zeros(4)) == 1.0
+
+    def test_model_fractions_and_skew_from_geometry(self):
+        led = dm.MeshStatsLedger(_layout(
+            f12=[800.0, 400.0, 400.0, 400.0], f3=100.0, payload=10))
+        c_coll = 10 * led.coll_flop_per_byte
+        crit = 800.0 + 100.0 + c_coll
+        assert led.frac_coll == pytest.approx(c_coll / crit)
+        assert led.frac_stage3 == pytest.approx(100.0 / crit)
+        assert led.frac_local == pytest.approx(800.0 / crit)
+        assert led.model_skew == pytest.approx(800.0 / 500.0)
+
+    def test_coll_flop_per_byte_env_override(self, monkeypatch):
+        monkeypatch.setenv("EWT_MESH_COLL_FPB", "64.0")
+        led = dm.MeshStatsLedger(_layout())
+        assert led.coll_flop_per_byte == 64.0
+
+    def test_fold_accumulates_and_tracks_straggler(self):
+        led = dm.MeshStatsLedger(_layout(procs=[0, 0, 1, 1]))
+        attr = np.zeros((4, 4))
+        attr[:, 0] = 10.0                      # 10 evals per shard
+        attr[:, 1] = [100.0, 100.0, 300.0, 100.0]
+        g = led.fold(attr, wall_s=2.0)
+        assert g["shard_skew"] == pytest.approx(300.0 / 150.0)
+        assert g["straggler_index"] == 2
+        assert g["straggler_host"] == 1
+        assert g["collective_wall_ms"] == pytest.approx(
+            2000.0 * led.frac_coll)
+        led.fold(attr, wall_s=1.0)
+        snap = led.snapshot()
+        assert snap["blocks"] == 2
+        assert snap["shard_evals"] == [20.0] * 4
+        assert snap["shard_work"][2] == 600.0
+        assert snap["straggler_hits"] == [0, 0, 2, 0]
+        assert snap["wall_ms"] == pytest.approx(3000.0)
+        # the wall split is a decomposition of the measured wall
+        assert (snap["collective_wall_ms"] + snap["stage3_wall_ms"]
+                + snap["local_wall_ms"]) \
+            == pytest.approx(snap["wall_ms"])
+        assert snap["cost_basis"] == "static_cost_model"
+
+    def test_mesh_enabled_gating(self, monkeypatch):
+        monkeypatch.setenv("EWT_TELEMETRY", "1")
+        monkeypatch.delenv("EWT_MESH_STATS", raising=False)
+        assert dm.mesh_enabled()
+        monkeypatch.setenv("EWT_MESH_STATS", "0")
+        assert not dm.mesh_enabled()
+        monkeypatch.delenv("EWT_MESH_STATS", raising=False)
+        monkeypatch.setenv("EWT_TELEMETRY", "0")
+        assert not dm.mesh_enabled()
+
+    def test_write_mesh_stats_per_process_paths(self, tmp_path,
+                                                monkeypatch):
+        p = dm.write_mesh_stats(str(tmp_path), {"blocks": 1})
+        assert os.path.basename(p) == "mesh_stats.json"
+        monkeypatch.setattr(distributed, "process_index", lambda: 1)
+        monkeypatch.setattr(distributed, "process_count", lambda: 2)
+        p1 = dm.write_mesh_stats(str(tmp_path), {"blocks": 2})
+        # the telemetry_ok hatch: a SECONDARY process writes, to its
+        # own suffixed path — never the primary's artifact
+        assert os.path.basename(p1) == "mesh_stats.1.json"
+        assert json.load(open(tmp_path / "mesh_stats.json")) \
+            == {"blocks": 1}
+        assert json.load(open(tmp_path / "mesh_stats.1.json")) \
+            == {"blocks": 2}
+
+
+# ------------------------------------------------------------------ #
+#  8-way PT end-to-end: zero overhead + surfacing                     #
+# ------------------------------------------------------------------ #
+
+@pytest.fixture(scope="module")
+def pt_mesh_runs(tmp_path_factory):
+    """One armed + one EWT_TELEMETRY=0 PT run over the 8-way sharded
+    likelihood (module-scoped: the shard_map block compile dominates
+    this module's wall time)."""
+    from enterprise_warp_tpu.parallel import (build_pta_likelihood,
+                                              make_mesh)
+    from enterprise_warp_tpu.samplers import PTSampler
+
+    psrs = _pta(8)
+    likeS = build_pta_likelihood(psrs, _gwb_termlists(psrs),
+                                 mesh=make_mesh(8))
+
+    def run(outdir, tel):
+        old = os.environ.get("EWT_TELEMETRY")
+        os.environ["EWT_TELEMETRY"] = tel
+        telemetry.registry().reset()
+        try:
+            scope = (telemetry.run_scope(outdir, sampler="pt")
+                     if tel != "0"
+                     else telemetry.run_scope(None))
+            with scope:
+                s = PTSampler(likeS, outdir, ntemps=2, nchains=2,
+                              seed=7, cov_update=100)
+                s.sample(120, resume=False, verbose=False)
+        finally:
+            if old is None:
+                os.environ.pop("EWT_TELEMETRY", None)
+            else:
+                os.environ["EWT_TELEMETRY"] = old
+            telemetry.registry().reset()
+        chain = np.loadtxt(os.path.join(outdir, "chain_1.txt"))
+        return s, chain
+
+    root = tmp_path_factory.mktemp("pt_mesh")
+    s_on, chain_on = run(str(root / "on"), "1")
+    s_off, chain_off = run(str(root / "off"), "0")
+    return root, s_on, chain_on, s_off, chain_off
+
+
+class TestPTMeshEndToEnd:
+    def test_zero_overhead_bit_equality(self, pt_mesh_runs):
+        """The PR 10 contract on the mesh plane: arming attribution
+        adds no dispatches and no host syncs, and the chains are
+        BIT-equal to the EWT_TELEMETRY=0 run."""
+        _, s_on, chain_on, s_off, chain_off = pt_mesh_runs
+        assert s_on.mesh_stats is not None
+        assert s_off.mesh_stats is None
+        assert (s_on.n_dispatch, s_on.n_sync) \
+            == (s_off.n_dispatch, s_off.n_sync)
+        np.testing.assert_array_equal(chain_on, chain_off)
+
+    def test_mesh_stats_event_and_sidecar(self, pt_mesh_runs):
+        root, s_on, *_ = pt_mesh_runs
+        events = [json.loads(l) for l in
+                  open(root / "on" / "events.jsonl")]
+        ms = [e for e in events if e["type"] == "mesh_stats"]
+        assert ms, "no mesh_stats event at block-commit cadence"
+        last = ms[-1]
+        assert last["nshard"] == 8
+        assert last["cost_basis"] == "static_cost_model"
+        # every shard evaluated the same proposal count; the work
+        # table is the per-shard TOA traffic
+        evals = last["shard_evals"]
+        assert len(set(evals)) == 1 and evals[0] > 0
+        assert sum(last["shard_work"]) > 0
+        assert last["blocks"] == len(ms)
+        # heartbeats carry the three gauges
+        hb = [e for e in events if e["type"] == "heartbeat"
+              and "shard_skew" in e]
+        assert hb
+        assert "collective_wall_ms" in hb[-1]
+        assert "straggler_index" in hb[-1]
+        # the per-process sidecar landed next to the stream
+        side = json.load(open(root / "on" / "mesh_stats.json"))
+        assert side["blocks"] == last["blocks"]
+        # ...and NONE of the mesh artifacts exist on the dark run
+        assert not (root / "off" / "events.jsonl").exists()
+        assert not (root / "off" / "mesh_stats.json").exists()
+
+    def test_report_folds_mesh_section(self, pt_mesh_runs):
+        root, *_ = pt_mesh_runs
+        report = _load_tool("report")
+        events, dropped = report.load_events(
+            str(root / "on" / "events.jsonl"))
+        rep = report.build_report(events, dropped)
+        mesh = rep["mesh"]
+        assert mesh["nshard"] == 8
+        assert mesh["shard_skew"] is not None
+        assert mesh["cost_basis"] == "static_cost_model"
+        # --check vocabulary: the typed event and heartbeat fields are
+        # all known (no unknown-field drift)
+        chk = report.check_events(events) \
+            if hasattr(report, "check_events") else None
+        if chk is not None:
+            assert not chk.get("unknown_types")
+
+
+# ------------------------------------------------------------------ #
+#  multi-host stream stitch                                           #
+# ------------------------------------------------------------------ #
+
+def _mesh_event(pidx, blocks, work, wall_ms, hits, skew):
+    straggler = int(np.argmax(work))
+    return {
+        "type": "mesh_stats", "t": 1.0 + blocks,
+        "process_index": pidx, "nshard": len(work),
+        "blocks": blocks, "shard_evals": [float(blocks)] * len(work),
+        "shard_work": [float(w) for w in work],
+        "shard_jitter": [0.0] * len(work),
+        "shard_diverged": [0.0] * len(work),
+        "shard_process": [0, 0, 1, 1],
+        "straggler_hits": hits, "shard_skew": skew,
+        "model_skew": 1.0, "straggler_index": straggler,
+        "straggler_host": [0, 0, 1, 1][straggler],
+        "wall_ms": wall_ms,
+        "collective_wall_ms": 0.1 * wall_ms,
+        "stage3_wall_ms": 0.2 * wall_ms,
+        "local_wall_ms": 0.7 * wall_ms,
+        "collective_frac_model": 0.1, "coll_flop_per_byte": 32.0,
+        "cost_basis": "static_cost_model",
+    }
+
+
+def _write_stream(path, events):
+    with open(path, "w") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+
+
+class TestMultiStreamStitch:
+    def _make_run(self, root, work, hits, skew):
+        ev0 = [{"type": "run_start", "t": 0.0, "run_id": "r1"},
+               _mesh_event(0, 3, work, 900.0, hits, skew)]
+        ev1 = [{"type": "run_start", "t": 0.0, "run_id": "r1",
+                "process_index": 1},
+               _mesh_event(1, 3, work, 930.0, hits, skew)]
+        _write_stream(root / "events.jsonl", ev0)
+        _write_stream(root / "events.1.jsonl", ev1)
+
+    def test_stitch_reconstructs_per_host_rows(self, tmp_path):
+        """Two shard streams of one run fold into the mesh view: one
+        row per host in process order, the skew histogram over the
+        shard work table, and a straggler verdict."""
+        report = _load_tool("report")
+        self._make_run(tmp_path, [100, 100, 300, 100],
+                       hits=[0, 0, 3, 0], skew=2.0)
+        streams = []
+        for name in ("events.jsonl", "events.1.jsonl"):
+            ev, dropped = report.load_events(str(tmp_path / name))
+            streams.append((str(tmp_path / name), ev, dropped))
+        mesh = report.fold_mesh_streams(streams)
+        assert [h["process_index"] for h in mesh["hosts"]] == [0, 1]
+        assert mesh["hosts"][0]["wall_ms"] == 900.0
+        assert mesh["hosts"][1]["wall_ms"] == 930.0
+        # histogram: 3 shards at ratio 100/150 land in [0.5,0.9),
+        # the straggler at 300/150 in [1.5,inf)
+        hist = {(b["lo"], b["hi"]): b["shards"]
+                for b in mesh["skew_histogram"]}
+        assert hist[(0.5, 0.9)] == 3
+        assert hist[(1.5, None)] == 1
+        # one shard topped the table in 3/3 blocks on a skewed mesh
+        assert mesh["straggler"]["verdict"] == "persistent"
+        assert mesh["straggler"]["shard"] == 2
+        assert mesh["straggler"]["host"] == 1
+
+    def test_balanced_mesh_verdict(self, tmp_path):
+        report = _load_tool("report")
+        self._make_run(tmp_path, [100, 100, 100, 100],
+                       hits=[1, 1, 1, 0], skew=1.0)
+        streams = []
+        for name in ("events.jsonl", "events.1.jsonl"):
+            ev, dropped = report.load_events(str(tmp_path / name))
+            streams.append((str(tmp_path / name), ev, dropped))
+        mesh = report.fold_mesh_streams(streams)
+        assert mesh["straggler"]["verdict"] == "balanced"
+
+    def test_stream_process_index_resolution(self, tmp_path):
+        report = _load_tool("report")
+        # filename suffix wins when no heartbeat stamps the index
+        assert report._stream_process_index(
+            str(tmp_path / "events.3.jsonl"), []) == 3
+        assert report._stream_process_index(
+            str(tmp_path / "events.jsonl"), []) == 0
+        # an in-stream stamp beats the name
+        assert report._stream_process_index(
+            str(tmp_path / "events.jsonl"),
+            [{"type": "heartbeat", "process_index": 2}]) == 2
+
+
+# ------------------------------------------------------------------ #
+#  secondary-process telemetry stream                                 #
+# ------------------------------------------------------------------ #
+
+class TestSecondaryStream:
+    def test_secondary_writes_suffixed_stream_only(self, tmp_path,
+                                                   monkeypatch):
+        """A non-primary process records telemetry (its OWN suffixed
+        stream) while the artifact plane stays primary-only — the
+        run_scope relaxation that makes the stitch possible."""
+        monkeypatch.setenv("EWT_TELEMETRY", "1")
+        monkeypatch.setattr(distributed, "process_index", lambda: 1)
+        monkeypatch.setattr(distributed, "process_count", lambda: 2)
+        telemetry.registry().reset()
+        with telemetry.run_scope(str(tmp_path), sampler="pt"):
+            rec = telemetry.active_recorder()
+            assert rec is not None
+            assert rec.process_index == 1
+            rec.event("mesh_stats", blocks=1)
+        telemetry.registry().reset()
+        assert (tmp_path / "events.1.jsonl").exists()
+        assert not (tmp_path / "events.jsonl").exists()
+        ev = [json.loads(l) for l in open(tmp_path / "events.1.jsonl")]
+        assert any(e["type"] == "mesh_stats" for e in ev)
+        start = [e for e in ev if e["type"] == "run_start"]
+        assert start and start[0]["process_index"] == 1
+
+    def test_jax_free_env_process_identity(self, monkeypatch):
+        """Before (or without) jax.distributed init, the process
+        identity comes straight from the launcher env — no jax import
+        required on the hot path."""
+        monkeypatch.setattr(distributed, "_INITIALIZED", False)
+        monkeypatch.setenv("EWT_PROCESS_ID", "3")
+        monkeypatch.setenv("EWT_NUM_PROCESSES", "4")
+        assert distributed.process_index() == 3
+        assert distributed.process_count() == 4
+        assert not distributed.is_primary()
+
+
+# ------------------------------------------------------------------ #
+#  sentinel skew gate                                                 #
+# ------------------------------------------------------------------ #
+
+def _scale_record(imbalance=1.0, coll_frac=0.05, all_reduce=1,
+                  with_attr=True):
+    def entry(w, spmd):
+        e = {"npsr": 64, "width": w, "spmd": spmd,
+             "lnl": -1.0,
+             "collectives": {"all_reduce": all_reduce if spmd else 0,
+                             "all_gather": 0, "all_to_all": 0,
+                             "collective_permute": 0}}
+        if spmd and with_attr:
+            e["attribution"] = {
+                "shard_psrs": [64 // w] * w,
+                "shard_toas": [1024 * (64 // w)] * w,
+                "imbalance_ratio": imbalance,
+                "collective_frac_model": coll_frac,
+                "stage3_frac_model": 0.01,
+                "psum_payload_bytes": 1776,
+                "coll_flop_per_byte": 32.0,
+                "cost_basis": "static_cost_model"}
+        return e
+
+    return {"strong": {"per_width": {str(w): entry(w, w > 1)
+                                     for w in (1, 2, 4, 8)}},
+            "weak": {"per_width": {str(w): entry(w, w > 1)
+                                   for w in (1, 2, 4, 8)}}}
+
+
+class TestSentinelSkewGate:
+    def _gate(self, tmp_path, rec, **kw):
+        sentinel = _load_tool("sentinel")
+        if rec is not None:
+            with open(tmp_path / "BENCH_SCALE.json", "w") as fh:
+                json.dump(rec, fh)
+        return sentinel.gate_skew(str(tmp_path), **kw)
+
+    def test_healthy_record_passes(self, tmp_path):
+        g = self._gate(tmp_path, _scale_record())
+        assert g["status"] == "pass", g
+        assert g["worst_imbalance"] == 1.0
+
+    def test_skewed_record_fails(self, tmp_path):
+        g = self._gate(tmp_path, _scale_record(imbalance=2.0),
+                       max_skew=1.5)
+        assert g["status"] == "fail"
+        assert "imbalance" in g["detail"]
+
+    def test_collective_fraction_ceiling(self, tmp_path):
+        g = self._gate(tmp_path, _scale_record(coll_frac=0.9),
+                       max_coll_frac=0.5)
+        assert g["status"] == "fail"
+        assert "collective fraction" in g["detail"]
+
+    def test_second_collective_fails(self, tmp_path):
+        g = self._gate(tmp_path, _scale_record(all_reduce=2))
+        assert g["status"] == "fail"
+        assert "all-reduce" in g["detail"]
+
+    def test_missing_record_warns(self, tmp_path):
+        g = self._gate(tmp_path, None)
+        assert g["status"] == "warn"
+
+    def test_pre_attribution_record_warns(self, tmp_path):
+        """A committed record predating the attribution columns must
+        surface as WARN (refresh the bench), never silently pass."""
+        g = self._gate(tmp_path, _scale_record(with_attr=False))
+        assert g["status"] == "warn"
+        assert "refresh" in g["detail"]

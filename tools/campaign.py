@@ -61,12 +61,16 @@ from report import (_atomic_write_json, fold_segments,  # noqa: E402
 
 
 def discover_streams(root):
-    """Every ``events.jsonl`` under ``root`` (sorted, stable)."""
+    """Every telemetry stream under ``root`` (sorted, stable):
+    the primary ``events.jsonl`` plus any per-process shard streams
+    (``events.<i>.jsonl``, mesh observability plane)."""
     hits = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if not d.startswith(".")]
-        if "events.jsonl" in filenames:
-            hits.append(os.path.join(dirpath, "events.jsonl"))
+        for f in filenames:
+            if f == "events.jsonl" or (f.startswith("events.")
+                                       and f.endswith(".jsonl")):
+                hits.append(os.path.join(dirpath, f))
     return sorted(hits)
 
 
@@ -89,9 +93,19 @@ def fold_campaign(root, now=None, stale_s=300.0):
     # loads the (jax-importing) profiling clocks
     now = time.time() if now is None else now
     streams = discover_streams(root)
+    # one fleet row per run_dir: the primary stream drives the row;
+    # shard streams (events.<i>.jsonl) are counted, not re-rowed —
+    # their mesh roll-up rides the primary's mesh_stats events
+    by_dir: dict = {}
+    for path in streams:
+        by_dir.setdefault(os.path.dirname(path), []).append(path)
     all_segs = []
     runs = []
-    for path in streams:
+    for dirpath in sorted(by_dir):
+        group = by_dir[dirpath]
+        primary = os.path.join(dirpath, "events.jsonl")
+        path = primary if primary in group else sorted(group)[0]
+        n_shard_streams = len(group) - 1
         events, dropped = load_events(path)
         rel = os.path.relpath(os.path.dirname(path), root)
         segs = fold_segments(events, stream=rel)
@@ -138,6 +152,13 @@ def fold_campaign(root, now=None, stale_s=300.0):
             "batch_fill": term["batch_fill"],
             "requests_done": term["requests_done"],
             "queue_age_ms": term["queue_age_ms"],
+            # mesh observability plane: shard-work imbalance ratio
+            # plus the per-shard health-word escalation total (the
+            # quarantine-prone-shard early warning) and how many
+            # secondary-host shard streams live beside the primary
+            "shard_skew": term["shard_skew"],
+            "mesh_esc": term["mesh_esc"],
+            "shard_streams": n_shard_streams,
             "faults": counts["fault"],
             "retries": counts["retry"],
             "demotions": counts["demotion"],
@@ -213,7 +234,7 @@ def render(report, out=sys.stdout):
       f"{t['aggregate_running_evals_per_s']} evals/s")
     p()
     hdr = (f"{'run_dir':32s} {'status':10s} {'prog':>6s} "
-           f"{'evals/s':>9s} {'rhat':>7s} {'sess':>4s} "
+           f"{'evals/s':>9s} {'rhat':>7s} {'skew':>6s} {'sess':>4s} "
            f"{'flt':>3s} {'rty':>3s} {'dmt':>3s} lineage")
     p(hdr)
     p("-" * len(hdr))
@@ -247,6 +268,14 @@ def render(report, out=sys.stdout):
                 and age >= 1000.0 else "")
         else:
             rhat = "-"
+        # mesh plane: shard-work imbalance, marked "!" when any
+        # shard's health words escalated (jitter/divergence counts) —
+        # a quarantine-prone shard shows here before the ladder trips
+        if r.get("shard_skew") is not None:
+            skew = (f"{r['shard_skew']:.2f}"
+                    + ("!" if r.get("mesh_esc") else ""))
+        else:
+            skew = "-"
         flags = ("!" if r.get("anomaly") else "") \
             + ("v" if r.get("demoted") else "")
         reasons = ">".join({"fresh": "F", "resume": "R",
@@ -254,7 +283,8 @@ def render(report, out=sys.stdout):
                             "preempt-restart": "P"}.get(x, "?")
                            for x in r["reasons"])
         p(f"{r['run_dir'][:32]:32s} {(r['status'] + flags):10s} "
-          f"{prog:>6s} {rate:>9s} {rhat:>7s} {r['sessions']:>4d} "
+          f"{prog:>6s} {rate:>9s} {rhat:>7s} {skew:>6s} "
+          f"{r['sessions']:>4d} "
           f"{r['faults']:>3d} {r['retries']:>3d} "
           f"{r['demotions']:>3d} {reasons}")
     if g["orphans"]:

@@ -59,7 +59,7 @@ if _HERE not in sys.path:
 # package-free atomic JSON writer; this tool adds the per-tenant SLO
 # fold on top
 from report import (STAGE_FIELDS, _atomic_write_json,  # noqa: E402
-                    check_stream, load_events)
+                    check_stream, fold_mesh_streams, load_events)
 
 #: default SLO window when the stream carries no ``slo_config``
 #: (mirrors serve/slo.py:DEFAULT_WINDOW without importing the —
@@ -471,6 +471,64 @@ def render(report, out=sys.stdout):
             p(f"  ! {n}")
 
 
+# ------------------------------------------------------------------ #
+#  mesh fold (--mesh): the pod-scale view of one sharded run           #
+# ------------------------------------------------------------------ #
+
+def fold_mesh(root):
+    """``--mesh``: stitch the root's per-process telemetry streams
+    (``events.jsonl`` + ``events.<i>.jsonl``, mesh observability
+    plane) into the mesh view — per-host rows, the shard-work skew
+    histogram, and the straggler verdict. ``report.py``'s
+    :func:`fold_mesh_streams` owns the fold; this wrapper only
+    discovers the streams. None when the root carries no mesh
+    traffic."""
+    streams = []
+    for f in sorted(os.listdir(root)):
+        if f == "events.jsonl" or (f.startswith("events.")
+                                   and f.endswith(".jsonl")):
+            path = os.path.join(root, f)
+            events, dropped = load_events(path)
+            streams.append((path, events, dropped))
+    return fold_mesh_streams(streams)
+
+
+def render_mesh(mesh, out=sys.stdout):
+    """The mesh console: host table + skew histogram + verdict."""
+    def p(msg=""):
+        print(msg, file=out)
+
+    if not mesh:
+        p("mesh: no mesh_stats traffic in this root")
+        return
+    st = mesh["straggler"]
+    coll = mesh["collective"]
+    p(f"mesh: {len(mesh['hosts'])} host stream(s)")
+    p(f"straggler verdict: {st['verdict']} — shard {st['shard']} on "
+      f"host {st['host']} (hit_frac {st['hit_frac']}, skew "
+      f"{st['shard_skew']} vs model {st['model_skew']})")
+    if coll.get("wall_ms"):
+        p(f"collective wall: {coll['collective_wall_ms']:.1f}ms of "
+          f"{coll['wall_ms']:.1f}ms (model frac "
+          f"{coll['frac_model']:.3f}, basis {coll['cost_basis']})")
+    if mesh.get("skew_histogram"):
+        p("skew histogram (shard work / mean): " + "  ".join(
+            f"[{b['lo']},{b['hi'] if b['hi'] is not None else 'inf'})"
+            f"={b['shards']}" for b in mesh["skew_histogram"]))
+    p(f"{'host':>4s} {'blocks':>6s} {'wall_ms':>10s} "
+      f"{'coll_ms':>9s} {'skew':>6s} {'strag':>5s}")
+    for h in mesh["hosts"]:
+        wall = (f"{h['wall_ms']:.1f}" if h.get("wall_ms") is not None
+                else "-")
+        cw = (f"{h['collective_wall_ms']:.1f}"
+              if h.get("collective_wall_ms") is not None else "-")
+        sk = (f"{h['shard_skew']:.3f}"
+              if h.get("shard_skew") is not None else "-")
+        p(f"{h['process_index']:>4d} {h.get('blocks') or 0:>6d} "
+          f"{wall:>10s} {cw:>9s} {sk:>6s} "
+          f"{h.get('straggler_index', '-'):>5}")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="fold one serve root's driver + tenant streams "
@@ -498,11 +556,26 @@ def main(argv=None):
                     default=RECONCILE_TOL_MS,
                     help="decomposition reconciliation tolerance "
                          f"(default {RECONCILE_TOL_MS}ms)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="mesh observability fold instead of the "
+                         "tenant console: stitch the root's "
+                         "per-process shard streams into per-host "
+                         "rows, a skew histogram, and the straggler "
+                         "verdict (docs/scaling.md #mesh-plane)")
     opts = ap.parse_args(argv)
 
     if not os.path.isdir(opts.root):
         print(f"no serve root at {opts.root}", file=sys.stderr)
         return 2
+    if opts.mesh:
+        mesh = fold_mesh(opts.root)
+        out_path = opts.output or os.path.join(opts.root,
+                                               "mesh_report.json")
+        _atomic_write_json(out_path, mesh or {})
+        if not opts.quiet:
+            render_mesh(mesh)
+            print(f"report: {out_path}")
+        return 0 if mesh else 1
     out_path = opts.output or os.path.join(opts.root,
                                            "observatory_report.json")
     while True:

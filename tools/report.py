@@ -86,6 +86,11 @@ KNOWN_EVENT_TYPES = frozenset({
     # docs/flows.md): training fit open/close markers and the
     # exact-likelihood IS honesty rescoring verdict
     "flow_train", "flow_rescore",
+    # mesh observability plane (docs/scaling.md #mesh-plane): the
+    # per-shard attribution roll-up at block-commit cadence —
+    # shard work/eval/escalation columns, the skew/straggler
+    # verdict, and the model-based collective wall split
+    "mesh_stats",
 })
 
 #: the heartbeat field vocabulary — every field any sampler/driver
@@ -127,6 +132,12 @@ KNOWN_HEARTBEAT_FIELDS = frozenset({
     # jitter-fallback engagements, refinement divergences, and the
     # worst condition proxy seen so far
     "jitter_engaged", "refine_diverged", "kernel_cond",
+    # mesh observability plane (docs/scaling.md #mesh-plane):
+    # work-proxy imbalance ratio, the model-attributed collective
+    # wall, the argmax-work shard, and the emitting host (multi-host
+    # streams stamp their process index on every heartbeat)
+    "shard_skew", "collective_wall_ms", "straggler_index",
+    "process_index",
 })
 
 
@@ -213,7 +224,8 @@ def fold_segments(events, stream=None):
                 "evals_total": None, "rhat": None, "ess": None,
                 "rhat_stream": None, "ess_stream": None,
                 "queue_depth": None, "batch_fill": None,
-                "requests_done": None, "queue_age_ms": None}
+                "requests_done": None, "queue_age_ms": None,
+                "shard_skew": None, "mesh_esc": None}
 
     for ev in events:
         t = ev.get("type")
@@ -241,7 +253,7 @@ def fold_segments(events, stream=None):
             for k in ("step", "nsamp", "evals_per_s", "evals_total",
                       "rhat", "ess", "rhat_stream", "ess_stream",
                       "queue_depth", "batch_fill", "requests_done",
-                      "queue_age_ms"):
+                      "queue_age_ms", "shard_skew"):
                 if ev.get(k) is not None:
                     cur[k] = ev[k]
             # nested heartbeats carry 'iteration', never 'step' — the
@@ -249,6 +261,15 @@ def fold_segments(events, stream=None):
             if ev.get("step") is None \
                     and ev.get("iteration") is not None:
                 cur["step"] = ev["iteration"]
+        elif t == "mesh_stats":
+            # mesh plane roll-up (run-cumulative, last-wins): the
+            # skew figure plus the per-shard health-word escalation
+            # total the campaign fleet table surfaces
+            if ev.get("shard_skew") is not None:
+                cur["shard_skew"] = ev["shard_skew"]
+            cur["mesh_esc"] = int(
+                sum(ev.get("shard_jitter") or ())
+                + sum(ev.get("shard_diverged") or ()))
         elif t in ("fault", "retry", "demotion", "anomaly",
                    "checkpoint"):
             cur["counts"][t] += 1
@@ -487,6 +508,7 @@ def build_report(events, dropped=0):
                           else None),
         },
         "cache_hit_rate": cache_hit,
+        "mesh": _fold_mesh(by_type),
         "mixing": ({
             "stream_trajectory": stream_traj,
             "final_rhat_stream": (stream_traj[-1]["rhat_stream"]
@@ -549,6 +571,137 @@ def _fold_integrity(by_type):
             {str(ev.get("psr")) for ev in pq}),
         "quarantine_causes": {str(ev.get("psr")): str(ev.get("cause"))
                               for ev in pq} or None,
+    }
+
+
+def _fold_mesh(by_type):
+    """Mesh observability fold (docs/scaling.md #mesh-plane): the
+    latest ``mesh_stats`` roll-up — the events are run-cumulative, so
+    last-wins — plus the skew trajectory at block cadence. None when
+    the stream carries no mesh traffic."""
+    ms = by_type.get("mesh_stats", [])
+    if not ms:
+        return None
+    last = ms[-1]
+    return {
+        "nshard": last.get("nshard"),
+        "blocks": last.get("blocks"),
+        "shard_skew": last.get("shard_skew"),
+        "model_skew": last.get("model_skew"),
+        "straggler_index": last.get("straggler_index"),
+        "straggler_host": last.get("straggler_host"),
+        "straggler_hits": last.get("straggler_hits"),
+        "shard_evals": last.get("shard_evals"),
+        "shard_work": last.get("shard_work"),
+        "shard_jitter": last.get("shard_jitter"),
+        "shard_diverged": last.get("shard_diverged"),
+        "shard_process": last.get("shard_process"),
+        "wall_ms": last.get("wall_ms"),
+        "collective_wall_ms": last.get("collective_wall_ms"),
+        "local_wall_ms": last.get("local_wall_ms"),
+        "stage3_wall_ms": last.get("stage3_wall_ms"),
+        "collective_frac_model": last.get("collective_frac_model"),
+        "cost_basis": last.get("cost_basis"),
+        "events": len(ms),
+        "skew_trajectory": [
+            {"step": ev.get("step"),
+             "shard_skew": ev.get("shard_skew"),
+             "collective_wall_ms": ev.get("collective_wall_ms")}
+            for ev in ms],
+    }
+
+
+#: relative-work bin edges of the mesh skew histogram: a shard's work
+#: divided by the mean shard work — under 0.9 is starved, 0.9..1.1 is
+#: balanced, 1.5+ is a hot shard
+SKEW_BINS = ((0.0, 0.5), (0.5, 0.9), (0.9, 1.1), (1.1, 1.5),
+             (1.5, float("inf")))
+
+
+def _stream_process_index(path, events):
+    """The process index a telemetry stream belongs to: the stamp the
+    multi-host recorder puts on heartbeats, else the filename suffix
+    (``events.<i>.jsonl``), else 0 (primary)."""
+    for ev in events:
+        if ev.get("type") == "heartbeat" \
+                and ev.get("process_index") is not None:
+            return int(ev["process_index"])
+    parts = os.path.basename(path).split(".")
+    if len(parts) == 3 and parts[1].isdigit():
+        return int(parts[1])
+    return 0
+
+
+def fold_mesh_streams(streams):
+    """Stitch the per-process shard streams of ONE mesh run into the
+    mesh view: per-host rows (who emitted what, whose wall), the
+    relative-work skew histogram over shards, and the straggler
+    verdict (``persistent`` when one shard tops the work table in at
+    least half the blocks AND the mesh is skewed; ``roving`` when the
+    max moves around; ``balanced`` otherwise). ``streams`` is
+    ``[(path, events, dropped), ...]``. None when no stream carries
+    mesh traffic."""
+    hosts = []
+    latest = None
+    for path, events, _dropped in streams:
+        ms = [ev for ev in events if ev.get("type") == "mesh_stats"]
+        if not ms:
+            continue
+        last = ms[-1]
+        pidx = _stream_process_index(path, events)
+        hosts.append({
+            "process_index": pidx,
+            "stream": path,
+            "blocks": last.get("blocks"),
+            "wall_ms": last.get("wall_ms"),
+            "collective_wall_ms": last.get("collective_wall_ms"),
+            "shard_skew": last.get("shard_skew"),
+            "straggler_index": last.get("straggler_index"),
+        })
+        if latest is None or pidx == 0:
+            latest = last
+    if latest is None:
+        return None
+    hosts.sort(key=lambda h: h["process_index"])
+    work = [float(w) for w in latest.get("shard_work") or []]
+    skew_hist = None
+    if work:
+        mean = sum(work) / len(work)
+        ratios = [w / mean if mean > 0 else 1.0 for w in work]
+        skew_hist = [{"lo": lo, "hi": (None if hi == float("inf")
+                                       else hi),
+                      "shards": sum(1 for r in ratios
+                                    if lo <= r < hi)}
+                     for lo, hi in SKEW_BINS]
+    blocks = int(latest.get("blocks") or 0)
+    hits = latest.get("straggler_hits") or []
+    straggler = int(latest.get("straggler_index") or 0)
+    skew = float(latest.get("shard_skew") or 1.0)
+    hit_frac = (float(hits[straggler]) / blocks
+                if blocks and straggler < len(hits) else 0.0)
+    if skew <= 1.1:
+        verdict = "balanced"
+    elif hit_frac >= 0.5:
+        verdict = "persistent"
+    else:
+        verdict = "roving"
+    return {
+        "hosts": hosts,
+        "skew_histogram": skew_hist,
+        "straggler": {
+            "verdict": verdict,
+            "shard": straggler,
+            "host": latest.get("straggler_host"),
+            "hit_frac": round(hit_frac, 4),
+            "shard_skew": latest.get("shard_skew"),
+            "model_skew": latest.get("model_skew"),
+        },
+        "collective": {
+            "collective_wall_ms": latest.get("collective_wall_ms"),
+            "wall_ms": latest.get("wall_ms"),
+            "frac_model": latest.get("collective_frac_model"),
+            "cost_basis": latest.get("cost_basis"),
+        },
     }
 
 
@@ -763,6 +916,24 @@ def _human_summary(report, out=sys.stdout):
           f"{len(conv['trajectory'])} checks")
     if report["cache_hit_rate"] is not None:
         p(f"cache_hit_rate: {report['cache_hit_rate']}")
+    mesh = report.get("mesh")
+    if mesh:
+        bits = [f"{mesh.get('nshard')} shard(s)"]
+        if mesh.get("shard_skew") is not None:
+            s = f"skew {mesh['shard_skew']:.3f}"
+            if mesh.get("model_skew") is not None:
+                s += f" (model {mesh['model_skew']:.3f})"
+            bits.append(s)
+        if mesh.get("straggler_index") is not None:
+            bits.append(f"straggler shard {mesh['straggler_index']}"
+                        f"@host{mesh.get('straggler_host', 0)}")
+        if mesh.get("collective_wall_ms") is not None \
+                and mesh.get("wall_ms"):
+            bits.append(
+                f"collective {mesh['collective_wall_ms']:.1f}ms of "
+                f"{mesh['wall_ms']:.1f}ms "
+                f"[{mesh.get('cost_basis', '?')}]")
+        p("mesh: " + ", ".join(bits))
     mx = report.get("mixing")
     if mx:
         bits = []
@@ -1044,6 +1215,7 @@ def build_stitched_report(streams):
         per_stream[path] = sub
     return {
         "streams": per_stream,
+        "mesh": fold_mesh_streams(streams),
         "lineage": {
             "sessions": [{k: s[k] for k in
                           ("stream", "run_id", "campaign", "parent",
@@ -1079,11 +1251,24 @@ def main(argv=None):
     paths = []
     for path in opts.paths:
         if os.path.isdir(path):
-            path = os.path.join(path, "events.jsonl")
-        if not os.path.exists(path):
-            print(f"no event stream at {path}", file=sys.stderr)
-            return 1
-        paths.append(path)
+            # a mesh run_dir holds per-process shard streams
+            # (events.<i>.jsonl) next to the primary stream — fold
+            # them all, primary first
+            found = sorted(
+                (os.path.join(path, f) for f in os.listdir(path)
+                 if f == "events.jsonl"
+                 or (f.startswith("events.")
+                     and f.endswith(".jsonl"))),
+                key=lambda p: _stream_process_index(p, ()))
+            path = found if found \
+                else [os.path.join(path, "events.jsonl")]
+        else:
+            path = [path]
+        for one in path:
+            if not os.path.exists(one):
+                print(f"no event stream at {one}", file=sys.stderr)
+                return 1
+            paths.append(one)
     if opts.repair:
         for path in paths:
             repair_stream(path)
@@ -1114,12 +1299,32 @@ def main(argv=None):
         return 0
 
     report = build_stitched_report(streams)
-    out_path = opts.output or "run_report_stitched.json"
+    out_path = opts.output or os.path.join(
+        os.path.dirname(streams[0][0]), "run_report_stitched.json")
     _atomic_write_json(out_path, report)
     if not opts.quiet:
         for path, sub in report["streams"].items():
             print(f"== {path}")
             _human_summary(sub)
+        mm = report.get("mesh")
+        if mm:
+            st = mm["straggler"]
+            print(f"mesh view: {len(mm['hosts'])} host stream(s); "
+                  f"straggler verdict: {st['verdict']} (shard "
+                  f"{st['shard']}@host{st['host']}, hit "
+                  f"{st['hit_frac']}, skew {st['shard_skew']})")
+            if mm.get("skew_histogram"):
+                print("  skew histogram (work/mean): " + "  ".join(
+                    f"[{b['lo']},"
+                    f"{b['hi'] if b['hi'] is not None else 'inf'})"
+                    f"={b['shards']}"
+                    for b in mm["skew_histogram"]))
+            for h in mm["hosts"]:
+                print(f"  host {h['process_index']}: "
+                      f"blocks={h['blocks']} "
+                      f"wall={h['wall_ms']:.1f}ms "
+                      f"collective={h['collective_wall_ms']:.1f}ms "
+                      f"skew={h['shard_skew']:.3f}")
         g = report["lineage"]["graph"]
         print(f"campaign lineage: {g['nodes']} runs, "
               f"{len(g['edges'])} links, "

@@ -785,6 +785,78 @@ def gate_scale(bench_dir, min_strong_eff=0.6, min_npsr=64,
         weak_efficiency=(doc.get("weak") or {}).get("efficiency"))
 
 
+def gate_skew(bench_dir, max_skew=1.5, max_coll_frac=0.5):
+    """Mesh observability skew gates over BENCH_SCALE.json's
+    attribution columns (mesh plane, docs/scaling.md #mesh-plane):
+
+    - **imbalance ceiling** — every sharded width's geometric
+      imbalance ratio (max/mean per-shard stage-1/2 cost, static
+      model) must hold ``max_skew`` — a lopsided shard plan fails
+      here before it ever burns a pod;
+    - **collective-fraction ceiling** — the modeled collective share
+      of one evaluation must hold ``max_coll_frac`` at every sharded
+      width (a payload regression — say the packed psum growing a
+      quadratic lane — trips this);
+    - **census still one all-reduce** — re-checked per width so
+      arming the attribution lanes can never silently buy a second
+      collective;
+    - a record predating the attribution columns is a WARN (refresh
+      ``bench.py --scale``), never a silent pass.
+    """
+    doc = _load_json(os.path.join(bench_dir, "BENCH_SCALE.json"))
+    if not doc:
+        return _gate("skew", "warn", "no BENCH_SCALE.json record")
+    rows = []
+    for curve in ("strong", "weak"):
+        per_w = (doc.get(curve) or {}).get("per_width") or {}
+        for w, entry in sorted(per_w.items(),
+                               key=lambda kv: int(kv[0])):
+            if entry.get("spmd"):
+                rows.append((curve, w, entry))
+    if not rows:
+        return _gate("skew", "warn",
+                     "record carries no sharded widths")
+    missing = [f"{c}:{w}" for c, w, e in rows
+               if not isinstance(e.get("attribution"), dict)]
+    if missing:
+        return _gate(
+            "skew", "warn",
+            "record predates the mesh attribution columns (missing "
+            f"at {', '.join(missing)}) — refresh bench.py --scale")
+    problems = []
+    worst_skew = worst_frac = 0.0
+    for curve, w, entry in rows:
+        a = entry["attribution"]
+        imb = float(a.get("imbalance_ratio") or 0.0)
+        cf = float(a.get("collective_frac_model") or 0.0)
+        worst_skew = max(worst_skew, imb)
+        worst_frac = max(worst_frac, cf)
+        if imb > max_skew:
+            problems.append(f"{curve} width {w}: shard imbalance "
+                            f"{imb} > ceiling {max_skew}")
+        if cf > max_coll_frac:
+            problems.append(
+                f"{curve} width {w}: modeled collective fraction "
+                f"{cf} > ceiling {max_coll_frac}")
+        c = entry.get("collectives") or {}
+        if c.get("all_reduce") != 1 or any(
+                c.get(k) for k in ("all_gather", "all_to_all",
+                                   "collective_permute")):
+            problems.append(
+                f"{curve} width {w}: census {c} != one all-reduce "
+                "(attribution lanes must ride the existing psum)")
+    if problems:
+        return _gate("skew", "fail", "; ".join(problems),
+                     max_skew=max_skew, max_coll_frac=max_coll_frac)
+    return _gate(
+        "skew", "pass",
+        f"{len(rows)} sharded width(s): worst shard imbalance "
+        f"{worst_skew} <= {max_skew}, worst modeled collective "
+        f"fraction {worst_frac} <= {max_coll_frac}, one all-reduce "
+        "each", worst_imbalance=worst_skew,
+        worst_collective_frac=worst_frac)
+
+
 def gate_staleness(series, stale_days, now=None):
     """The "device leg went stale unnoticed" alarm: the newest
     headline must be a device measurement young enough to trust."""
@@ -964,6 +1036,13 @@ def main(argv=None):
     ap.add_argument("--min-scale-npsr", type=int, default=64,
                     help="minimum pulsar count the strong-scaling "
                          "curve must have raced (default 64)")
+    ap.add_argument("--max-skew", type=float, default=1.5,
+                    help="per-width shard imbalance ratio ceiling "
+                         "(max/mean static-model shard cost, "
+                         "default 1.5)")
+    ap.add_argument("--max-collective-frac", type=float, default=0.5,
+                    help="modeled collective fraction ceiling per "
+                         "sharded width (default 0.5)")
     ap.add_argument("--max-retraces", type=int, default=8,
                     help="per-fn retrace cap for --run (default 8)")
     ap.add_argument("--max-bubble", type=float, default=0.6,
@@ -1007,6 +1086,9 @@ def main(argv=None):
         gate_scale(opts.bench_dir,
                    min_strong_eff=opts.min_scale_eff,
                    min_npsr=opts.min_scale_npsr),
+        gate_skew(opts.bench_dir,
+                  max_skew=opts.max_skew,
+                  max_coll_frac=opts.max_collective_frac),
         gate_staleness(series, opts.stale_days),
     ]
     if opts.run is not None:
@@ -1040,6 +1122,8 @@ def main(argv=None):
             "max_flow_query_p50_ms": opts.max_flow_query_p50_ms,
             "min_scale_eff": opts.min_scale_eff,
             "min_scale_npsr": opts.min_scale_npsr,
+            "max_skew": opts.max_skew,
+            "max_collective_frac": opts.max_collective_frac,
             "max_retraces": opts.max_retraces,
             "max_bubble": opts.max_bubble,
             "stale_days": opts.stale_days,
